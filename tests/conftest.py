@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform so multi-chip
+sharding paths (mesh, pjit, shard_map, collectives) run without TPU hardware.
+
+Must set the env vars before jax initializes its backends (hence before any
+test module imports jax).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

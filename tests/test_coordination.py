@@ -1,0 +1,195 @@
+"""Tests for the Python<->C++ control plane (coordination.py) and the TCP
+store. Mirrors the reference's lighthouse_test.py / coordination_test.py:
+live in-proc servers on ephemeral ports, threads as replica groups.
+"""
+
+import threading
+
+import pytest
+
+from torchft_tpu import store as store_mod
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    yield server
+    server.shutdown()
+
+
+def test_lighthouse_quorum_two_replicas(lighthouse) -> None:
+    results = {}
+
+    def join(name: str, step: int) -> None:
+        client = LighthouseClient(lighthouse.address())
+        results[name] = client.quorum(
+            replica_id=name, step=step, timeout=10.0, address=f"addr-{name}"
+        )
+        client.close()
+
+    threads = [
+        threading.Thread(target=join, args=("alpha", 3)),
+        threading.Thread(target=join, args=("beta", 3)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert results["alpha"].quorum_id == results["beta"].quorum_id
+    ids = sorted(m.replica_id for m in results["alpha"].participants)
+    assert ids == ["alpha", "beta"]
+
+
+def test_lighthouse_quorum_timeout(lighthouse) -> None:
+    client = LighthouseClient(lighthouse.address())
+    with pytest.raises(TimeoutError):
+        client.quorum(replica_id="lonely", timeout=0.3)
+    client.close()
+
+
+def test_lighthouse_heartbeat_and_status(lighthouse) -> None:
+    client = LighthouseClient(lighthouse.address())
+    client.heartbeat("hb-replica")
+    status = client.status()
+    assert "hb-replica" in status["heartbeat_ages_ms"]
+    client.close()
+
+
+def test_lighthouse_http_dashboard(lighthouse) -> None:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/status", timeout=5
+    ) as resp:
+        body = resp.read().decode()
+    assert "torchft-tpu lighthouse" in body
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/status.json", timeout=5
+    ) as resp:
+        assert b"quorum_id" in resp.read()
+
+
+def test_manager_quorum_and_heal(lighthouse) -> None:
+    """Two replica groups; one lags and must heal from the other."""
+    mgr_a = ManagerServer(
+        replica_id="groupA",
+        lighthouse_addr=lighthouse.address(),
+        store_address="storeA:1",
+        world_size=1,
+    )
+    mgr_b = ManagerServer(
+        replica_id="groupB",
+        lighthouse_addr=lighthouse.address(),
+        store_address="storeB:1",
+        world_size=1,
+    )
+    results = {}
+
+    def quorum(name: str, addr: str, step: int) -> None:
+        client = ManagerClient(addr)
+        results[name] = client._quorum(
+            group_rank=0,
+            step=step,
+            checkpoint_metadata=f"ckpt-{name}",
+            shrink_only=False,
+            timeout=10.0,
+        )
+        client.close()
+
+    threads = [
+        threading.Thread(target=quorum, args=("a", mgr_a.address(), 0)),
+        threading.Thread(target=quorum, args=("b", mgr_b.address(), 5)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+
+    assert results["a"].heal
+    assert not results["b"].heal
+    assert results["a"].max_step == 5
+    assert results["a"].recover_src_manager_address == mgr_b.address()
+    assert results["b"].recover_dst_replica_ranks == [results["a"].replica_rank]
+    # Store comes from the up-to-date primary.
+    assert results["a"].store_address == "storeB:1"
+
+    # The recovering side can fetch the peer's checkpoint metadata.
+    client = ManagerClient(results["a"].recover_src_manager_address)
+    assert client._checkpoint_metadata(0) == "ckpt-b"
+    client.close()
+
+    mgr_a.shutdown()
+    mgr_b.shutdown()
+
+
+def test_manager_should_commit_barrier(lighthouse) -> None:
+    mgr = ManagerServer(
+        replica_id="solo",
+        lighthouse_addr=lighthouse.address(),
+        store_address="store:1",
+        world_size=2,
+    )
+    votes = {}
+
+    def vote(rank: int, value: bool) -> None:
+        client = ManagerClient(mgr.address())
+        votes[rank] = client.should_commit(rank, step=1, should_commit=value, timeout=10.0)
+        client.close()
+
+    t0 = threading.Thread(target=vote, args=(0, True))
+    t1 = threading.Thread(target=vote, args=(1, False))
+    t0.start(), t1.start()
+    t0.join(timeout=15), t1.join(timeout=15)
+    assert votes == {0: False, 1: False}
+
+    t0 = threading.Thread(target=vote, args=(0, True))
+    t1 = threading.Thread(target=vote, args=(1, True))
+    t0.start(), t1.start()
+    t0.join(timeout=15), t1.join(timeout=15)
+    assert votes == {0: True, 1: True}
+    mgr.shutdown()
+
+
+def test_store_basic() -> None:
+    server = store_mod.TCPStoreServer()
+    client = store_mod.StoreClient(server.address())
+    client.set("k", b"v1")
+    assert client.get("k") == b"v1"
+    assert client.check("k")
+    assert not client.check("missing")
+    with pytest.raises(TimeoutError):
+        client.get("missing", timeout=0.2)
+    assert client.add("ctr", 2) == 2
+    assert client.add("ctr", 3) == 5
+    assert client.delete("k")
+
+    # Prefixed clients are isolated namespaces.
+    p1 = client.with_prefix("torchft/1/0")
+    p2 = client.with_prefix("torchft/2/0")
+    p1.set("rank0", b"a")
+    assert not p2.check("rank0")
+    assert p1.get("rank0") == b"a"
+
+    # A blocked get is released by a set from another client.
+    result = {}
+
+    def blocked_get() -> None:
+        c = store_mod.StoreClient(server.address())
+        result["v"] = c.get("late-key", timeout=5.0)
+        c.close()
+
+    t = threading.Thread(target=blocked_get)
+    t.start()
+    client.set("late-key", b"arrived")
+    t.join(timeout=10)
+    assert result["v"] == b"arrived"
+    client.close()
+    server.shutdown()

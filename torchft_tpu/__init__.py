@@ -1,0 +1,14 @@
+"""torchft_tpu: per-step fault-tolerant training for TPU (JAX/XLA) clusters.
+
+A TPU-native framework with the capabilities of torchft
+(github.com/pytorch/torchft): a C++ Lighthouse computes a quorum of healthy
+replica groups each step; a per-group Manager reconfigures a resizable
+collective layer, live-heals recovering replicas by streaming checkpoints from
+a healthy peer, and gates optimizer commits with a distributed should-commit
+vote. Inner parallelism (FSDP/TP/SP) stays native XLA SPMD over ICI; the
+fault-tolerant replica axis runs host-driven over DCN.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = []  # populated as runtime modules land; see torchft_tpu.manager etc.

@@ -1,0 +1,65 @@
+// Standalone lighthouse CLI (reference: src/bin/lighthouse.rs + the
+// torchft_lighthouse console script). Prints "LISTENING <port>" on stdout once
+// bound so wrappers can discover the ephemeral port.
+#include <signal.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "lighthouse.hpp"
+#include "net.hpp"
+
+static const char* kUsage =
+    "usage: lighthouse --min-replicas N [--bind-host H] [--port P]\n"
+    "                  [--join-timeout-ms N] [--quorum-tick-ms N]\n"
+    "                  [--heartbeat-timeout-ms N]\n";
+
+int main(int argc, char** argv) {
+  std::string bind_host = "0.0.0.0";
+  int port = 29510;
+  tft::LighthouseOpts opts;
+  bool have_min = false;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s", kUsage);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--bind-host") {
+      bind_host = next();
+    } else if (a == "--port") {
+      port = std::stoi(next());
+    } else if (a == "--min-replicas") {
+      opts.min_replicas = std::stoll(next());
+      have_min = true;
+    } else if (a == "--join-timeout-ms") {
+      opts.join_timeout_ms = std::stoll(next());
+    } else if (a == "--quorum-tick-ms") {
+      opts.quorum_tick_ms = std::stoll(next());
+    } else if (a == "--heartbeat-timeout-ms") {
+      opts.heartbeat_timeout_ms = std::stoll(next());
+    } else {
+      fprintf(stderr, "unknown flag '%s'\n%s", a.c_str(), kUsage);
+      return 2;
+    }
+  }
+  if (!have_min) {
+    fprintf(stderr, "--min-replicas is required\n%s", kUsage);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  tft::Lighthouse lh(bind_host, port, opts);
+  if (!lh.start()) {
+    fprintf(stderr, "failed to bind %s:%d\n", bind_host.c_str(), port);
+    return 1;
+  }
+  printf("LISTENING %d\n", lh.port());
+  fflush(stdout);
+  while (true) tft::sleep_ms(1000);
+  return 0;
+}

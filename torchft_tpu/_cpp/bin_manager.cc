@@ -1,0 +1,71 @@
+// Standalone per-replica-group manager server CLI. Spawned by the Python
+// Manager on group rank 0 (the reference boots its Rust ManagerServer
+// in-process via pyo3; we isolate it in a subprocess so a wedged trainer
+// can't take the control plane down with it).
+#include <signal.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "manager_server.hpp"
+#include "net.hpp"
+
+static const char* kUsage =
+    "usage: torchft_manager --replica-id ID --lighthouse HOST:PORT\n"
+    "         --store-address HOST:PORT --world-size N\n"
+    "         [--advertise-host H] [--bind-host H] [--port P]\n"
+    "         [--heartbeat-interval-ms N] [--connect-timeout-ms N]\n"
+    "         [--quorum-retries N]\n";
+
+int main(int argc, char** argv) {
+  tft::ManagerOpts opts;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s", kUsage);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--replica-id") {
+      opts.replica_id = next();
+    } else if (a == "--lighthouse") {
+      opts.lighthouse_addr = next();
+    } else if (a == "--advertise-host") {
+      opts.advertise_host = next();
+    } else if (a == "--bind-host") {
+      opts.bind_host = next();
+    } else if (a == "--port") {
+      opts.port = std::stoi(next());
+    } else if (a == "--store-address") {
+      opts.store_address = next();
+    } else if (a == "--world-size") {
+      opts.world_size = std::stoll(next());
+    } else if (a == "--heartbeat-interval-ms") {
+      opts.heartbeat_interval_ms = std::stoll(next());
+    } else if (a == "--connect-timeout-ms") {
+      opts.connect_timeout_ms = std::stoll(next());
+    } else if (a == "--quorum-retries") {
+      opts.quorum_retries = std::stoll(next());
+    } else {
+      fprintf(stderr, "unknown flag '%s'\n%s", a.c_str(), kUsage);
+      return 2;
+    }
+  }
+  if (opts.replica_id.empty() || opts.lighthouse_addr.empty()) {
+    fprintf(stderr, "--replica-id and --lighthouse are required\n%s", kUsage);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  tft::ManagerServer server(opts);
+  if (!server.start()) {
+    fprintf(stderr, "failed to bind manager server\n");
+    return 1;
+  }
+  printf("LISTENING %d\n", server.port());
+  fflush(stdout);
+  while (true) tft::sleep_ms(1000);
+  return 0;
+}

@@ -1,0 +1,54 @@
+// Tracks live per-connection handler threads by fd so a server can shut them
+// all down promptly and wait for handlers to drain (connection threads are
+// detached; without this, stop() would block up to the idle-frame timeout on
+// every open connection, and the handle vector would grow unboundedly).
+#pragma once
+
+#include <sys/socket.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+#include <set>
+
+namespace tft {
+
+class ConnTracker {
+ public:
+  // Registers a connection. Returns false if the server is shutting down
+  // (caller should close the fd and bail).
+  bool add(int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (closed_) return false;
+    fds_.insert(fd);
+    return true;
+  }
+
+  void remove(int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    fds_.erase(fd);
+    cv_.notify_all();
+  }
+
+  // Interrupts every in-flight recv/send; handlers then exit on their own.
+  void shutdown_all() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    for (int fd : fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+
+  // Waits for all handler threads to deregister. Returns false on timeout.
+  bool wait_idle(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [this] { return fds_.empty(); });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::set<int> fds_;
+  bool closed_ = false;
+};
+
+}  // namespace tft

@@ -1,0 +1,437 @@
+// Minimal JSON value type + parser/serializer for the torchft-tpu control plane.
+//
+// The reference control plane (src/lighthouse.rs, src/manager.rs in
+// tushar00jain/torchft) speaks protobuf/gRPC; this TPU-native build uses
+// length-prefixed JSON frames over TCP instead (no external deps in the image),
+// with identical message capability (see proto/torchft.proto in the reference
+// for the fields each message carries).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace tft {
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, Str, Array, Object };
+
+  Type type = Type::Null;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  Json() = default;
+  static Json null() { return Json(); }
+  static Json of(bool v) {
+    Json j;
+    j.type = Type::Bool;
+    j.b = v;
+    return j;
+  }
+  static Json of(int64_t v) {
+    Json j;
+    j.type = Type::Int;
+    j.i = v;
+    return j;
+  }
+  static Json of(int v) { return of(static_cast<int64_t>(v)); }
+  static Json of(double v) {
+    Json j;
+    j.type = Type::Double;
+    j.d = v;
+    return j;
+  }
+  static Json of(const std::string& v) {
+    Json j;
+    j.type = Type::Str;
+    j.s = v;
+    return j;
+  }
+  static Json of(const char* v) { return of(std::string(v)); }
+  static Json array() {
+    Json j;
+    j.type = Type::Array;
+    return j;
+  }
+  static Json object() {
+    Json j;
+    j.type = Type::Object;
+    return j;
+  }
+
+  bool is_null() const { return type == Type::Null; }
+  bool is_object() const { return type == Type::Object; }
+  bool is_array() const { return type == Type::Array; }
+
+  // Accessors with defaults (lenient: wrong type returns the default).
+  bool as_bool(bool dflt = false) const {
+    if (type == Type::Bool) return b;
+    if (type == Type::Int) return i != 0;
+    return dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type == Type::Int) return i;
+    if (type == Type::Double) return static_cast<int64_t>(d);
+    if (type == Type::Bool) return b ? 1 : 0;
+    return dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    if (type == Type::Double) return d;
+    if (type == Type::Int) return static_cast<double>(i);
+    return dflt;
+  }
+  std::string as_str(const std::string& dflt = "") const {
+    return type == Type::Str ? s : dflt;
+  }
+
+  bool has(const std::string& key) const {
+    return type == Type::Object && obj.count(key) > 0;
+  }
+  const Json& get(const std::string& key) const {
+    static Json kNull;
+    auto it = obj.find(key);
+    return it == obj.end() ? kNull : it->second;
+  }
+  Json& operator[](const std::string& key) {
+    type = Type::Object;
+    return obj[key];
+  }
+  void push(Json v) {
+    type = Type::Array;
+    arr.push_back(std::move(v));
+  }
+
+  std::string dump() const {
+    std::string out;
+    dump_to(out);
+    return out;
+  }
+
+  void dump_to(std::string& out) const {
+    switch (type) {
+      case Type::Null:
+        out += "null";
+        break;
+      case Type::Bool:
+        out += b ? "true" : "false";
+        break;
+      case Type::Int:
+        out += std::to_string(i);
+        break;
+      case Type::Double: {
+        if (std::isfinite(d)) {
+          std::ostringstream ss;
+          ss.precision(17);
+          ss << d;
+          out += ss.str();
+        } else {
+          out += "null";
+        }
+        break;
+      }
+      case Type::Str:
+        escape_to(s, out);
+        break;
+      case Type::Array: {
+        out += '[';
+        bool first = true;
+        for (const auto& v : arr) {
+          if (!first) out += ',';
+          first = false;
+          v.dump_to(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& kv : obj) {
+          if (!first) out += ',';
+          first = false;
+          escape_to(kv.first, out);
+          out += ':';
+          kv.second.dump_to(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  // Parses `in` into `out`. Returns false and sets *err on malformed input.
+  static bool parse(const std::string& in, Json* out, std::string* err = nullptr) {
+    size_t pos = 0;
+    std::string e;
+    if (!parse_value(in, pos, out, &e)) {
+      if (err) *err = e;
+      return false;
+    }
+    skip_ws(in, pos);
+    if (pos != in.size()) {
+      if (err) *err = "trailing characters at " + std::to_string(pos);
+      return false;
+    }
+    return true;
+  }
+
+ private:
+  static void escape_to(const std::string& v, std::string& out) {
+    out += '"';
+    for (unsigned char c : v) {
+      switch (c) {
+        case '"':
+          out += "\\\"";
+          break;
+        case '\\':
+          out += "\\\\";
+          break;
+        case '\n':
+          out += "\\n";
+          break;
+        case '\r':
+          out += "\\r";
+          break;
+        case '\t':
+          out += "\\t";
+          break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  static void skip_ws(const std::string& in, size_t& pos) {
+    while (pos < in.size() && (in[pos] == ' ' || in[pos] == '\t' ||
+                               in[pos] == '\n' || in[pos] == '\r'))
+      pos++;
+  }
+
+  static bool fail(std::string* err, const std::string& msg, size_t pos) {
+    if (err) *err = msg + " at " + std::to_string(pos);
+    return false;
+  }
+
+  static bool parse_value(const std::string& in, size_t& pos, Json* out,
+                          std::string* err) {
+    skip_ws(in, pos);
+    if (pos >= in.size()) return fail(err, "unexpected end", pos);
+    char c = in[pos];
+    if (c == '{') return parse_object(in, pos, out, err);
+    if (c == '[') return parse_array(in, pos, out, err);
+    if (c == '"') {
+      out->type = Type::Str;
+      return parse_string(in, pos, &out->s, err);
+    }
+    if (c == 't') {
+      if (in.compare(pos, 4, "true") != 0) return fail(err, "bad literal", pos);
+      pos += 4;
+      *out = Json::of(true);
+      return true;
+    }
+    if (c == 'f') {
+      if (in.compare(pos, 5, "false") != 0) return fail(err, "bad literal", pos);
+      pos += 5;
+      *out = Json::of(false);
+      return true;
+    }
+    if (c == 'n') {
+      if (in.compare(pos, 4, "null") != 0) return fail(err, "bad literal", pos);
+      pos += 4;
+      *out = Json::null();
+      return true;
+    }
+    return parse_number(in, pos, out, err);
+  }
+
+  static bool parse_string(const std::string& in, size_t& pos, std::string* out,
+                           std::string* err) {
+    pos++;  // opening quote
+    out->clear();
+    while (pos < in.size()) {
+      char c = in[pos];
+      if (c == '"') {
+        pos++;
+        return true;
+      }
+      if (c == '\\') {
+        pos++;
+        if (pos >= in.size()) return fail(err, "bad escape", pos);
+        char e = in[pos];
+        switch (e) {
+          case '"':
+            *out += '"';
+            break;
+          case '\\':
+            *out += '\\';
+            break;
+          case '/':
+            *out += '/';
+            break;
+          case 'b':
+            *out += '\b';
+            break;
+          case 'f':
+            *out += '\f';
+            break;
+          case 'n':
+            *out += '\n';
+            break;
+          case 'r':
+            *out += '\r';
+            break;
+          case 't':
+            *out += '\t';
+            break;
+          case 'u': {
+            if (pos + 4 >= in.size()) return fail(err, "bad \\u escape", pos);
+            unsigned int cp = 0;
+            for (int k = 1; k <= 4; k++) {
+              char h = in[pos + k];
+              cp <<= 4;
+              if (h >= '0' && h <= '9')
+                cp |= h - '0';
+              else if (h >= 'a' && h <= 'f')
+                cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F')
+                cp |= h - 'A' + 10;
+              else
+                return fail(err, "bad hex", pos + k);
+            }
+            pos += 4;
+            // UTF-8 encode (surrogate pairs not combined; rare in control msgs).
+            if (cp < 0x80) {
+              *out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              *out += static_cast<char>(0xC0 | (cp >> 6));
+              *out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              *out += static_cast<char>(0xE0 | (cp >> 12));
+              *out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              *out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            return fail(err, "bad escape char", pos);
+        }
+        pos++;
+      } else {
+        *out += c;
+        pos++;
+      }
+    }
+    return fail(err, "unterminated string", pos);
+  }
+
+  static bool parse_number(const std::string& in, size_t& pos, Json* out,
+                           std::string* err) {
+    size_t start = pos;
+    if (pos < in.size() && (in[pos] == '-' || in[pos] == '+')) pos++;
+    bool is_double = false;
+    while (pos < in.size()) {
+      char c = in[pos];
+      if (c >= '0' && c <= '9') {
+        pos++;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+        if (c == '.' || c == 'e' || c == 'E') is_double = true;
+        pos++;
+      } else {
+        break;
+      }
+    }
+    if (pos == start) return fail(err, "bad number", pos);
+    std::string tok = in.substr(start, pos - start);
+    try {
+      if (is_double) {
+        *out = Json::of(std::stod(tok));
+      } else {
+        *out = Json::of(static_cast<int64_t>(std::stoll(tok)));
+      }
+    } catch (...) {
+      return fail(err, "unparseable number '" + tok + "'", start);
+    }
+    return true;
+  }
+
+  static bool parse_array(const std::string& in, size_t& pos, Json* out,
+                          std::string* err) {
+    pos++;  // '['
+    *out = Json::array();
+    skip_ws(in, pos);
+    if (pos < in.size() && in[pos] == ']') {
+      pos++;
+      return true;
+    }
+    while (true) {
+      Json v;
+      if (!parse_value(in, pos, &v, err)) return false;
+      out->arr.push_back(std::move(v));
+      skip_ws(in, pos);
+      if (pos >= in.size()) return fail(err, "unterminated array", pos);
+      if (in[pos] == ',') {
+        pos++;
+        continue;
+      }
+      if (in[pos] == ']') {
+        pos++;
+        return true;
+      }
+      return fail(err, "expected ',' or ']'", pos);
+    }
+  }
+
+  static bool parse_object(const std::string& in, size_t& pos, Json* out,
+                           std::string* err) {
+    pos++;  // '{'
+    *out = Json::object();
+    skip_ws(in, pos);
+    if (pos < in.size() && in[pos] == '}') {
+      pos++;
+      return true;
+    }
+    while (true) {
+      skip_ws(in, pos);
+      if (pos >= in.size() || in[pos] != '"')
+        return fail(err, "expected object key", pos);
+      std::string key;
+      if (!parse_string(in, pos, &key, err)) return false;
+      skip_ws(in, pos);
+      if (pos >= in.size() || in[pos] != ':')
+        return fail(err, "expected ':'", pos);
+      pos++;
+      Json v;
+      if (!parse_value(in, pos, &v, err)) return false;
+      out->obj[key] = std::move(v);
+      skip_ws(in, pos);
+      if (pos >= in.size()) return fail(err, "unterminated object", pos);
+      if (in[pos] == ',') {
+        pos++;
+        continue;
+      }
+      if (in[pos] == '}') {
+        pos++;
+        return true;
+      }
+      return fail(err, "expected ',' or '}'", pos);
+    }
+  }
+};
+
+}  // namespace tft

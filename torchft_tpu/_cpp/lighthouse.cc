@@ -1,0 +1,344 @@
+#include "lighthouse.hpp"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+#include "net.hpp"
+
+namespace tft {
+
+Lighthouse::Lighthouse(const std::string& bind_host, int port,
+                       LighthouseOpts opts)
+    : bind_host_(bind_host), port_(port), opts_(opts) {}
+
+Lighthouse::~Lighthouse() { stop(); }
+
+bool Lighthouse::start() {
+  listen_fd_ = tcp_listen(bind_host_, port_);
+  if (listen_fd_ < 0) return false;
+  port_ = bound_port(listen_fd_);
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  tick_thread_ = std::thread([this] { tick_loop(); });
+  return true;
+}
+
+void Lighthouse::stop() {
+  if (!running_.exchange(false)) return;
+  cv_.notify_all();
+  conns_.shutdown_all();  // interrupt in-flight frames so handlers drain fast
+  if (listen_fd_ >= 0) {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  conns_.wait_idle(10000);
+}
+
+std::string Lighthouse::address() const {
+  return "127.0.0.1:" + std::to_string(port_);
+}
+
+void Lighthouse::accept_loop() {
+  while (running_) {
+    int fd = tcp_accept(listen_fd_, 200);
+    if (fd < 0) continue;
+    if (!conns_.add(fd)) {
+      close(fd);
+      continue;
+    }
+    std::thread([this, fd] {
+      handle_conn(fd);
+      conns_.remove(fd);
+    }).detach();
+  }
+}
+
+void Lighthouse::tick_loop() {
+  while (running_) {
+    tick();
+    sleep_ms(opts_.quorum_tick_ms);
+  }
+}
+
+void Lighthouse::tick() {
+  std::unique_lock<std::mutex> lk(mu_);
+  std::string reason;
+  auto members = quorum_compute(now_ms(), state_, opts_, &reason);
+  if (!members) {
+    if (reason != last_reason_ && !state_.participants.empty()) {
+      fprintf(stderr, "[lighthouse] no quorum: %s\n", reason.c_str());
+    }
+    last_reason_ = reason;
+    return;
+  }
+  // Bump quorum_id only when membership changed or a member reported commit
+  // failures (lighthouse.rs:305-325) — a changed id forces process groups to
+  // reconfigure, so we avoid it when the world is stable.
+  bool bump = false;
+  if (!state_.prev_quorum) {
+    bump = true;
+  } else if (quorum_changed(state_.prev_quorum->participants, *members)) {
+    bump = true;
+  } else {
+    for (const auto& m : *members)
+      if (m.commit_failures > 0) bump = true;
+  }
+  if (bump) state_.quorum_id += 1;
+
+  Quorum q;
+  q.quorum_id = state_.quorum_id;
+  q.participants = *members;
+  q.created_ms = now_ms();
+  state_.prev_quorum = q;
+  state_.participants.clear();  // next round starts fresh (lighthouse.rs:336)
+  last_quorum_ = q;
+  quorum_gen_ += 1;
+  last_reason_.clear();
+  fprintf(stderr, "[lighthouse] quorum %lld formed with %zu members\n",
+          static_cast<long long>(q.quorum_id), q.participants.size());
+  lk.unlock();
+  cv_.notify_all();
+}
+
+void Lighthouse::handle_conn(int fd) {
+  // Sniff: framed requests begin with a 4-byte big-endian length whose first
+  // byte is 0 for any sane control message; HTTP begins with ASCII letters.
+  char peek[4] = {0};
+  int n = peek_bytes(fd, peek, 4, 30000);
+  if (n <= 0) {
+    close(fd);
+    return;
+  }
+  if (n >= 3 && (memcmp(peek, "GET", 3) == 0 || memcmp(peek, "POS", 3) == 0 ||
+                 memcmp(peek, "HEA", 3) == 0)) {
+    handle_http(fd);
+    close(fd);
+    return;
+  }
+  // Persistent framed connection: serve requests until the peer closes.
+  while (running_) {
+    std::string payload;
+    if (!recv_frame(fd, &payload, 3600 * 1000)) break;
+    Json req;
+    std::string err;
+    Json resp;
+    if (!Json::parse(payload, &req, &err)) {
+      resp["ok"] = Json::of(false);
+      resp["error"] = Json::of("bad json: " + err);
+    } else {
+      int64_t timeout = req.get("timeout_ms").as_int(60000);
+      resp = handle_request(req, now_ms() + timeout);
+    }
+    if (!send_frame(fd, resp.dump(), 30000)) break;
+  }
+  close(fd);
+}
+
+Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
+  const std::string type = req.get("type").as_str();
+  Json resp = Json::object();
+  if (type == "heartbeat") {
+    std::lock_guard<std::mutex> lk(mu_);
+    state_.heartbeats[req.get("replica_id").as_str()] = now_ms();
+    resp["ok"] = Json::of(true);
+    return resp;
+  }
+  if (type == "quorum") {
+    return quorum_rpc(req, deadline_ms);
+  }
+  if (type == "status") {
+    resp["ok"] = Json::of(true);
+    resp["status"] = status_json();
+    return resp;
+  }
+  if (type == "kill") {
+    // Forward a kill to the member's manager address (lighthouse.rs:454-479).
+    std::string replica_id = req.get("replica_id").as_str();
+    std::string addr;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (state_.prev_quorum) {
+        for (const auto& m : state_.prev_quorum->participants)
+          if (m.replica_id == replica_id) addr = m.address;
+      }
+      for (const auto& kv : state_.participants)
+        if (kv.first == replica_id) addr = kv.second.first.address;
+    }
+    if (addr.empty()) {
+      resp["ok"] = Json::of(false);
+      resp["error"] = Json::of("unknown replica " + replica_id);
+      return resp;
+    }
+    Json kill = Json::object();
+    kill["type"] = Json::of("kill");
+    kill["msg"] = Json::of("killed via lighthouse");
+    Json ignored;
+    bool ok = call_json_addr(addr, kill, &ignored, 5000);
+    // The victim exits without replying; treat connection-level failure after
+    // send as success-ish.
+    resp["ok"] = Json::of(true);
+    resp["sent"] = Json::of(ok);
+    return resp;
+  }
+  resp["ok"] = Json::of(false);
+  resp["error"] = Json::of("unknown request type '" + type + "'");
+  return resp;
+}
+
+Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
+  QuorumMember me = QuorumMember::from_json(req.get("requester"));
+  Json resp = Json::object();
+  if (me.replica_id.empty()) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of("quorum request missing requester.replica_id");
+    return resp;
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  // Joining is an implicit heartbeat (lighthouse.rs:502-512).
+  state_.heartbeats[me.replica_id] = now_ms();
+  state_.participants[me.replica_id] = {me, now_ms()};
+  int64_t my_gen = quorum_gen_;
+  lk.unlock();
+  // Proactive tick so a completing quorum doesn't wait for the next timer
+  // tick (lighthouse.rs:516-518).
+  tick();
+  lk.lock();
+
+  while (running_) {
+    // Wait for a fresh quorum broadcast.
+    while (running_ && quorum_gen_ == my_gen) {
+      if (cv_.wait_until(lk, std::chrono::system_clock::time_point(
+                                 std::chrono::milliseconds(deadline_ms))) ==
+          std::cv_status::timeout) {
+        if (now_ms() >= deadline_ms) {
+          resp["ok"] = Json::of(false);
+          resp["error"] = Json::of("timed out waiting for quorum");
+          resp["timeout"] = Json::of(true);
+          return resp;
+        }
+      }
+    }
+    if (!running_) break;
+    my_gen = quorum_gen_;
+    if (last_quorum_) {
+      bool in_quorum = false;
+      for (const auto& m : last_quorum_->participants)
+        if (m.replica_id == me.replica_id) in_quorum = true;
+      if (in_quorum) {
+        resp["ok"] = Json::of(true);
+        resp["quorum"] = last_quorum_->to_json();
+        return resp;
+      }
+      // Delivered quorum doesn't include us (we joined too late): rejoin and
+      // wait for the next one (lighthouse.rs:523-544).
+      state_.heartbeats[me.replica_id] = now_ms();
+      state_.participants[me.replica_id] = {me, now_ms()};
+    }
+  }
+  resp["ok"] = Json::of(false);
+  resp["error"] = Json::of("lighthouse shutting down");
+  return resp;
+}
+
+Json Lighthouse::status_json() {
+  std::lock_guard<std::mutex> lk(mu_);
+  Json s = Json::object();
+  s["quorum_id"] = Json::of(state_.quorum_id);
+  int64_t now = now_ms();
+  Json hb = Json::object();
+  for (const auto& kv : state_.heartbeats)
+    hb[kv.first] = Json::of(now - kv.second);
+  s["heartbeat_ages_ms"] = hb;
+  Json parts = Json::array();
+  for (const auto& kv : state_.participants)
+    parts.push(kv.second.first.to_json());
+  s["participants"] = parts;
+  s["prev_quorum"] =
+      state_.prev_quorum ? state_.prev_quorum->to_json() : Json::null();
+  s["reason"] = Json::of(last_reason_);
+  return s;
+}
+
+std::string Lighthouse::render_status_html() {
+  Json s = status_json();
+  std::ostringstream html;
+  html << "<!doctype html><html><head><title>torchft-tpu lighthouse</title>"
+       << "<style>body{font-family:monospace;margin:2em}table{border-collapse:"
+          "collapse}td,th{border:1px solid #999;padding:4px 8px}</style>"
+       << "</head><body><h1>torchft-tpu lighthouse</h1>"
+       << "<p>quorum_id: " << s.get("quorum_id").as_int() << "</p>";
+  html << "<h2>heartbeats</h2><table><tr><th>replica</th><th>age (ms)</th>"
+       << "<th></th></tr>";
+  for (const auto& kv : s.get("heartbeat_ages_ms").obj) {
+    html << "<tr><td>" << kv.first << "</td><td>" << kv.second.as_int()
+         << "</td><td><form method=post action=\"/replica/" << kv.first
+         << "/kill\"><button>kill</button></form></td></tr>";
+  }
+  html << "</table><h2>previous quorum</h2><table><tr><th>replica</th>"
+       << "<th>address</th><th>step</th><th>world</th></tr>";
+  if (s.get("prev_quorum").is_object()) {
+    for (const auto& p : s.get("prev_quorum").get("participants").arr) {
+      html << "<tr><td>" << p.get("replica_id").as_str() << "</td><td>"
+           << p.get("address").as_str() << "</td><td>"
+           << p.get("step").as_int() << "</td><td>"
+           << p.get("world_size").as_int() << "</td></tr>";
+    }
+  }
+  html << "</table>";
+  if (!s.get("reason").as_str().empty())
+    html << "<p>waiting: " << s.get("reason").as_str() << "</p>";
+  html << "</body></html>";
+  return html.str();
+}
+
+void Lighthouse::handle_http(int fd) {
+  std::string req = read_http_request(fd, 10000);
+  std::string path = "/";
+  {
+    size_t sp1 = req.find(' ');
+    size_t sp2 = req.find(' ', sp1 + 1);
+    if (sp1 != std::string::npos && sp2 != std::string::npos)
+      path = req.substr(sp1 + 1, sp2 - sp1 - 1);
+  }
+  std::string body;
+  std::string ctype = "text/html";
+  int code = 200;
+  if (path == "/" || path == "/status") {
+    body = render_status_html();
+  } else if (path == "/status.json") {
+    body = status_json().dump();
+    ctype = "application/json";
+  } else if (path.rfind("/replica/", 0) == 0 &&
+             path.size() > 14 &&
+             path.compare(path.size() - 5, 5, "/kill") == 0) {
+    std::string replica_id = path.substr(9, path.size() - 9 - 5);
+    Json kreq = Json::object();
+    kreq["type"] = Json::of("kill");
+    kreq["replica_id"] = Json::of(replica_id);
+    Json kresp = handle_request(kreq, now_ms() + 5000);
+    body = kresp.dump();
+    ctype = "application/json";
+    if (!kresp.get("ok").as_bool()) code = 404;
+  } else {
+    code = 404;
+    body = "not found";
+    ctype = "text/plain";
+  }
+  std::ostringstream hdr;
+  hdr << "HTTP/1.1 " << code << (code == 200 ? " OK" : " Not Found")
+      << "\r\nContent-Type: " << ctype
+      << "\r\nContent-Length: " << body.size()
+      << "\r\nConnection: close\r\n\r\n";
+  std::string out = hdr.str() + body;
+  write_all(fd, out.data(), out.size(), 10000);
+}
+
+}  // namespace tft

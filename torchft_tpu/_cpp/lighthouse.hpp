@@ -1,0 +1,74 @@
+// Lighthouse: the global quorum coordinator for torchft-tpu.
+//
+// Capability parity with the reference's src/lighthouse.rs:68-480:
+// heartbeats + participants maps, a tick loop running quorum_compute every
+// quorum_tick_ms, quorum_id bumps on membership change or commit failures,
+// blocking Quorum requests answered via broadcast, an HTTP status dashboard
+// served on the same port (sniffed by first bytes), and a kill endpoint that
+// forwards a Kill message to a member's manager address.
+//
+// Wire protocol: length-prefixed JSON frames (see net.hpp). Requests:
+//   {"type":"heartbeat","replica_id":...}
+//   {"type":"quorum","timeout_ms":N,"requester":{QuorumMember}}
+//   {"type":"status"}
+//   {"type":"kill","replica_id":...}
+// HTTP: GET / or /status (dashboard), GET/POST /replica/<id>/kill.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.hpp"
+#include "quorum.hpp"
+
+namespace tft {
+
+class Lighthouse {
+ public:
+  Lighthouse(const std::string& bind_host, int port, LighthouseOpts opts);
+  ~Lighthouse();
+
+  // Starts listener + tick threads. Returns false if bind failed.
+  bool start();
+  void stop();
+
+  int port() const { return port_; }
+  std::string address() const;
+
+  // Exposed for tests: runs one tick synchronously.
+  void tick();
+
+ private:
+  void accept_loop();
+  void tick_loop();
+  void handle_conn(int fd);
+  void handle_frame_conn(int fd, const std::string& first_payload);
+  void handle_http(int fd);
+  Json handle_request(const Json& req, int64_t deadline_ms);
+  Json quorum_rpc(const Json& req, int64_t deadline_ms);
+  std::string render_status_html();
+  Json status_json();
+
+  std::string bind_host_;
+  int port_;
+  LighthouseOpts opts_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  LighthouseState state_;
+  std::optional<Quorum> last_quorum_;  // most recently broadcast quorum
+  int64_t quorum_gen_ = 0;             // bumped on every broadcast
+  std::string last_reason_;            // why no quorum yet (for status page)
+
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::thread tick_thread_;
+  ConnTracker conns_;
+};
+
+}  // namespace tft

@@ -1,0 +1,312 @@
+#include "manager_server.hpp"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+
+#include "net.hpp"
+
+namespace tft {
+
+ManagerServer::ManagerServer(ManagerOpts opts) : opts_(std::move(opts)) {
+  if (opts_.bind_host.empty()) opts_.bind_host = "0.0.0.0";
+  if (opts_.advertise_host.empty()) opts_.advertise_host = "127.0.0.1";
+}
+
+ManagerServer::~ManagerServer() { stop(); }
+
+bool ManagerServer::start() {
+  listen_fd_ = tcp_listen(opts_.bind_host, opts_.port);
+  if (listen_fd_ < 0) return false;
+  port_ = bound_port(listen_fd_);
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+  return true;
+}
+
+void ManagerServer::stop() {
+  if (!running_.exchange(false)) return;
+  cv_.notify_all();
+  conns_.shutdown_all();  // interrupt in-flight frames so handlers drain fast
+  if (listen_fd_ >= 0) {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  conns_.wait_idle(10000);
+}
+
+void ManagerServer::accept_loop() {
+  while (running_) {
+    int fd = tcp_accept(listen_fd_, 200);
+    if (fd < 0) continue;
+    if (!conns_.add(fd)) {
+      close(fd);
+      continue;
+    }
+    std::thread([this, fd] {
+      handle_conn(fd);
+      conns_.remove(fd);
+    }).detach();
+  }
+}
+
+void ManagerServer::heartbeat_loop() {
+  // Pings the lighthouse every heartbeat_interval_ms over a persistent
+  // connection, recreating it on failure (manager.rs:194-216).
+  std::string host;
+  int port = 0;
+  if (!split_host_port(opts_.lighthouse_addr, &host, &port)) {
+    fprintf(stderr, "[manager %s] bad lighthouse addr '%s'\n",
+            opts_.replica_id.c_str(), opts_.lighthouse_addr.c_str());
+    return;
+  }
+  int fd = -1;
+  while (running_) {
+    if (fd < 0) fd = tcp_connect(host, port, opts_.connect_timeout_ms);
+    if (fd >= 0) {
+      Json req = Json::object();
+      req["type"] = Json::of("heartbeat");
+      req["replica_id"] = Json::of(opts_.replica_id);
+      Json resp;
+      if (!call_json(fd, req, &resp, 5000)) {
+        close(fd);
+        fd = -1;
+      }
+    }
+    sleep_ms(opts_.heartbeat_interval_ms);
+  }
+  if (fd >= 0) close(fd);
+}
+
+void ManagerServer::handle_conn(int fd) {
+  while (running_) {
+    std::string payload;
+    if (!recv_frame(fd, &payload, 3600 * 1000)) break;
+    Json req;
+    std::string err;
+    Json resp;
+    if (!Json::parse(payload, &req, &err)) {
+      resp["ok"] = Json::of(false);
+      resp["error"] = Json::of("bad json: " + err);
+    } else {
+      int64_t timeout = req.get("timeout_ms").as_int(60000);
+      resp = handle_request(req, now_ms() + timeout);
+    }
+    if (!send_frame(fd, resp.dump(), 30000)) break;
+  }
+  close(fd);
+}
+
+Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
+  const std::string type = req.get("type").as_str();
+  Json resp = Json::object();
+  if (type == "quorum") return quorum_rpc(req, deadline_ms);
+  if (type == "should_commit") return should_commit_rpc(req, deadline_ms);
+  if (type == "checkpoint_metadata") {
+    int64_t rank = req.get("rank").as_int();
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = checkpoint_metadata_.find(rank);
+    if (it == checkpoint_metadata_.end()) {
+      resp["ok"] = Json::of(false);
+      resp["error"] =
+          Json::of("no checkpoint metadata for rank " + std::to_string(rank));
+    } else {
+      resp["ok"] = Json::of(true);
+      resp["checkpoint_metadata"] = Json::of(it->second);
+    }
+    return resp;
+  }
+  if (type == "kill") {
+    fprintf(stderr, "[manager %s] kill requested: %s\n",
+            opts_.replica_id.c_str(), req.get("msg").as_str().c_str());
+    fflush(stderr);
+    // _exit, not exit: static destructors would try to join live server
+    // threads and delay the death the caller is counting on
+    // (reference kills the whole process too, manager.rs:481-486).
+    _exit(1);
+  }
+  if (type == "info") {
+    resp["ok"] = Json::of(true);
+    resp["replica_id"] = Json::of(opts_.replica_id);
+    resp["address"] = Json::of(address());
+    resp["world_size"] = Json::of(opts_.world_size);
+    return resp;
+  }
+  resp["ok"] = Json::of(false);
+  resp["error"] = Json::of("unknown request type '" + type + "'");
+  return resp;
+}
+
+std::optional<Quorum> ManagerServer::lighthouse_quorum(const QuorumMember& me,
+                                                       int64_t deadline_ms) {
+  // Retry with per-attempt deadline slices (manager.rs:250-306): each attempt
+  // gets total/(retries+1); sleeps at least 100ms between attempts.
+  int64_t attempts = std::max<int64_t>(1, opts_.quorum_retries + 1);
+  int64_t total = std::max<int64_t>(1, deadline_ms - now_ms());
+  int64_t slice = std::max<int64_t>(100, total / attempts);
+  std::string host;
+  int port = 0;
+  if (!split_host_port(opts_.lighthouse_addr, &host, &port)) return std::nullopt;
+
+  for (int64_t a = 0; a < attempts && running_; a++) {
+    int64_t attempt_deadline = std::min(deadline_ms, now_ms() + slice);
+    int fd = tcp_connect_retry(host, port,
+                               std::min<int64_t>(slice, opts_.connect_timeout_ms));
+    if (fd >= 0) {
+      Json req = Json::object();
+      req["type"] = Json::of("quorum");
+      req["timeout_ms"] = Json::of(attempt_deadline - now_ms());
+      req["requester"] = me.to_json();
+      Json resp;
+      bool ok = call_json(fd, req, &resp, attempt_deadline - now_ms());
+      close(fd);
+      if (ok && resp.get("ok").as_bool()) {
+        return Quorum::from_json(resp.get("quorum"));
+      }
+    }
+    if (now_ms() >= deadline_ms) break;
+    if (a + 1 < attempts) sleep_ms(std::min<int64_t>(100, deadline_ms - now_ms()));
+  }
+  return std::nullopt;
+}
+
+Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
+  int64_t rank = req.get("group_rank").as_int();
+  bool init_sync = req.get("init_sync").as_bool(true);
+  Json resp = Json::object();
+  if (rank < 0 || rank >= opts_.world_size) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of("group_rank " + std::to_string(rank) +
+                             " out of range [0, " +
+                             std::to_string(opts_.world_size) + ")");
+    return resp;
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  RankInfo info;
+  info.step = req.get("step").as_int();
+  info.shrink_only = req.get("shrink_only").as_bool();
+  info.commit_failures = req.get("commit_failures").as_int();
+  participants_[rank] = info;
+  checkpoint_metadata_[rank] = req.get("checkpoint_metadata").as_str();
+  int64_t my_round = quorum_round_;
+
+  if (static_cast<int64_t>(participants_.size()) >= opts_.world_size &&
+      !quorum_inflight_) {
+    // Last local rank in: this thread performs the lighthouse round
+    // (manager.rs:332-402).
+    quorum_inflight_ = true;
+    QuorumMember me;
+    me.replica_id = opts_.replica_id;
+    me.address = address();
+    me.store_address = opts_.store_address;
+    me.world_size = opts_.world_size;
+    for (const auto& kv : participants_) {
+      me.step = std::max(me.step, kv.second.step);
+      me.shrink_only = me.shrink_only || kv.second.shrink_only;
+      me.commit_failures = std::max(me.commit_failures, kv.second.commit_failures);
+    }
+    lk.unlock();
+    auto q = lighthouse_quorum(me, deadline_ms);
+    lk.lock();
+    if (q) {
+      current_quorum_ = q;
+      quorum_error_.clear();
+    } else {
+      current_quorum_.reset();
+      quorum_error_ = "lighthouse quorum failed (timeout or unreachable)";
+    }
+    quorum_round_ += 1;
+    participants_.clear();
+    quorum_inflight_ = false;
+    lk.unlock();
+    cv_.notify_all();
+    lk.lock();
+  } else {
+    while (running_ && quorum_round_ == my_round) {
+      if (cv_.wait_until(lk, std::chrono::system_clock::time_point(
+                                 std::chrono::milliseconds(deadline_ms))) ==
+              std::cv_status::timeout &&
+          now_ms() >= deadline_ms) {
+        participants_.erase(rank);
+        resp["ok"] = Json::of(false);
+        resp["error"] = Json::of("timed out waiting for local ranks / quorum");
+        resp["timeout"] = Json::of(true);
+        return resp;
+      }
+    }
+  }
+
+  if (!current_quorum_) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of(
+        quorum_error_.empty() ? "no quorum delivered" : quorum_error_);
+    return resp;
+  }
+  std::string err;
+  auto result = compute_quorum_results(rank, opts_.replica_id, *current_quorum_,
+                                       init_sync, &err);
+  if (!result) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of(err);
+    return resp;
+  }
+  resp["ok"] = Json::of(true);
+  resp["result"] = result->to_json();
+  resp["quorum"] = current_quorum_->to_json();
+  return resp;
+}
+
+Json ManagerServer::should_commit_rpc(const Json& req, int64_t deadline_ms) {
+  int64_t rank = req.get("group_rank").as_int();
+  bool vote = req.get("should_commit").as_bool();
+  Json resp = Json::object();
+  if (rank < 0 || rank >= opts_.world_size) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of("group_rank " + std::to_string(rank) +
+                             " out of range [0, " +
+                             std::to_string(opts_.world_size) + ")");
+    return resp;
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  commit_votes_[rank] = vote;
+  int64_t my_round = commit_round_;
+  if (static_cast<int64_t>(commit_votes_.size()) >= opts_.world_size) {
+    // Barrier complete: commit iff no rank voted false (manager.rs:423-479).
+    bool all = true;
+    for (const auto& kv : commit_votes_) all = all && kv.second;
+    commit_result_ = all;
+    commit_votes_.clear();
+    commit_round_ += 1;
+    lk.unlock();
+    cv_.notify_all();
+    lk.lock();
+  } else {
+    while (running_ && commit_round_ == my_round) {
+      if (cv_.wait_until(lk, std::chrono::system_clock::time_point(
+                                 std::chrono::milliseconds(deadline_ms))) ==
+              std::cv_status::timeout &&
+          now_ms() >= deadline_ms) {
+        commit_votes_.erase(rank);
+        resp["ok"] = Json::of(false);
+        resp["error"] = Json::of("timed out waiting for should_commit barrier");
+        resp["timeout"] = Json::of(true);
+        return resp;
+      }
+    }
+  }
+  resp["ok"] = Json::of(true);
+  resp["should_commit"] = Json::of(commit_result_);
+  return resp;
+}
+
+}  // namespace tft

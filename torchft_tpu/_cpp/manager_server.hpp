@@ -1,0 +1,105 @@
+// ManagerServer: per-replica-group coordinator for torchft-tpu.
+//
+// Capability parity with the reference's src/manager.rs:68-487: local ranks
+// of one replica group check in via a Quorum request; when the last of
+// `world_size` ranks arrives the server forwards a single QuorumMember to the
+// Lighthouse (with retry/reconnect, manager.rs:250-306), broadcasts the
+// delivered quorum to all waiting ranks, and each rank's reply carries its
+// recovery plan from compute_quorum_results. Also: a ShouldCommit barrier
+// (commit iff zero ranks voted false, manager.rs:423-479), CheckpointMetadata
+// lookup for recovering peers (manager.rs:404-421), a Kill request that exits
+// the process (manager.rs:481-486), and a heartbeat loop pinging the
+// Lighthouse (manager.rs:194-216).
+//
+// Requests (length-prefixed JSON frames):
+//   {"type":"quorum","group_rank":r,"step":s,"checkpoint_metadata":m,
+//    "shrink_only":b,"init_sync":b,"commit_failures":n,"timeout_ms":N}
+//   {"type":"should_commit","group_rank":r,"step":s,"should_commit":b,
+//    "timeout_ms":N}
+//   {"type":"checkpoint_metadata","rank":r}
+//   {"type":"kill","msg":...}
+//   {"type":"info"}
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.hpp"
+#include "quorum.hpp"
+
+namespace tft {
+
+struct ManagerOpts {
+  std::string replica_id;
+  std::string lighthouse_addr;     // host:port
+  std::string advertise_host;      // host other processes can reach us at
+  int port = 0;                    // 0 = ephemeral
+  std::string bind_host;           // default 0.0.0.0
+  std::string store_address;       // rendezvous store this group advertises
+  int64_t world_size = 1;          // local ranks in this replica group
+  int64_t heartbeat_interval_ms = 100;
+  int64_t connect_timeout_ms = 10000;
+  int64_t quorum_retries = 0;
+};
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(ManagerOpts opts);
+  ~ManagerServer();
+
+  bool start();
+  void stop();
+
+  int port() const { return port_; }
+  std::string address() const {
+    return opts_.advertise_host + ":" + std::to_string(port_);
+  }
+
+ private:
+  void accept_loop();
+  void heartbeat_loop();
+  void handle_conn(int fd);
+  Json handle_request(const Json& req, int64_t deadline_ms);
+  Json quorum_rpc(const Json& req, int64_t deadline_ms);
+  Json should_commit_rpc(const Json& req, int64_t deadline_ms);
+  // Calls the lighthouse Quorum RPC with retries; returns nullopt on failure.
+  std::optional<Quorum> lighthouse_quorum(const QuorumMember& me,
+                                          int64_t deadline_ms);
+
+  ManagerOpts opts_;
+  int port_ = 0;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::thread heartbeat_thread_;
+  ConnTracker conns_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+
+  // Quorum round state (reset after each broadcast).
+  struct RankInfo {
+    int64_t step = 0;
+    bool shrink_only = false;
+    int64_t commit_failures = 0;
+  };
+  std::map<int64_t, RankInfo> participants_;
+  std::map<int64_t, std::string> checkpoint_metadata_;  // persists across rounds
+  std::optional<Quorum> current_quorum_;
+  int64_t quorum_round_ = 0;
+  bool quorum_inflight_ = false;
+  std::string quorum_error_;
+
+  // should_commit round state.
+  std::map<int64_t, bool> commit_votes_;
+  bool commit_result_ = false;
+  int64_t commit_round_ = 0;
+};
+
+}  // namespace tft

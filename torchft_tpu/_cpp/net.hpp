@@ -1,0 +1,66 @@
+// TCP helpers for the torchft-tpu control plane: listen/connect with timeouts,
+// length-prefixed JSON frames, and exponential-backoff connect retry.
+//
+// Capability parity with the reference's src/net.rs:10-36 (keep-alive connect
+// with exponential backoff 100ms -> 10s x1.5) and src/retry.rs, minus gRPC:
+// the wire format here is [u32 big-endian length][JSON payload].
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "json.hpp"
+
+namespace tft {
+
+// Returns ms since epoch (steady for intervals where it matters we use the
+// same clock consistently).
+int64_t now_ms();
+
+// Sleep helper.
+void sleep_ms(int64_t ms);
+
+// Creates a listening socket bound to `host` (empty or "0.0.0.0" = any) and
+// `port` (0 = ephemeral). Returns fd >= 0 or -1 on error (errno set).
+int tcp_listen(const std::string& host, int port, int backlog = 128);
+
+// Port a listening fd is bound to, or -1.
+int bound_port(int fd);
+
+// Accept with timeout. Returns client fd, -1 on timeout/error.
+int tcp_accept(int listen_fd, int timeout_ms);
+
+// Connect to host:port with a timeout. Returns fd or -1.
+int tcp_connect(const std::string& host, int port, int64_t timeout_ms);
+
+// Connect with exponential backoff retries until deadline, mirroring the
+// reference's net.rs connect(): 100ms initial, x1.5, max 10s interval.
+int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms);
+
+// Splits "host:port" (also accepts "[v6]:port"). Returns false on parse error.
+bool split_host_port(const std::string& addr, std::string* host, int* port);
+
+// Sends a length-prefixed frame. Returns false on error/timeout.
+bool send_frame(int fd, const std::string& payload, int64_t timeout_ms);
+
+// Receives a length-prefixed frame into *out. Returns false on error/timeout.
+bool recv_frame(int fd, std::string* out, int64_t timeout_ms);
+
+// Convenience: send `req` JSON, receive one JSON reply. False on any failure.
+bool call_json(int fd, const Json& req, Json* resp, int64_t timeout_ms);
+
+// One-shot: connect, call, close. False on any failure.
+bool call_json_addr(const std::string& addr, const Json& req, Json* resp,
+                    int64_t timeout_ms);
+
+// Peeks at up to n bytes without consuming (for HTTP-vs-frame sniffing).
+// Returns number of bytes peeked, or -1.
+int peek_bytes(int fd, char* buf, int n, int timeout_ms);
+
+// Reads until the socket closes or `max` bytes (for HTTP requests).
+std::string read_http_request(int fd, int timeout_ms);
+
+// Writes all bytes. Returns false on error.
+bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms);
+
+}  // namespace tft

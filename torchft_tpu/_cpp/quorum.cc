@@ -1,0 +1,258 @@
+#include "quorum.hpp"
+
+#include <algorithm>
+#include <set>
+
+namespace tft {
+
+Json QuorumMember::to_json() const {
+  Json j = Json::object();
+  j["replica_id"] = Json::of(replica_id);
+  j["address"] = Json::of(address);
+  j["store_address"] = Json::of(store_address);
+  j["step"] = Json::of(step);
+  j["world_size"] = Json::of(world_size);
+  j["shrink_only"] = Json::of(shrink_only);
+  j["commit_failures"] = Json::of(commit_failures);
+  j["data"] = data;
+  return j;
+}
+
+QuorumMember QuorumMember::from_json(const Json& j) {
+  QuorumMember m;
+  m.replica_id = j.get("replica_id").as_str();
+  m.address = j.get("address").as_str();
+  m.store_address = j.get("store_address").as_str();
+  m.step = j.get("step").as_int();
+  m.world_size = j.get("world_size").as_int(1);
+  m.shrink_only = j.get("shrink_only").as_bool();
+  m.commit_failures = j.get("commit_failures").as_int();
+  m.data = j.get("data");
+  return m;
+}
+
+Json Quorum::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = Json::of(quorum_id);
+  j["created_ms"] = Json::of(created_ms);
+  Json parts = Json::array();
+  for (const auto& p : participants) parts.push(p.to_json());
+  j["participants"] = parts;
+  return j;
+}
+
+Quorum Quorum::from_json(const Json& j) {
+  Quorum q;
+  q.quorum_id = j.get("quorum_id").as_int();
+  q.created_ms = j.get("created_ms").as_int();
+  for (const auto& p : j.get("participants").arr)
+    q.participants.push_back(QuorumMember::from_json(p));
+  return q;
+}
+
+std::optional<std::vector<QuorumMember>> quorum_compute(
+    int64_t now, const LighthouseState& state, const LighthouseOpts& opt,
+    std::string* reason) {
+  // shrink_only: if any participant requests it and we have a previous quorum,
+  // candidates are restricted to previous members (lighthouse.rs:172-200).
+  bool shrink_only = false;
+  for (const auto& kv : state.participants) {
+    if (kv.second.first.shrink_only) shrink_only = true;
+  }
+  std::set<std::string> prev_ids;
+  if (state.prev_quorum) {
+    for (const auto& m : state.prev_quorum->participants)
+      prev_ids.insert(m.replica_id);
+  }
+  bool restrict_to_prev = shrink_only && state.prev_quorum.has_value();
+
+  // (1) healthy = replicas whose heartbeat is fresh (lighthouse.rs:147-156).
+  // Under shrink_only, newcomers' heartbeats are ignored entirely — they
+  // neither join nor count toward the majority guard.
+  std::set<std::string> healthy;
+  for (const auto& kv : state.heartbeats) {
+    if (restrict_to_prev && !prev_ids.count(kv.first)) continue;
+    if (now - kv.second < opt.heartbeat_timeout_ms) healthy.insert(kv.first);
+  }
+
+  // met = healthy participants (restricted to prev members if shrinking).
+  std::vector<QuorumMember> met;
+  int64_t first_joined = -1;
+  for (const auto& kv : state.participants) {
+    const QuorumMember& m = kv.second.first;
+    int64_t joined_at = kv.second.second;
+    if (first_joined < 0 || joined_at < first_joined) first_joined = joined_at;
+    if (!healthy.count(m.replica_id)) continue;
+    if (shrink_only && state.prev_quorum && !prev_ids.count(m.replica_id))
+      continue;
+    met.push_back(m);
+  }
+
+  // (2) fast quorum: every member of the previous quorum is a healthy
+  // participant again — no need to wait for the join window
+  // (lighthouse.rs:202-214).
+  bool fast = false;
+  if (state.prev_quorum && !prev_ids.empty()) {
+    std::set<std::string> met_ids;
+    for (const auto& m : met) met_ids.insert(m.replica_id);
+    fast = std::all_of(prev_ids.begin(), prev_ids.end(),
+                       [&](const std::string& id) { return met_ids.count(id); });
+  }
+
+  if (!fast) {
+    // (3) min_replicas floor (lighthouse.rs:218-228).
+    if (static_cast<int64_t>(met.size()) < opt.min_replicas) {
+      if (reason)
+        *reason = "need at least " + std::to_string(opt.min_replicas) +
+                  " participants, have " + std::to_string(met.size());
+      return std::nullopt;
+    }
+    // (4) split-brain guard: participants must exceed half of all heartbeating
+    // replicas (lighthouse.rs:231-241).
+    if (met.size() * 2 <= healthy.size()) {
+      if (reason)
+        *reason = "split-brain guard: " + std::to_string(met.size()) +
+                  " participants <= half of " + std::to_string(healthy.size()) +
+                  " healthy replicas";
+      return std::nullopt;
+    }
+    // (5) give healthy stragglers up to join_timeout_ms (measured from the
+    // first joiner of this round) to participate (lighthouse.rs:243-263).
+    bool all_healthy_joined = true;
+    for (const auto& id : healthy) {
+      if (shrink_only && state.prev_quorum && !prev_ids.count(id)) continue;
+      if (!state.participants.count(id)) all_healthy_joined = false;
+    }
+    if (!all_healthy_joined && first_joined >= 0 &&
+        now - first_joined < opt.join_timeout_ms) {
+      if (reason)
+        *reason = "waiting up to join_timeout for healthy stragglers";
+      return std::nullopt;
+    }
+  }
+
+  if (met.empty()) {
+    if (reason) *reason = "no healthy participants";
+    return std::nullopt;
+  }
+
+  std::sort(met.begin(), met.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+  return met;
+}
+
+bool quorum_changed(const std::vector<QuorumMember>& a,
+                    const std::vector<QuorumMember>& b) {
+  std::vector<std::string> ia, ib;
+  for (const auto& m : a) ia.push_back(m.replica_id);
+  for (const auto& m : b) ib.push_back(m.replica_id);
+  std::sort(ia.begin(), ia.end());
+  std::sort(ib.begin(), ib.end());
+  return ia != ib;
+}
+
+Json ManagerQuorumResult::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = Json::of(quorum_id);
+  j["recover_src_manager_address"] = Json::of(recover_src_manager_address);
+  j["recover_src_replica_rank"] = recover_src_replica_rank
+                                      ? Json::of(*recover_src_replica_rank)
+                                      : Json::null();
+  Json dsts = Json::array();
+  for (int64_t r : recover_dst_replica_ranks) dsts.push(Json::of(r));
+  j["recover_dst_replica_ranks"] = dsts;
+  j["store_address"] = Json::of(store_address);
+  j["max_step"] = Json::of(max_step);
+  j["max_replica_rank"] =
+      max_replica_rank ? Json::of(*max_replica_rank) : Json::null();
+  j["max_world_size"] = Json::of(max_world_size);
+  j["replica_rank"] = Json::of(replica_rank);
+  j["replica_world_size"] = Json::of(replica_world_size);
+  j["heal"] = Json::of(heal);
+  j["commit_failures"] = Json::of(commit_failures);
+  return j;
+}
+
+std::optional<ManagerQuorumResult> compute_quorum_results(
+    int64_t group_rank, const std::string& my_replica_id, const Quorum& quorum,
+    bool init_sync, std::string* error) {
+  // Sort by replica_id -> replica_rank (manager.rs:495-496).
+  std::vector<QuorumMember> parts = quorum.participants;
+  std::sort(parts.begin(), parts.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  if (group_rank < 0) {
+    if (error) *error = "group_rank must be non-negative";
+    return std::nullopt;
+  }
+  int64_t my_rank = -1;
+  for (size_t k = 0; k < parts.size(); k++) {
+    if (parts[k].replica_id == my_replica_id) my_rank = static_cast<int64_t>(k);
+  }
+  if (my_rank < 0) {
+    if (error)
+      *error = "replica " + my_replica_id + " not in quorum " +
+               std::to_string(quorum.quorum_id);
+    return std::nullopt;
+  }
+
+  // Max step and the set of members at it (manager.rs:519-528).
+  int64_t max_step = 0;
+  for (const auto& p : parts) max_step = std::max(max_step, p.step);
+  std::vector<int64_t> max_idx;  // replica ranks at max_step
+  for (size_t k = 0; k < parts.size(); k++) {
+    if (parts[k].step == max_step) max_idx.push_back(static_cast<int64_t>(k));
+  }
+
+  // Store primary spread across local ranks (manager.rs:532-533).
+  int64_t primary_idx = max_idx[group_rank % static_cast<int64_t>(max_idx.size())];
+  const QuorumMember& primary = parts[primary_idx];
+
+  // Everyone recovers from the primary at step 0 when init_sync is requested
+  // (manager.rs:537) so all replicas start from identical weights.
+  bool force_recover = init_sync && max_step == 0;
+
+  // Recovering set (manager.rs:542-552).
+  std::vector<int64_t> recovering;  // replica ranks
+  std::vector<int64_t> up_to_date;
+  for (size_t k = 0; k < parts.size(); k++) {
+    bool rec = parts[k].step != max_step ||
+               (force_recover && parts[k].replica_id != primary.replica_id);
+    if (rec)
+      recovering.push_back(static_cast<int64_t>(k));
+    else
+      up_to_date.push_back(static_cast<int64_t>(k));
+  }
+
+  ManagerQuorumResult res;
+  res.quorum_id = quorum.quorum_id;
+  res.store_address = primary.store_address;
+  res.max_step = max_step;
+  res.max_replica_rank = primary_idx;
+  res.max_world_size = static_cast<int64_t>(max_idx.size());
+  res.replica_rank = my_rank;
+  res.replica_world_size = static_cast<int64_t>(parts.size());
+  for (const auto& p : parts)
+    res.commit_failures = std::max(res.commit_failures, p.commit_failures);
+
+  // Round-robin recovery-source assignment, offset by group_rank so different
+  // local ranks of the same recovering group pull from different sources
+  // (manager.rs:569-585).
+  for (size_t k = 0; k < recovering.size(); k++) {
+    int64_t src = up_to_date[(static_cast<int64_t>(k) + group_rank) %
+                             static_cast<int64_t>(up_to_date.size())];
+    if (recovering[k] == my_rank) {
+      res.heal = true;
+      res.recover_src_replica_rank = src;
+      res.recover_src_manager_address = parts[src].address;
+    }
+    if (src == my_rank) res.recover_dst_replica_ranks.push_back(recovering[k]);
+  }
+  return res;
+}
+
+}  // namespace tft

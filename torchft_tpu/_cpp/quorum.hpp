@@ -1,0 +1,100 @@
+// Pure quorum logic for the torchft-tpu control plane.
+//
+// Capability parity with the reference (tushar00jain/torchft):
+//  - quorum_compute: src/lighthouse.rs:141-269 (heartbeat filter, fast quorum,
+//    min_replicas floor, split-brain majority guard, join-timeout straggler
+//    wait, shrink_only restriction).
+//  - quorum_changed: src/lighthouse.rs:133-138 (sorted replica_ids compare).
+//  - compute_quorum_results: src/manager.rs:489-624 (replica ranks, max-step
+//    set, store primary selection, force_recover on init_sync, round-robin
+//    recovery-source assignment offset by group rank).
+// Pure functions; unit-tested in cpp_tests.cc.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+namespace tft {
+
+struct QuorumMember {
+  std::string replica_id;
+  std::string address;        // manager control-plane address host:port
+  std::string store_address;  // rendezvous store address host:port
+  int64_t step = 0;
+  int64_t world_size = 1;
+  bool shrink_only = false;
+  int64_t commit_failures = 0;
+  Json data;  // opaque user payload (reference: QuorumMember.data JSON)
+
+  Json to_json() const;
+  static QuorumMember from_json(const Json& j);
+};
+
+struct Quorum {
+  int64_t quorum_id = 0;
+  std::vector<QuorumMember> participants;
+  int64_t created_ms = 0;
+
+  Json to_json() const;
+  static Quorum from_json(const Json& j);
+};
+
+struct LighthouseOpts {
+  int64_t min_replicas = 1;
+  int64_t join_timeout_ms = 60000;
+  int64_t quorum_tick_ms = 100;
+  int64_t heartbeat_timeout_ms = 5000;
+};
+
+// Mutable lighthouse state operated on by the tick loop.
+struct LighthouseState {
+  // replica_id -> (member info, joined_at ms)
+  std::map<std::string, std::pair<QuorumMember, int64_t>> participants;
+  // replica_id -> last heartbeat ms
+  std::map<std::string, int64_t> heartbeats;
+  std::optional<Quorum> prev_quorum;
+  int64_t quorum_id = 0;
+};
+
+// Returns the members of a newly formed quorum, or nullopt (with a
+// human-readable reason in *reason) if no quorum can form yet.
+std::optional<std::vector<QuorumMember>> quorum_compute(
+    int64_t now, const LighthouseState& state, const LighthouseOpts& opt,
+    std::string* reason);
+
+// True iff membership differs (compares sorted replica_ids only, like the
+// reference — step/address changes alone don't bump the quorum id).
+bool quorum_changed(const std::vector<QuorumMember>& a,
+                    const std::vector<QuorumMember>& b);
+
+// Per-rank recovery plan computed from a delivered quorum.
+struct ManagerQuorumResult {
+  int64_t quorum_id = 0;
+  std::string recover_src_manager_address;  // empty if not healing
+  std::optional<int64_t> recover_src_replica_rank;
+  std::vector<int64_t> recover_dst_replica_ranks;
+  std::string store_address;
+  int64_t max_step = 0;
+  std::optional<int64_t> max_replica_rank;
+  int64_t max_world_size = 0;
+  int64_t replica_rank = 0;
+  int64_t replica_world_size = 0;
+  bool heal = false;
+  int64_t commit_failures = 0;
+
+  Json to_json() const;
+};
+
+// group_rank: the caller's local rank inside its replica group (used to spread
+// store-primary choice and recovery sources across local ranks).
+// Returns nullopt if my_replica_id is not in the quorum.
+std::optional<ManagerQuorumResult> compute_quorum_results(
+    int64_t group_rank, const std::string& my_replica_id, const Quorum& quorum,
+    bool init_sync, std::string* error);
+
+}  // namespace tft

@@ -1,0 +1,104 @@
+"""Socket helpers shared by the Python control-plane clients, the TCP store,
+and the socket-based process groups.
+
+Wire format matches the C++ side (torchft_tpu/_cpp/net.cc): frames are a
+4-byte big-endian length followed by the payload (JSON for control messages,
+raw bytes for tensor payloads).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+from typing import Any, Optional
+
+MAX_FRAME = 1 << 30  # 1 GiB sanity cap, matches net.cc
+
+
+class FrameError(RuntimeError):
+    pass
+
+
+def set_keepalive(sock: socket.socket) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    """Splits ``host:port`` (also ``[v6]:port``)."""
+    if addr.startswith("["):
+        host, _, port = addr[1:].partition("]:")
+    else:
+        host, _, port = addr.rpartition(":")
+    if host in ("", "::", "0.0.0.0"):
+        host = "127.0.0.1"
+    return host, int(port)
+
+
+def connect(addr: str, timeout: float) -> socket.socket:
+    """Connects with exponential backoff retries until ``timeout`` seconds,
+    mirroring the reference's net.rs connect() (100ms -> 10s, x1.5)."""
+    host, port = parse_addr(addr)
+    deadline = time.monotonic() + timeout
+    backoff = 0.1
+    last_err: Optional[Exception] = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"could not connect to {addr} within {timeout}s: {last_err}"
+            )
+        try:
+            sock = socket.create_connection((host, port), timeout=min(remaining, 5.0))
+            set_keepalive(sock)
+            return sock
+        except OSError as e:  # noqa: PERF203
+            last_err = e
+            time.sleep(min(backoff, max(deadline - time.monotonic(), 0)))
+            backoff = min(backoff * 1.5, 10.0)
+
+
+def send_frame(sock: socket.socket, payload: bytes, timeout: Optional[float] = None) -> None:
+    if timeout is not None:
+        sock.settimeout(timeout)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int, deadline: Optional[float]) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("timed out receiving frame")
+            sock.settimeout(remaining)
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise FrameError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> bytes:
+    deadline = None if timeout is None else time.monotonic() + timeout
+    header = _recv_exact(sock, 4, deadline)
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise FrameError(f"frame too large: {length}")
+    return _recv_exact(sock, length, deadline)
+
+
+def send_json(sock: socket.socket, obj: Any, timeout: Optional[float] = None) -> None:
+    send_frame(sock, json.dumps(obj).encode("utf-8"), timeout)
+
+
+def recv_json(sock: socket.socket, timeout: Optional[float] = None) -> Any:
+    return json.loads(recv_frame(sock, timeout).decode("utf-8"))
+
+
+def call_json(sock: socket.socket, obj: Any, timeout: float) -> Any:
+    deadline = time.monotonic() + timeout
+    send_json(sock, obj, timeout)
+    return recv_json(sock, max(deadline - time.monotonic(), 0.001))

@@ -1,0 +1,514 @@
+"""Python interface to the C++ control plane (Lighthouse + Manager servers).
+
+Capability parity with the reference's ``torchft.coordination`` /
+``torchft._torchft`` pyo3 module (src/lib.rs:80-758 in tushar00jain/torchft):
+``LighthouseServer``/``LighthouseClient``, ``ManagerServer``/``ManagerClient``,
+``QuorumMember``/``Quorum``/``QuorumResult``. The servers here are the C++
+binaries under ``torchft_tpu/_cpp`` spawned as subprocesses (the reference
+embeds a tokio runtime in-process; a subprocess isolates the control plane
+from a wedged trainer and from the Python GIL). Clients speak length-prefixed
+JSON frames over TCP with per-request deadlines; timeouts surface as
+``TimeoutError``, other failures as ``RuntimeError`` (matching the pyo3 error
+mapping in lib.rs:670-682).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import socket
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu import _net
+
+_CPP_DIR = Path(__file__).resolve().parent / "_cpp"
+_BIN_DIR = _CPP_DIR / "bin"
+_BUILD_LOCK = threading.Lock()
+
+
+def _ensure_built() -> None:
+    """Builds the C++ control plane on first use (idempotent; safe across
+    concurrent processes via a file lock on the build directory)."""
+    binaries = [_BIN_DIR / "lighthouse", _BIN_DIR / "torchft_manager"]
+    if all(b.exists() for b in binaries):
+        return
+    import fcntl
+
+    with _BUILD_LOCK:
+        lock_path = _CPP_DIR / ".build.lock"
+        with open(lock_path, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            try:
+                if all(b.exists() for b in binaries):
+                    return
+                proc = subprocess.run(
+                    ["make", "-j4", "all"],
+                    cwd=_CPP_DIR,
+                    capture_output=True,
+                    text=True,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        "failed to build torchft_tpu C++ control plane:\n"
+                        f"{proc.stderr}"
+                    )
+            finally:
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+def advertise_host() -> str:
+    """Host other processes should use to reach servers on this machine."""
+    host = os.environ.get("TORCHFT_HOST_ADDR")
+    if host:
+        return host
+    return "127.0.0.1"
+
+
+@dataclass
+class QuorumMember:
+    replica_id: str
+    address: str = ""
+    store_address: str = ""
+    step: int = 0
+    world_size: int = 1
+    shrink_only: bool = False
+    commit_failures: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "address": self.address,
+            "store_address": self.store_address,
+            "step": self.step,
+            "world_size": self.world_size,
+            "shrink_only": self.shrink_only,
+            "commit_failures": self.commit_failures,
+            "data": self.data or {},
+        }
+
+    @staticmethod
+    def from_json(j: Dict[str, Any]) -> "QuorumMember":
+        return QuorumMember(
+            replica_id=j.get("replica_id", ""),
+            address=j.get("address", ""),
+            store_address=j.get("store_address", ""),
+            step=j.get("step", 0),
+            world_size=j.get("world_size", 1),
+            shrink_only=j.get("shrink_only", False),
+            commit_failures=j.get("commit_failures", 0),
+            data=j.get("data") or {},
+        )
+
+
+@dataclass
+class Quorum:
+    quorum_id: int
+    participants: List[QuorumMember]
+    created_ms: int = 0
+
+    @staticmethod
+    def from_json(j: Dict[str, Any]) -> "Quorum":
+        return Quorum(
+            quorum_id=j.get("quorum_id", 0),
+            participants=[
+                QuorumMember.from_json(p) for p in j.get("participants", [])
+            ],
+            created_ms=j.get("created_ms", 0),
+        )
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank recovery plan (reference: ManagerQuorumResponse /
+    lib.rs QuorumResult, manager.rs:603-623)."""
+
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_replica_rank: Optional[int]
+    recover_dst_replica_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_replica_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+    commit_failures: int
+    quorum: Optional[Quorum] = None
+
+    @staticmethod
+    def from_json(j: Dict[str, Any], quorum: Optional[Quorum] = None) -> "QuorumResult":
+        return QuorumResult(
+            quorum_id=j["quorum_id"],
+            replica_rank=j["replica_rank"],
+            replica_world_size=j["replica_world_size"],
+            recover_src_manager_address=j.get("recover_src_manager_address", ""),
+            recover_src_replica_rank=j.get("recover_src_replica_rank"),
+            recover_dst_replica_ranks=list(j.get("recover_dst_replica_ranks", [])),
+            store_address=j.get("store_address", ""),
+            max_step=j["max_step"],
+            max_replica_rank=j.get("max_replica_rank"),
+            max_world_size=j["max_world_size"],
+            heal=j.get("heal", False),
+            commit_failures=j.get("commit_failures", 0),
+            quorum=quorum,
+        )
+
+
+class _FramedClient:
+    """Persistent framed-JSON connection with reconnect-on-error."""
+
+    def __init__(self, addr: str, connect_timeout: float) -> None:
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def call(self, req: Dict[str, Any], timeout: float) -> Dict[str, Any]:
+        """Sends one request; raises TimeoutError on deadline expiry and
+        RuntimeError on server-reported errors or transport failure."""
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = _net.connect(self._addr, self._connect_timeout)
+                try:
+                    resp = _net.call_json(self._sock, req, timeout)
+                    break
+                except (TimeoutError, socket.timeout) as e:
+                    self.close_unlocked()
+                    raise TimeoutError(
+                        f"request {req.get('type')} to {self._addr} timed out"
+                    ) from e
+                except OSError as e:
+                    self.close_unlocked()
+                    if attempt == 1:
+                        raise RuntimeError(
+                            f"request {req.get('type')} to {self._addr} failed: {e}"
+                        ) from e
+            else:  # pragma: no cover
+                raise RuntimeError("unreachable")
+        if not resp.get("ok", False):
+            if resp.get("timeout"):
+                raise TimeoutError(resp.get("error", "timed out"))
+            raise RuntimeError(
+                f"{req.get('type')} to {self._addr} failed: {resp.get('error')}"
+            )
+        return resp
+
+    def close_unlocked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class _ServerProcess:
+    """A spawned control-plane binary that prints ``LISTENING <port>``."""
+
+    def __init__(self, argv: List[str], name: str) -> None:
+        _ensure_built()
+        self._name = name
+        self._proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=None,  # inherit: server logs go to our stderr
+            text=True,
+        )
+        self.port = self._read_port()
+        atexit.register(self.shutdown)
+
+    def _read_port(self, timeout: float = 10.0) -> int:
+        assert self._proc.stdout is not None
+        import select
+
+        deadline = time.monotonic() + timeout
+        buf = ""
+        fd = self._proc.stdout.fileno()
+        while time.monotonic() < deadline:
+            # Poll the pipe so a silent-but-alive child can't block the
+            # constructor past the deadline.
+            ready, _, _ = select.select([fd], [], [], 0.2)
+            if ready:
+                chunk = os.read(fd, 4096).decode(errors="replace")
+                if not chunk and self._proc.poll() is not None:
+                    break
+                buf += chunk
+                for line in buf.splitlines():
+                    if line.startswith("LISTENING "):
+                        return int(line.split()[1])
+            elif self._proc.poll() is not None:
+                break
+        raise RuntimeError(
+            f"{self._name} failed to start (rc={self._proc.poll()}, "
+            f"output={buf!r})"
+        )
+
+    def is_alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def shutdown(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=5)
+
+
+class LighthouseServer:
+    """Spawns the C++ lighthouse (reference: LighthouseServer, lib.rs:606-668).
+
+    Args mirror the reference CLI flags (lighthouse.rs:94-131); timeouts in
+    milliseconds.
+    """
+
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 60000,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+    ) -> None:
+        host, port = _split_bind(bind)
+        self._server = _ServerProcess(
+            [
+                str(_BIN_DIR / "lighthouse"),
+                "--bind-host",
+                host,
+                "--port",
+                str(port),
+                "--min-replicas",
+                str(min_replicas),
+                "--join-timeout-ms",
+                str(join_timeout_ms),
+                "--quorum-tick-ms",
+                str(quorum_tick_ms),
+                "--heartbeat-timeout-ms",
+                str(heartbeat_timeout_ms),
+            ],
+            "lighthouse",
+        )
+
+    def address(self) -> str:
+        return f"{advertise_host()}:{self._server.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+class LighthouseClient:
+    """Client for the lighthouse (reference: LighthouseClient, lib.rs:483-591)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
+        self._client = _FramedClient(addr, connect_timeout)
+
+    def heartbeat(self, replica_id: str, timeout: float = 5.0) -> None:
+        self._client.call({"type": "heartbeat", "replica_id": replica_id,
+                           "timeout_ms": int(timeout * 1000)}, timeout)
+
+    def quorum(
+        self,
+        replica_id: str,
+        timeout: float = 60.0,
+        address: str = "",
+        store_address: str = "",
+        step: int = 0,
+        world_size: int = 1,
+        shrink_only: bool = False,
+        commit_failures: int = 0,
+        data: Optional[Dict[str, Any]] = None,
+    ) -> Quorum:
+        member = QuorumMember(
+            replica_id=replica_id,
+            address=address,
+            store_address=store_address,
+            step=step,
+            world_size=world_size,
+            shrink_only=shrink_only,
+            commit_failures=commit_failures,
+            data=data or {},
+        )
+        resp = self._client.call(
+            {
+                "type": "quorum",
+                "timeout_ms": int(timeout * 1000),
+                "requester": member.to_json(),
+            },
+            timeout + 5.0,
+        )
+        return Quorum.from_json(resp["quorum"])
+
+    def status(self, timeout: float = 5.0) -> Dict[str, Any]:
+        return self._client.call(
+            {"type": "status", "timeout_ms": int(timeout * 1000)}, timeout
+        )["status"]
+
+    def kill(self, replica_id: str, timeout: float = 5.0) -> None:
+        self._client.call(
+            {"type": "kill", "replica_id": replica_id,
+             "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class ManagerServer:
+    """Spawns the per-replica-group C++ manager server (reference:
+    ManagerServer, lib.rs:80-144 / src/manager.rs:118-174)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        store_address: str,
+        world_size: int,
+        bind: str = "0.0.0.0:0",
+        heartbeat_interval_ms: int = 100,
+        connect_timeout_ms: int = 10000,
+        quorum_retries: int = 0,
+    ) -> None:
+        host, port = _split_bind(bind)
+        self.replica_id = replica_id
+        self._server = _ServerProcess(
+            [
+                str(_BIN_DIR / "torchft_manager"),
+                "--replica-id",
+                replica_id,
+                "--lighthouse",
+                lighthouse_addr,
+                "--advertise-host",
+                advertise_host(),
+                "--bind-host",
+                host,
+                "--port",
+                str(port),
+                "--store-address",
+                store_address,
+                "--world-size",
+                str(world_size),
+                "--heartbeat-interval-ms",
+                str(heartbeat_interval_ms),
+                "--connect-timeout-ms",
+                str(connect_timeout_ms),
+                "--quorum-retries",
+                str(quorum_retries),
+            ],
+            f"manager[{replica_id}]",
+        )
+
+    def address(self) -> str:
+        return f"{advertise_host()}:{self._server.port}"
+
+    def is_alive(self) -> bool:
+        return self._server.is_alive()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+class ManagerClient:
+    """Client for a manager server (reference: ManagerClient, lib.rs:153-281)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
+        self._client = _FramedClient(addr, connect_timeout)
+
+    @property
+    def addr(self) -> str:
+        return self._client.addr
+
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: float,
+        init_sync: bool = True,
+        commit_failures: int = 0,
+    ) -> QuorumResult:
+        resp = self._client.call(
+            {
+                "type": "quorum",
+                "group_rank": group_rank,
+                "step": step,
+                "checkpoint_metadata": checkpoint_metadata,
+                "shrink_only": shrink_only,
+                "init_sync": init_sync,
+                "commit_failures": commit_failures,
+                "timeout_ms": int(timeout * 1000),
+            },
+            timeout + 5.0,
+        )
+        quorum = Quorum.from_json(resp["quorum"]) if "quorum" in resp else None
+        return QuorumResult.from_json(resp["result"], quorum)
+
+    def _checkpoint_metadata(self, rank: int, timeout: float = 10.0) -> str:
+        resp = self._client.call(
+            {"type": "checkpoint_metadata", "rank": rank,
+             "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+        return resp["checkpoint_metadata"]
+
+    def should_commit(
+        self, group_rank: int, step: int, should_commit: bool, timeout: float
+    ) -> bool:
+        resp = self._client.call(
+            {
+                "type": "should_commit",
+                "group_rank": group_rank,
+                "step": step,
+                "should_commit": should_commit,
+                "timeout_ms": int(timeout * 1000),
+            },
+            timeout + 5.0,
+        )
+        return resp["should_commit"]
+
+    def kill(self, msg: str = "") -> None:
+        try:
+            self._client.call({"type": "kill", "msg": msg, "timeout_ms": 2000}, 2.0)
+        except (RuntimeError, TimeoutError):
+            pass  # the victim exits without replying
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def _split_bind(bind: str) -> tuple[str, int]:
+    host, port = _net.parse_addr(bind) if ":" in bind else (bind, 0)
+    if host == "127.0.0.1" and bind.startswith(("0.0.0.0", "[::]", "::")):
+        host = "0.0.0.0"
+    return host, port
+
+
+def lighthouse_main() -> None:
+    """CLI entry point: ``torchft_tpu_lighthouse`` (reference:
+    torchft_lighthouse console script)."""
+    import sys
+
+    _ensure_built()
+    os.execv(str(_BIN_DIR / "lighthouse"), [str(_BIN_DIR / "lighthouse")] + sys.argv[1:])
