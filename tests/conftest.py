@@ -1,16 +1,21 @@
 """Test configuration: force an 8-device virtual CPU platform so multi-chip
 sharding paths (mesh, pjit, shard_map, collectives) run without TPU hardware.
 
-Must set the env vars before jax initializes its backends (hence before any
-test module imports jax).
+The container's sitecustomize pre-imports jax and pins the 'axon' TPU
+platform via jax.config, so setting JAX_PLATFORMS env here is too late —
+we must override through jax.config before any backend initializes
+(backends initialize lazily at first jax.devices()/computation).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

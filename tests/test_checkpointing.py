@@ -1,0 +1,195 @@
+"""Checkpoint transport tests (reference: http_transport_test.py,
+pg_transport_test.py, rwlock_test.py, transport_test.py's shared
+multi-peer recovery scenario)."""
+
+import threading
+import urllib.error
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing._serialization import (
+    dumps,
+    join_state,
+    loads,
+    split_state,
+)
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.process_group import ProcessGroupSocket
+from torchft_tpu.store import TCPStoreServer
+
+
+def sample_state():
+    return {
+        "model": {
+            "w1": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b1": np.zeros(4, dtype=np.float32),
+            "deep": [np.ones((2, 2), dtype=np.float64), {"x": np.int32(7)}],
+        },
+        "step": 5,
+        "name": "test",
+    }
+
+
+def assert_state_equal(a, b):
+    np.testing.assert_array_equal(a["model"]["w1"], b["model"]["w1"])
+    np.testing.assert_array_equal(a["model"]["b1"], b["model"]["b1"])
+    np.testing.assert_array_equal(a["model"]["deep"][0], b["model"]["deep"][0])
+    assert a["step"] == b["step"]
+    assert a["name"] == b["name"]
+
+
+def test_serialization_roundtrip():
+    state = sample_state()
+    restored = loads(dumps(state))
+    assert_state_equal(state, restored)
+
+
+def test_serialization_inplace():
+    state = sample_state()
+    target = sample_state()
+    target["model"]["w1"].fill(-1)
+    restored = loads(dumps(state), inplace_into=target)
+    # The preallocated leaf was reused and overwritten.
+    assert restored["model"]["w1"] is target["model"]["w1"]
+    np.testing.assert_array_equal(target["model"]["w1"], state["model"]["w1"])
+
+
+def test_serialization_jax_arrays():
+    import jax.numpy as jnp
+
+    state = {"p": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}
+    restored = loads(dumps(state))
+    np.testing.assert_array_equal(restored["p"], np.arange(6).reshape(2, 3))
+
+
+@pytest.mark.parametrize("num_chunks", [0, 3])
+def test_http_transport_roundtrip(num_chunks):
+    sender = HTTPTransport(num_chunks=num_chunks)
+    receiver = HTTPTransport()
+    try:
+        state = sample_state()
+        sender.send_checkpoint([1], step=5, state_dict=state, timeout=10)
+        got = receiver.recv_checkpoint(
+            src_rank=0, metadata=sender.metadata(), step=5, timeout=10
+        )
+        assert_state_equal(state, got)
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+def test_http_transport_wrong_step_and_disallow():
+    sender = HTTPTransport()
+    receiver = HTTPTransport()
+    try:
+        sender.send_checkpoint([1], step=5, state_dict=sample_state(), timeout=10)
+        with pytest.raises(urllib.error.HTTPError):
+            receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=99, timeout=10
+            )
+        sender.disallow_checkpoint()
+        with pytest.raises(urllib.error.HTTPError):
+            receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=5, timeout=10
+            )
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+def test_http_transport_multi_peer():
+    """One sender serves several recovering peers concurrently (reference:
+    transport_test.py run_multi_recovery_test)."""
+    sender = HTTPTransport(num_chunks=2)
+    receivers = [HTTPTransport() for _ in range(3)]
+    try:
+        state = sample_state()
+        sender.send_checkpoint([1, 2, 3], step=1, state_dict=state, timeout=10)
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            results = list(
+                pool.map(
+                    lambda r: r.recv_checkpoint(
+                        0, sender.metadata(), step=1, timeout=10
+                    ),
+                    receivers,
+                )
+            )
+        for got in results:
+            assert_state_equal(state, got)
+    finally:
+        sender.shutdown()
+        for r in receivers:
+            r.shutdown()
+
+
+def test_pg_transport_roundtrip():
+    store = TCPStoreServer()
+    pgs = [ProcessGroupSocket(timeout=10.0) for _ in range(2)]
+
+    def configure(rank):
+        pgs[rank].configure(f"{store.address()}/ckpt", rank, 2)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        list(pool.map(configure, range(2)))
+
+    state = sample_state()
+    prealloc = sample_state()
+    prealloc["model"]["w1"].fill(0)
+    sender = PGTransport(pgs[0], timeout=10.0)
+    receiver = PGTransport(pgs[1], timeout=10.0, state_dict_fn=lambda: prealloc)
+
+    def send():
+        sender.send_checkpoint([1], step=2, state_dict=state, timeout=10)
+
+    def recv():
+        return receiver.recv_checkpoint(0, "<n/a>", step=2, timeout=10)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        fs = pool.submit(send)
+        fr = pool.submit(recv)
+        fs.result(timeout=30)
+        got = fr.result(timeout=30)
+    assert_state_equal(state, got)
+    # In-place receive wrote into the preallocated leaves.
+    assert got["model"]["w1"] is prealloc["model"]["w1"]
+    for pg in pgs:
+        pg.shutdown()
+    store.shutdown()
+
+
+def test_rwlock():
+    lock = RWLock()
+    # Multiple readers coexist.
+    assert lock.acquire_read(1.0)
+    assert lock.acquire_read(1.0)
+    # Writer blocks while readers hold.
+    assert not lock.acquire_write(0.1)
+    lock.release_read()
+    lock.release_read()
+    assert lock.acquire_write(1.0)
+    # Reader blocks while writer holds.
+    assert not lock.acquire_read(0.1)
+    lock.release_write()
+
+    # Writer preference: a waiting writer blocks new readers.
+    assert lock.acquire_read(1.0)
+    got_write = threading.Event()
+
+    def writer():
+        assert lock.acquire_write(5.0)
+        got_write.set()
+        lock.release_write()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    assert not lock.acquire_read(0.1)  # writer is waiting
+    lock.release_read()
+    t.join(timeout=5)
+    assert got_write.is_set()

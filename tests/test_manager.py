@@ -1,0 +1,317 @@
+"""Manager state-machine tests with mocked control-plane RPC (reference:
+torchft/manager_test.py: patched ManagerClient + autospec'd ProcessGroup
+drive the Manager through happy path, heal, errors, and commit gating
+without any networking)."""
+
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import QuorumResult
+from torchft_tpu.manager import (
+    ExceededMaxRetriesError,
+    Manager,
+    WorldSizeMode,
+)
+from torchft_tpu.process_group import ProcessGroupDummy
+
+
+def make_quorum_result(**kwargs) -> QuorumResult:
+    defaults = dict(
+        quorum_id=1,
+        replica_rank=0,
+        replica_world_size=2,
+        recover_src_manager_address="",
+        recover_src_replica_rank=None,
+        recover_dst_replica_ranks=[],
+        store_address="127.0.0.1:1234",
+        max_step=0,
+        max_replica_rank=0,
+        max_world_size=2,
+        heal=False,
+        commit_failures=0,
+    )
+    defaults.update(kwargs)
+    return QuorumResult(**defaults)
+
+
+def make_manager(pg=None, quorum_result=None, **kwargs):
+    """Builds a Manager with mocked ManagerServer/Client and transport."""
+    pg = pg if pg is not None else ProcessGroupDummy()
+    transport = MagicMock()
+    transport.metadata.return_value = "http://127.0.0.1:0"
+    with patch("torchft_tpu.manager.ManagerServer") as server_cls, patch(
+        "torchft_tpu.manager.ManagerClient"
+    ) as client_cls:
+        server_cls.return_value.address.return_value = "127.0.0.1:1"
+        client = client_cls.return_value
+        client._quorum.return_value = quorum_result or make_quorum_result()
+        # Echo the local vote by default.
+        client.should_commit.side_effect = (
+            lambda rank, step, ok, timeout=None: ok
+        )
+        manager = Manager(
+            pg=pg,
+            checkpoint_transport=transport,
+            replica_id="test",
+            lighthouse_addr="unused:1",
+            group_rank=0,
+            group_world_size=1,
+            use_async_quorum=kwargs.pop("use_async_quorum", True),
+            **kwargs,
+        )
+    manager._test_client = client  # type: ignore[attr-defined]
+    manager._test_transport = transport  # type: ignore[attr-defined]
+    return manager
+
+
+def test_happy_path_commit():
+    pg = ProcessGroupDummy()
+    m = make_manager(pg=pg)
+    try:
+        m.start_quorum()
+        arr = np.full(4, 2.0, dtype=np.float32)
+        out = m.allreduce(arr).wait()
+        # Dummy pg: sum = input; divided by num_participants (2).
+        np.testing.assert_allclose(out[0], 1.0)
+        assert m.should_commit()
+        assert m.current_step() == 1
+        assert m.batches_committed() == 2
+        assert pg.configure_count == 1  # quorum_id changed from -1 -> 1
+    finally:
+        m.shutdown()
+
+
+def test_pg_reconfigured_only_on_quorum_change():
+    pg = ProcessGroupDummy()
+    m = make_manager(pg=pg)
+    try:
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 1
+        # Same quorum id -> no reconfigure.
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 1
+        # New quorum id -> reconfigure with prefixed store path.
+        m._test_client._quorum.return_value = make_quorum_result(quorum_id=2)
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 2
+    finally:
+        m.shutdown()
+
+
+def test_async_heal_defers_user_state():
+    user_state = {"w": np.arange(3.0)}
+    loaded = {}
+    m = make_manager(
+        quorum_result=make_quorum_result(
+            heal=True,
+            max_step=7,
+            recover_src_manager_address="127.0.0.1:9",
+            recover_src_replica_rank=1,
+        )
+    )
+    m._test_transport.recv_checkpoint.return_value = {
+        "torchft": {"step": 7, "batches_committed": 14},
+        "user": {"default": user_state},
+    }
+    m.register_state_dict_fn(
+        "default", lambda: user_state, lambda s: loaded.update(s)
+    )
+    with patch("torchft_tpu.manager.ManagerClient") as peer_cls:
+        peer_cls.return_value._checkpoint_metadata.return_value = "http://peer"
+        try:
+            m.start_quorum()
+            m.wait_quorum()
+            # Healing rank doesn't participate in async mode.
+            assert not m.is_participating()
+            assert m.num_participants() == 2
+            # torchft state applied immediately; user state deferred.
+            assert m.current_step() == 7
+            assert not loaded
+            assert m.should_commit()
+            assert loaded  # applied at commit time
+            assert m.current_step() == 8
+        finally:
+            m.shutdown()
+
+
+def test_sync_quorum_applies_state_immediately():
+    loaded = {}
+    m = make_manager(
+        use_async_quorum=False,
+        quorum_result=make_quorum_result(
+            heal=True,
+            max_step=3,
+            recover_src_manager_address="127.0.0.1:9",
+            recover_src_replica_rank=1,
+        ),
+    )
+    m._test_transport.recv_checkpoint.return_value = {
+        "torchft": {"step": 3, "batches_committed": 6},
+        "user": {"default": {"x": 1}},
+    }
+    m.register_state_dict_fn(
+        "default", lambda: {}, lambda s: loaded.update(s)
+    )
+    with patch("torchft_tpu.manager.ManagerClient") as peer_cls:
+        peer_cls.return_value._checkpoint_metadata.return_value = "http://peer"
+        try:
+            m.start_quorum()  # sync: waits and applies
+            assert loaded == {"x": 1}
+            assert m.current_step() == 3
+            # Sync mode participates even while healing.
+            assert m.is_participating()
+        finally:
+            m.shutdown()
+
+
+def test_send_checkpoint_to_recovering_peers():
+    m = make_manager(
+        quorum_result=make_quorum_result(recover_dst_replica_ranks=[1], max_step=5)
+    )
+    try:
+        m.start_quorum()
+        m.wait_quorum()
+        call = m._test_transport.send_checkpoint.call_args
+        assert call.kwargs["dst_ranks"] == [1]
+        assert call.kwargs["step"] == 5
+    finally:
+        m.shutdown()
+
+
+def test_allreduce_error_latches_and_commit_fails():
+    pg = MagicMock()
+    pg.errored.return_value = None
+    pg.allreduce.side_effect = RuntimeError("collective died")
+    m = make_manager(pg=pg)
+    try:
+        m.start_quorum()
+        arr = np.ones(2, dtype=np.float32)
+        m.allreduce(arr).wait()  # DummyWork, no raise
+        assert m.errored() is not None
+        assert not m.should_commit()
+        assert m.current_step() == 0
+        # Next quorum resets the error.
+        pg.allreduce.side_effect = None
+        m._test_client._quorum.return_value = make_quorum_result(quorum_id=1)
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is None
+    finally:
+        m.shutdown()
+
+
+def test_pg_async_error_surfaces():
+    pg = ProcessGroupDummy()
+    m = make_manager(pg=pg)
+    try:
+        m.start_quorum()
+        m.wait_quorum()
+        pg_err = RuntimeError("async pg failure")
+        pg.errored = lambda: pg_err  # type: ignore[method-assign]
+        assert m.errored() is pg_err
+        assert not m.should_commit()
+    finally:
+        m.shutdown()
+
+
+def test_quorum_rpc_failure_is_latched():
+    m = make_manager()
+    m._test_client._quorum.side_effect = TimeoutError("lighthouse down")
+    try:
+        m.start_quorum()
+        arr = np.ones(1)
+        m.allreduce(arr).wait()  # no crash
+        assert isinstance(m.errored(), TimeoutError)
+        assert not m.should_commit()
+    finally:
+        m.shutdown()
+
+
+def test_min_replica_size_gates_commit():
+    m = make_manager(
+        min_replica_size=3,
+        quorum_result=make_quorum_result(replica_world_size=2, max_world_size=2),
+    )
+    try:
+        m.start_quorum()
+        m.wait_quorum()
+        assert not m.should_commit()  # 2 < 3
+    finally:
+        m.shutdown()
+
+
+def test_fixed_with_spares_benches_extra_ranks():
+    m = make_manager(
+        min_replica_size=2,
+        world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        quorum_result=make_quorum_result(
+            replica_rank=2, max_world_size=3, replica_world_size=3
+        ),
+    )
+    try:
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.num_participants() == 2  # clamped to fixed size
+        assert not m.is_participating()  # rank 2 is a spare
+        arr = np.full(2, 5.0)
+        out = m.allreduce(arr).wait()
+        np.testing.assert_allclose(out[0], 0.0)  # spare contributes zeros
+    finally:
+        m.shutdown()
+
+
+def test_max_retries_raises():
+    m = make_manager(max_retries=1)
+    m._test_client.should_commit.side_effect = None
+    m._test_client.should_commit.return_value = False
+    try:
+        m.start_quorum()
+        assert not m.should_commit()
+        m.start_quorum()
+        with pytest.raises(ExceededMaxRetriesError):
+            m.should_commit()
+    finally:
+        m.shutdown()
+
+
+def test_commit_failures_reported_to_quorum():
+    m = make_manager()
+    m._test_client.should_commit.side_effect = None
+    m._test_client.should_commit.return_value = False
+    try:
+        m.start_quorum()
+        assert not m.should_commit()
+        m.start_quorum()
+        m.wait_quorum()
+        kwargs = m._test_client._quorum.call_args.kwargs
+        assert kwargs["commit_failures"] == 1
+    finally:
+        m.shutdown()
+
+
+def test_state_dict_roundtrip():
+    m = make_manager()
+    try:
+        m.load_state_dict({"step": 42, "batches_committed": 84})
+        assert m.current_step() == 42
+        assert m.state_dict() == {"step": 42, "batches_committed": 84}
+    finally:
+        m.shutdown()
+
+
+def test_state_dict_lock_blocks_checkpoint_read():
+    m = make_manager()
+    try:
+        m.register_state_dict_fn("default", lambda: {"x": 1}, lambda s: None)
+        m.disallow_state_dict_read()
+        with pytest.raises(TimeoutError):
+            m._state_dict_lock.r_lock(timeout=0.1).__enter__()
+        m.allow_state_dict_read()
+        assert m._manager_state_dict()["user"]["default"] == {"x": 1}
+    finally:
+        m.shutdown()

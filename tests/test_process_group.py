@@ -1,0 +1,303 @@
+"""Process-group tests: N ranks as threads sharing a store (reference:
+process_group_test.py MultiPgBaseTest:863-1020), full collective surface,
+crash-and-reconfigure resiliency, and the wrapper zoo."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ManagedProcessGroup,
+    ProcessGroupDummy,
+    ProcessGroupSocket,
+    ReduceOp,
+)
+from torchft_tpu.store import TCPStoreServer
+from torchft_tpu.work import DummyWork
+
+
+def _run_parallel(fns):
+    """Runs one callable per rank in threads; returns results, re-raising
+    the first failure."""
+    with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        return [f.result(timeout=60) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = TCPStoreServer()
+    yield server
+    server.shutdown()
+
+
+def _make_group(store, world_size, prefix="pg0", timeout=10.0):
+    groups = [ProcessGroupSocket(timeout=timeout) for _ in range(world_size)]
+
+    def configure(rank):
+        groups[rank].configure(f"{store.address()}/{prefix}", rank, world_size)
+
+    _run_parallel([lambda r=r: configure(r) for r in range(world_size)])
+    return groups
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_allreduce_sum(store, world_size):
+    groups = _make_group(store, world_size, prefix=f"ar{world_size}")
+    expected = sum(range(world_size))
+
+    def run(rank):
+        arr = np.full((5, 3), float(rank), dtype=np.float32)
+        out = groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+        return out[0]
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(world_size)])
+    for r in results:
+        np.testing.assert_allclose(r, expected)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_avg_and_inplace(store):
+    groups = _make_group(store, 2, prefix="avg")
+
+    def run(rank):
+        arr = np.full(7, float(rank * 2), dtype=np.float32)  # 0 and 2 -> avg 1
+        groups[rank].allreduce(arr, ReduceOp.AVG).wait(timeout=30)
+        return arr  # reduced in place
+
+    a, b = _run_parallel([lambda: run(0), lambda: run(1)])
+    np.testing.assert_allclose(a, 1.0)
+    np.testing.assert_allclose(b, 1.0)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_max_min(store):
+    groups = _make_group(store, 3, prefix="maxmin")
+
+    def run(rank, op):
+        arr = np.array([float(rank)], dtype=np.float64)
+        return groups[rank].allreduce(arr, op).wait(timeout=30)[0][0]
+
+    maxes = _run_parallel([lambda r=r: run(r, ReduceOp.MAX) for r in range(3)])
+    assert all(m == 2.0 for m in maxes)
+    mins = _run_parallel([lambda r=r: run(r, ReduceOp.MIN) for r in range(3)])
+    assert all(m == 0.0 for m in mins)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allgather_broadcast_reduce_scatter_alltoall_barrier(store):
+    ws = 3
+    groups = _make_group(store, ws, prefix="suite")
+
+    def run(rank):
+        pg = groups[rank]
+        # allgather
+        gathered = pg.allgather(np.array([rank, rank + 10])).wait(timeout=30)
+        assert [g[0][0] for g in gathered] == list(range(ws))
+        # broadcast from root 1
+        arr = np.array([float(rank)], dtype=np.float64)
+        pg.broadcast(arr, root=1).wait(timeout=30)
+        assert arr[0] == 1.0
+        # reduce_scatter: rank j receives sum over ranks of inputs[j]
+        inputs = [np.full(4, float(rank + j), dtype=np.float32) for j in range(ws)]
+        shard = pg.reduce_scatter(inputs, ReduceOp.SUM).wait(timeout=30)
+        np.testing.assert_allclose(shard, sum(r + rank for r in range(ws)))
+        # alltoall: output[j] = rank j's inputs[me]
+        inputs = [np.array([rank * 10 + j]) for j in range(ws)]
+        out = pg.alltoall(inputs).wait(timeout=30)
+        assert [o[0] for o in out] == [j * 10 + rank for j in range(ws)]
+        # barrier
+        pg.barrier().wait(timeout=30)
+        return True
+
+    assert all(_run_parallel([lambda r=r: run(r) for r in range(ws)]))
+    for g in groups:
+        g.shutdown()
+
+
+def test_send_recv(store):
+    groups = _make_group(store, 2, prefix="p2p")
+
+    def sender():
+        groups[0].send([np.arange(6, dtype=np.float32)], dst=1, tag="x").wait(30)
+
+    def receiver():
+        (arr,) = groups[1].recv(src=0, tag="x").wait(30)
+        return arr
+
+    _, arr = _run_parallel([sender, receiver])
+    np.testing.assert_allclose(arr, np.arange(6))
+    for g in groups:
+        g.shutdown()
+
+
+def test_crash_and_reconfigure(store):
+    """The resiliency scenario (reference: process_group_test.py:961-1020):
+    kill the last rank mid-life, survivors' collectives raise, then a
+    reconfigure against a fresh prefix with a smaller world succeeds."""
+    ws = 3
+    groups = _make_group(store, ws, prefix="crash1")
+
+    groups[2].shutdown()  # crash the last rank
+
+    def failing(rank):
+        arr = np.ones(1024, dtype=np.float32)
+        with pytest.raises((RuntimeError, TimeoutError)):
+            groups[rank].allreduce(arr).wait(timeout=5)
+        return True
+
+    assert all(_run_parallel([lambda: failing(0), lambda: failing(1)]))
+
+    # Reconfigure the survivors into a 2-world group under a new prefix.
+    def reconfigure(rank):
+        groups[rank].configure(f"{store.address()}/crash2", rank, 2)
+        arr = np.full(3, float(rank), dtype=np.float32)
+        groups[rank].allreduce(arr).wait(timeout=30)
+        return arr
+
+    a, b = _run_parallel([lambda: reconfigure(0), lambda: reconfigure(1)])
+    np.testing.assert_allclose(a, 1.0)  # 0 + 1
+    np.testing.assert_allclose(b, 1.0)
+    assert groups[0].errored() is None  # configure cleared the latched error
+    for g in groups[:2]:
+        g.shutdown()
+
+
+def test_abort_latches_error(store):
+    groups = _make_group(store, 2, prefix="abort")
+    groups[0].abort()
+    assert groups[0].errored() is not None
+    work = groups[0].allreduce(np.ones(2))
+    with pytest.raises(RuntimeError):
+        work.wait(timeout=5)
+    for g in groups:
+        g.shutdown()
+
+
+def test_world_size_one_noop():
+    pg = ProcessGroupSocket()
+    pg.configure("unused:0/solo", 0, 1)
+    arr = np.full(4, 7.0)
+    out = pg.allreduce(arr, ReduceOp.SUM).wait(timeout=5)
+    np.testing.assert_allclose(out[0], 7.0)
+    pg.shutdown()
+
+
+def test_dummy_pg():
+    pg = ProcessGroupDummy()
+    arr = np.ones(3)
+    out = pg.allreduce(arr).wait()
+    np.testing.assert_allclose(out[0], 1.0)
+    pg.configure("x:1/y", 0, 1)
+    assert pg.configure_count == 1
+    assert isinstance(pg.barrier(), DummyWork)
+
+
+def test_error_swallowing_wrapper(store):
+    inner = ProcessGroupDummy()
+    wrapper = ErrorSwallowingProcessGroupWrapper(inner)
+    fake_err = RuntimeError("injected")
+    wrapper.report_error(fake_err)
+    assert wrapper.error() is fake_err
+    # Post-error allreduce is a no-op that returns the inputs.
+    arr = np.ones(2)
+    out = wrapper.allreduce(arr).wait()
+    np.testing.assert_allclose(out[0], 1.0)
+    # configure resets the error.
+    wrapper.configure("x:1/y", 0, 1)
+    assert wrapper.error() is None
+
+
+def test_fake_wrapper_injects_error():
+    wrapper = FakeProcessGroupWrapper(ProcessGroupDummy())
+    wrapper.report_future_error(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        wrapper.allreduce(np.ones(1)).wait(timeout=5)
+    # Next op is clean.
+    wrapper.allreduce(np.ones(1)).wait(timeout=5)
+
+
+def test_managed_pg_delegates_to_manager():
+    class FakeManager:
+        def __init__(self):
+            self.calls = 0
+
+        def allreduce(self, tensors):
+            self.calls += 1
+            return DummyWork(tensors)
+
+        def num_participants(self):
+            return 5
+
+        def participating_rank(self):
+            return 2
+
+        def errored(self):
+            return None
+
+    m = FakeManager()
+    pg = ManagedProcessGroup(m)
+    pg.allreduce(np.ones(1)).wait()
+    assert m.calls == 1
+    assert pg.size() == 5
+    assert pg.rank() == 2
+
+
+def test_futures_engine():
+    import concurrent.futures
+
+    from torchft_tpu import futures
+
+    fut = concurrent.futures.Future()
+    wrapped = futures.future_timeout(fut, 0.2)
+    with pytest.raises(TimeoutError):
+        wrapped.result(timeout=5)
+
+    fut2 = concurrent.futures.Future()
+    wrapped2 = futures.future_timeout(fut2, 5.0)
+    fut2.set_result(42)
+    assert wrapped2.result(timeout=5) == 42
+
+    fired = threading.Event()
+    with futures.context_timeout(fired.set, 0.2):
+        fired.wait(1.0)
+    assert fired.is_set()
+
+    not_fired = threading.Event()
+    with futures.context_timeout(not_fired.set, 5.0):
+        pass
+    assert not not_fired.is_set()
+
+
+def test_allreduce_quantized_accuracy(store):
+    """Quantized allreduce matches exact allreduce within int8 tolerance
+    (reference: collectives_test.py / quantization_test.py)."""
+    from torchft_tpu.collectives import allreduce_quantized
+
+    ws = 2
+    groups = _make_group(store, ws, prefix="quant")
+    rng = np.random.default_rng(0)
+    data = [rng.standard_normal(2047).astype(np.float32) for _ in range(ws)]
+    expected = sum(d.copy() for d in data)
+
+    def run(rank):
+        arr = data[rank].copy()
+        allreduce_quantized(groups[rank], [arr]).wait(timeout=30)
+        return arr
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    for r in results:
+        # one quantize->dequantize round trip per value: ~1% of block max
+        np.testing.assert_allclose(r, expected, atol=np.abs(expected).max() * 0.05)
+    # must be meaningfully accurate, not garbage
+    err = np.abs(results[0] - expected).mean() / (np.abs(expected).mean() + 1e-9)
+    assert err < 0.02, f"mean relative error too high: {err}"
+    for g in groups:
+        g.shutdown()

@@ -1,0 +1,2 @@
+from torchft_tpu.checkpointing.http_transport import HTTPTransport  # noqa: F401
+from torchft_tpu.checkpointing.transport import CheckpointTransport  # noqa: F401

@@ -1,0 +1,184 @@
+"""HTTP checkpoint transport (reference: torchft/checkpointing/http_transport.py:39-299).
+
+Each rank runs a threading HTTP server serving
+``/checkpoint/{step}/full``, ``/checkpoint/{step}/metadata`` and
+``/checkpoint/{step}/chunk_{i}``; the state dict is staged as host numpy
+copies and fenced by an RWLock so a send can't observe a mid-mutation state
+dict. Receivers fetch the full stream or N chunks in parallel threads and
+reassemble. ``metadata()`` is the server URL.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing._serialization import join_state, split_state
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = RWLock(timeout=60.0)
+        self.step: Optional[int] = None
+        self.meta: Any = None
+        self.buffers: List[Any] = []
+        self.num_chunks: int = 0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        state: _State = self.server.ckpt_state  # type: ignore[attr-defined]
+        parts = self.path.strip("/").split("/")
+        # /checkpoint/{step}/{what}
+        if len(parts) != 3 or parts[0] != "checkpoint":
+            self.send_error(404, "unknown path")
+            return
+        try:
+            step = int(parts[1])
+        except ValueError:
+            self.send_error(400, "bad step")
+            return
+        what = parts[2]
+        if not state.lock.acquire_read(timeout=30.0):
+            self.send_error(503, "checkpoint busy")
+            return
+        try:
+            if state.step != step:
+                self.send_error(
+                    404, f"checkpoint for step {step} not available "
+                         f"(serving {state.step})"
+                )
+                return
+            if what == "metadata":
+                body = pickle.dumps({"num_chunks": state.num_chunks})
+            elif what == "full":
+                body = dumps_parts(state.meta, state.buffers)
+            elif what.startswith("chunk_"):
+                idx = int(what[len("chunk_"):])
+                if state.num_chunks == 0 or idx >= state.num_chunks:
+                    self.send_error(404, "no such chunk")
+                    return
+                # Round-robin buffer split (reference: values[i::num_chunks],
+                # http_transport.py:288-299); chunk 0 carries the meta skeleton.
+                assigned = list(range(idx, len(state.buffers), state.num_chunks))
+                payload = {
+                    "meta": state.meta if idx == 0 else None,
+                    "parts": {i: state.buffers[i] for i in assigned},
+                }
+                body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                self.send_error(404, "unknown resource")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+        finally:
+            state.lock.release_read()
+
+
+def dumps_parts(meta: Any, buffers: List[Any]) -> bytes:
+    return pickle.dumps({"meta": meta, "buffers": buffers},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class HTTPTransport(CheckpointTransport):
+    def __init__(self, timeout: float = 60.0, num_chunks: int = 0,
+                 port: int = 0) -> None:
+        self._timeout = timeout
+        self._state = _State()
+        self._state.num_chunks = num_chunks
+        self._server = _HTTPServer(("0.0.0.0", port), _Handler)
+        self._server.ckpt_state = self._state  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ckpt-http", daemon=True
+        )
+        self._thread.start()
+        self._port = self._server.server_address[1]
+
+    def metadata(self) -> str:
+        from torchft_tpu.coordination import advertise_host
+
+        return f"http://{advertise_host()}:{self._port}"
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+    ) -> None:
+        # Stage host copies under the write lock, then publish the step
+        # (reference: CPU copy on a side stream + allow_checkpoint,
+        # http_transport.py:220-242). The copy is required: split_state
+        # aliases contiguous numpy inputs, and the optimizer mutates those
+        # same arrays while peers are still fetching.
+        meta, buffers = split_state(state_dict)
+        buffers = [np.array(b, copy=True) for b in buffers]
+        with self._state.lock.w_lock(timeout):
+            self._state.meta = meta
+            self._state.buffers = buffers
+            self._state.step = step
+
+    def disallow_checkpoint(self) -> None:
+        with self._state.lock.w_lock(self._timeout):
+            self._state.step = None
+            self._state.meta = None
+            self._state.buffers = []
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
+        base = metadata.rstrip("/")
+        info = pickle.loads(
+            self._fetch(f"{base}/checkpoint/{step}/metadata", timeout)
+        )
+        num_chunks = info["num_chunks"]
+        if num_chunks <= 1:
+            payload = pickle.loads(
+                self._fetch(f"{base}/checkpoint/{step}/full", timeout)
+            )
+            return join_state(payload["meta"], payload["buffers"])
+        # Parallel chunk fetch (reference: http_transport.py:244-267).
+        with ThreadPoolExecutor(max_workers=num_chunks) as pool:
+            chunks = list(
+                pool.map(
+                    lambda i: pickle.loads(
+                        self._fetch(f"{base}/checkpoint/{step}/chunk_{i}", timeout)
+                    ),
+                    range(num_chunks),
+                )
+            )
+        meta = next(c["meta"] for c in chunks if c["meta"] is not None)
+        total = sum(len(c["parts"]) for c in chunks)
+        buffers: List[Optional[Any]] = [None] * total
+        for c in chunks:
+            for i, buf in c["parts"].items():
+                buffers[i] = buf
+        return join_state(meta, buffers)
+
+    @staticmethod
+    def _fetch(url: str, timeout: float) -> bytes:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read()
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,121 @@
+"""Quantized collectives for the replica (DCN) axis.
+
+Capability parity with the reference's ``torchft/collectives.py:159-415``:
+``allreduce_quantized`` cuts outer-axis gradient traffic ~4x by sending
+block-quantized int8 with per-block float scales instead of float32, using
+the same alltoall -> local-reduce-in-full-precision -> allgather pipeline
+(sums are computed in float32, so quantization error does not accumulate
+across ranks; only one quantize->dequantize round trip per value).
+
+The reference quantizes with Triton fp8 kernels on CUDA; here the host path
+is vectorized numpy int8 (DCN transfers are host-driven), and
+``torchft_tpu/ops/quantization.py`` provides Pallas TPU kernels for
+quantizing on-device before the device->host pull.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchft_tpu.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.work import DummyWork, FutureWork, Work
+
+BLOCK = 512  # values per quantization scale
+
+_EXECUTOR: Optional[ThreadPoolExecutor] = None
+_EXECUTOR_LOCK = threading.Lock()
+
+
+def _executor() -> ThreadPoolExecutor:
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            _EXECUTOR = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="quant-collective"
+            )
+        return _EXECUTOR
+
+
+def quantize_blockwise(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int8-quantizes a 1-D float array with one float32 scale per BLOCK
+    values (the rowwise-fp8 analog of quantization.py:44-162). Returns
+    (int8 values, float32 scales)."""
+    n = flat.size
+    blocks = (n + BLOCK - 1) // BLOCK
+    padded = np.zeros(blocks * BLOCK, dtype=np.float32)
+    padded[:n] = flat
+    mat = padded.reshape(blocks, BLOCK)
+    scales = np.abs(mat).max(axis=1) / 127.0
+    scales = np.where(scales == 0, 1.0, scales).astype(np.float32)
+    q = np.clip(np.rint(mat / scales[:, None]), -127, 127).astype(np.int8)
+    return q.reshape(-1), scales
+
+
+def dequantize_blockwise(
+    q: np.ndarray, scales: np.ndarray, n: int
+) -> np.ndarray:
+    mat = q.astype(np.float32).reshape(-1, BLOCK) * scales[:, None]
+    return mat.reshape(-1)[:n]
+
+
+def _flatten(arrays: Sequence[np.ndarray]) -> Tuple[np.ndarray, List[int]]:
+    sizes = [a.size for a in arrays]
+    flat = np.concatenate([a.reshape(-1).astype(np.float32) for a in arrays])
+    return flat, sizes
+
+
+def _unflatten_into(
+    arrays: Sequence[np.ndarray], flat: np.ndarray, sizes: List[int]
+) -> None:
+    offset = 0
+    for a, n in zip(arrays, sizes):
+        a[...] = flat[offset : offset + n].reshape(a.shape).astype(
+            a.dtype, copy=False
+        )
+        offset += n
+
+
+def allreduce_quantized(
+    pg: ProcessGroup, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+) -> Work:
+    """Quantized SUM/AVG allreduce, in place (reference:
+    collectives.py:297-415). Returns async Work whose result is ``arrays``."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
+    ws = pg.size()
+    if ws <= 1:
+        return DummyWork(list(arrays))
+
+    def run() -> List[np.ndarray]:
+        flat, sizes = _flatten(arrays)
+        rank_chunks = np.array_split(flat, ws)
+        chunk_sizes = [c.size for c in rank_chunks]
+        # Quantize my copy of every rank's chunk, alltoall so rank j gets
+        # everyone's j-th chunk.
+        qs, ss = zip(*(quantize_blockwise(c) for c in rank_chunks))
+        all_q = pg.alltoall(list(qs)).wait()
+        all_s = pg.alltoall([np.asarray(s) for s in ss]).wait()
+        # Local reduce in float32 (error does not compound across ranks).
+        me = pg.rank()
+        n_me = chunk_sizes[me]
+        acc = np.zeros(n_me, dtype=np.float32)
+        for q, s in zip(all_q, all_s):
+            acc += dequantize_blockwise(q, s, n_me)
+        if op == ReduceOp.AVG:
+            acc /= ws
+        # Re-quantize the reduced chunk and allgather.
+        rq, rs = quantize_blockwise(acc)
+        gathered = pg.allgather([rq, np.asarray(rs)]).wait()
+        pieces = [
+            dequantize_blockwise(gq, gs, chunk_sizes[r])
+            for r, (gq, gs) in enumerate(gathered)
+        ]
+        result = np.concatenate(pieces)
+        _unflatten_into(arrays, result, sizes)
+        return list(arrays)
+
+    return FutureWork(_executor().submit(run))

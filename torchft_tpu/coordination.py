@@ -182,11 +182,18 @@ class _FramedClient:
                 finally:
                     self._sock = None
 
-    def call(self, req: Dict[str, Any], timeout: float) -> Dict[str, Any]:
+    def call(
+        self, req: Dict[str, Any], timeout: float, retry: bool = True
+    ) -> Dict[str, Any]:
         """Sends one request; raises TimeoutError on deadline expiry and
-        RuntimeError on server-reported errors or transport failure."""
+        RuntimeError on server-reported errors or transport failure.
+
+        ``retry=False`` for non-idempotent requests (e.g. should_commit
+        votes): a reconnect-resend could double-apply a request whose first
+        copy the server already processed."""
         with self._lock:
-            for attempt in (0, 1):
+            attempts = (0, 1) if retry else (1,)
+            for attempt in attempts:
                 if self._sock is None:
                     self._sock = _net.connect(self._addr, self._connect_timeout)
                 try:
@@ -252,7 +259,10 @@ class _ServerProcess:
                 if not chunk and self._proc.poll() is not None:
                     break
                 buf += chunk
-                for line in buf.splitlines():
+                # Parse complete lines only — a chunk boundary can split
+                # "LISTENING <port>" mid-number.
+                *complete, buf = buf.split("\n")
+                for line in complete:
                     if line.startswith("LISTENING "):
                         return int(line.split()[1])
             elif self._proc.poll() is not None:
@@ -485,6 +495,7 @@ class ManagerClient:
                 "timeout_ms": int(timeout * 1000),
             },
             timeout + 5.0,
+            retry=False,  # a resent vote would poison the next barrier round
         )
         return resp["should_commit"]
 

@@ -1,0 +1,93 @@
+"""Fault-tolerant data parallelism across replica groups.
+
+Reference: ``torchft/ddp.py:32-105`` routes each gradient bucket through
+``manager.allreduce`` via a DDP comm hook. The JAX equivalent: the *inner*
+data-parallel axis (within a replica group / pod) is a mesh axis whose
+gradient psum is compiled into the step function and rides ICI; this module
+averages the resulting gradients *across replica groups* over DCN, bucketed
+into flat host buffers with async overlap (bucket N+1 transfers while N is
+in flight — the comm-hook overlap analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from torchft_tpu.manager import Manager
+
+
+class DistributedDataParallel:
+    """Averages gradient pytrees across the fault-tolerant replica axis.
+
+    Usage::
+
+        ddp = DistributedDataParallel(manager)
+        grads = grad_fn(params, batch)          # inner-axis psum inside jit
+        grads = ddp.allreduce_grads(grads)      # outer-axis average over DCN
+    """
+
+    def __init__(self, manager: Manager, bucket_cap_mb: float = 32.0) -> None:
+        self._manager = manager
+        self._bucket_cap = int(bucket_cap_mb * 1024 * 1024)
+
+    def allreduce_grads(self, grads: Any, should_quantize: bool = False) -> Any:
+        """Flattens ``grads`` into <=bucket_cap flat buffers per dtype, issues
+        async manager allreduces for all buckets, waits, and rebuilds the
+        pytree (values averaged over live participants)."""
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        host: List[np.ndarray] = [np.asarray(x) for x in leaves]
+
+        buckets = self._bucketize(host)
+        works: List[Tuple[Any, np.ndarray, List[int]]] = []
+        for idx_list in buckets:
+            flat = np.concatenate([host[i].reshape(-1) for i in idx_list])
+            work = self._manager.allreduce(flat, should_quantize=should_quantize)
+            works.append((work, flat, idx_list))
+
+        out: List[Optional[np.ndarray]] = [None] * len(host)
+        for work, flat, idx_list in works:
+            (reduced,) = work.wait()
+            offset = 0
+            for i in idx_list:
+                n = host[i].size
+                out[i] = reduced[offset : offset + n].reshape(host[i].shape)
+                offset += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _bucketize(self, arrays: List[np.ndarray]) -> List[List[int]]:
+        """Greedy same-dtype buckets up to the cap (reference: 32 MiB flat
+        buffers, local_sgd.py:466-560)."""
+        by_dtype: dict = {}
+        for i, a in enumerate(arrays):
+            by_dtype.setdefault(a.dtype, []).append(i)
+        buckets: List[List[int]] = []
+        for idxs in by_dtype.values():
+            cur: List[int] = []
+            size = 0
+            for i in idxs:
+                nbytes = arrays[i].nbytes
+                if cur and size + nbytes > self._bucket_cap:
+                    buckets.append(cur)
+                    cur, size = [], 0
+                cur.append(i)
+                size += nbytes
+            if cur:
+                buckets.append(cur)
+        return buckets
+
+
+class PureDistributedDataParallel:
+    """Naive per-leaf variant (reference: ddp.py:82-105) — one allreduce per
+    gradient leaf, no bucketing. Useful for debugging numerics."""
+
+    def __init__(self, manager: Manager) -> None:
+        self._manager = manager
+
+    def allreduce_grads(self, grads: Any) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        works = [self._manager.allreduce(np.asarray(g)) for g in leaves]
+        out = [w.wait()[0] for w in works]
+        return jax.tree_util.tree_unflatten(treedef, out)

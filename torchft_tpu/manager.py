@@ -1,0 +1,626 @@
+"""Manager: the per-rank fault-tolerance runtime state machine.
+
+Capability parity with the reference's ``torchft/manager.py:137-946``:
+- ``start_quorum()`` runs the quorum asynchronously (overlapping forward/
+  backward), reconfigures the process group when the quorum id changes, and
+  drives live recovery (send/receive checkpoints) for lagging replicas.
+- ``allreduce()`` gates gradient averaging on the quorum, zeroes the
+  contribution of non-participating ranks, and normalizes by the *live*
+  participant count (dynamic-world numerics).
+- ``should_commit()`` is the distributed commit gate: errors anywhere in the
+  step cause every replica to skip the optimizer update.
+- Errors are latched (``report_error``/``errored``) so a failed collective
+  poisons the step, not the process.
+
+TPU-first notes: tensors here are host numpy buffers or jax arrays (pulled
+to host at the manager boundary — the outer replica axis rides DCN, not
+ICI, so a host round-trip is inherent); the recovery path runs on a
+background thread (the reference's CUDA "recovery stream" analog); state
+dicts are arbitrary pytrees.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import threading
+import uuid
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar
+
+import numpy as np
+
+from torchft_tpu import futures as ft_futures
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
+from torchft_tpu.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.store import StoreClient, TCPStoreServer
+from torchft_tpu.work import DummyWork, Work
+
+logger = logging.getLogger(__name__)
+
+MANAGER_ADDR_KEY = "manager_addr"
+REPLICA_ID_KEY = "replica_id"
+
+T = TypeVar("T")
+
+
+class WorldSizeMode(Enum):
+    """How membership changes affect training numerics (reference:
+    manager.py:112-127).
+
+    DYNAMIC: gradients are averaged over however many replicas are live;
+    batch size (and thus gradient variance) varies with membership.
+    FIXED_WITH_SPARES: the participant count is fixed at ``min_replica_size``;
+    extra healthy replicas are benched as spares contributing zeros.
+    """
+
+    DYNAMIC = "dynamic"
+    FIXED_WITH_SPARES = "fixed_with_spares"
+
+
+class ExceededMaxRetriesError(RuntimeError):
+    pass
+
+
+class Manager:
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        load_state_dict: Optional[Callable[[Any], None]] = None,
+        state_dict: Optional[Callable[[], Any]] = None,
+        min_replica_size: int = 1,
+        use_async_quorum: bool = True,
+        timeout: float = 60.0,
+        quorum_timeout: float = 120.0,
+        connect_timeout: float = 20.0,
+        replica_id: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        store_addr: Optional[str] = None,
+        group_rank: Optional[int] = None,
+        group_world_size: Optional[int] = None,
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+        init_sync: bool = True,
+        max_retries: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        quorum_retries: int = 0,
+        heartbeat_interval_ms: int = 100,
+    ) -> None:
+        """
+        Args mirror the reference ctor (manager.py:151-333); env fallbacks:
+        ``TORCHFT_LIGHTHOUSE``, ``TORCHFT_TIMEOUT_SEC``,
+        ``TORCHFT_QUORUM_TIMEOUT_SEC``, ``TORCHFT_CONNECT_TIMEOUT_SEC``,
+        ``TORCHFT_QUORUM_RETRIES``, ``REPLICA_GROUP_ID``, ``RANK``,
+        ``WORLD_SIZE``, ``MASTER_ADDR``/``MASTER_PORT``.
+
+        ``pg`` carries the outer (replica) axis only; inner FSDP/TP axes live
+        in the jax mesh, not here.
+        """
+        self._pg = pg
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout = float(os.environ.get("TORCHFT_TIMEOUT_SEC", timeout))
+        self._quorum_timeout = float(
+            os.environ.get("TORCHFT_QUORUM_TIMEOUT_SEC", quorum_timeout)
+        )
+        self._connect_timeout = float(
+            os.environ.get("TORCHFT_CONNECT_TIMEOUT_SEC", connect_timeout)
+        )
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+        self._world_size_mode = world_size_mode
+        self._commit_failures = 0
+
+        self._group_rank = int(
+            group_rank if group_rank is not None else os.environ.get("RANK", 0)
+        )
+        self._group_world_size = int(
+            group_world_size
+            if group_world_size is not None
+            else os.environ.get("WORLD_SIZE", 1)
+        )
+
+        # User state-dict registry (reference: manager.py:219-226, 349-368).
+        self._user_state_dicts: Dict[str, Callable[[], Any]] = {}
+        self._load_state_dicts: Dict[str, Callable[[Any], None]] = {}
+        if state_dict is not None and load_state_dict is not None:
+            self.register_state_dict_fn("default", state_dict, load_state_dict)
+        self._state_dict_lock = RWLock(timeout=self._timeout)
+
+        if checkpoint_transport is None:
+            from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+            checkpoint_transport = HTTPTransport(timeout=self._timeout)
+        self._checkpoint_transport = checkpoint_transport
+
+        # Async quorum executor (one thread: quorum N must finish before N+1).
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async_quorum"
+        )
+        self._quorum_future: Optional[concurrent.futures.Future] = None
+
+        # Step/commit state.
+        self._step = 0
+        self._batches_committed = 0
+        self._consecutive_commit_failures = 0
+        self._participating_rank: Optional[int] = None
+        self._participating_world_size: int = 0
+        self._errored: Optional[Exception] = None
+        self._healing = False
+        self._pending_state_dict: Optional[Dict[str, Any]] = None
+        self._quorum_id = -1
+
+        # Rendezvous store (replica-group local; reference uses torchrun's
+        # TCPStore, manager.py:271-276).
+        self._store_server: Optional[TCPStoreServer] = None
+        if store_addr is None:
+            if self._group_rank == 0:
+                # Bind to MASTER_PORT when the launcher provides one so the
+                # other local ranks' env-fallback path can find us.
+                master_port = int(os.environ.get("MASTER_PORT", 0))
+                self._store_server = TCPStoreServer(port=master_port)
+                store_addr = self._store_server.address()
+            else:
+                master_addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+                master_port = os.environ.get("MASTER_PORT")
+                if master_port is None:
+                    raise ValueError(
+                        "non-zero group_rank needs store_addr or "
+                        "MASTER_ADDR/MASTER_PORT"
+                    )
+                store_addr = f"{master_addr}:{master_port}"
+        self._store_addr = store_addr
+        self._store = StoreClient(store_addr, timeout=self._connect_timeout)
+
+        # Manager server on group rank 0 (reference: manager.py:287-314).
+        self._manager_server: Optional[ManagerServer] = None
+        if self._group_rank == 0:
+            if replica_id is None:
+                replica_id = os.environ.get("REPLICA_GROUP_ID", "")
+            run_id = str(uuid.uuid4())
+            full_replica_id = f"{replica_id}:{run_id}" if replica_id else run_id
+            if lighthouse_addr is None:
+                lighthouse_addr = os.environ["TORCHFT_LIGHTHOUSE"]
+            self._manager_server = ManagerServer(
+                replica_id=full_replica_id,
+                lighthouse_addr=lighthouse_addr,
+                store_address=store_addr,
+                world_size=self._group_world_size,
+                quorum_retries=quorum_retries,
+                heartbeat_interval_ms=heartbeat_interval_ms,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager_server.address())
+            self._store.set(REPLICA_ID_KEY, full_replica_id)
+
+        manager_addr = self._store.get_str(
+            MANAGER_ADDR_KEY, timeout=self._connect_timeout
+        )
+        self._replica_id = self._store.get_str(
+            REPLICA_ID_KEY, timeout=self._connect_timeout
+        )
+        self._client = ManagerClient(manager_addr, self._connect_timeout)
+        self._logger = _ManagerLogger(self)
+
+        ft_futures.start_watchdog()
+
+    # ------------------------------------------------------------------
+    # State-dict registry
+    # ------------------------------------------------------------------
+
+    def register_state_dict_fn(
+        self,
+        key: str,
+        state_dict_fn: Callable[[], Any],
+        load_state_dict_fn: Callable[[Any], None],
+    ) -> None:
+        self._user_state_dicts[key] = state_dict_fn
+        self._load_state_dicts[key] = load_state_dict_fn
+
+    def _manager_state_dict(self) -> Dict[str, Any]:
+        with self._state_dict_lock.r_lock(self._timeout):
+            return {
+                "user": {k: fn() for k, fn in self._user_state_dicts.items()},
+                "torchft": self.state_dict(),
+            }
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def disallow_state_dict_read(self) -> None:
+        """Write-locks the state dict while the optimizer mutates parameters
+        (reference: local_sgd.py:109-113 pre-hook)."""
+        self._state_dict_lock.acquire_write(self._timeout)
+
+    def allow_state_dict_read(self) -> None:
+        self._state_dict_lock.release_write()
+
+    # ------------------------------------------------------------------
+    # Quorum
+    # ------------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Begins the (possibly async) quorum for this step (reference:
+        manager.py:517-573). Call at the top of the step (e.g. from
+        OptimizerWrapper.zero_grad)."""
+        self._errored = None
+        self._healing = False
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal,
+            shrink_only,
+            timeout if timeout is not None else self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                self._apply_pending_state_dict()
+
+    def wait_quorum(self) -> None:
+        assert self._quorum_future is not None, (
+            "wait_quorum called before start_quorum"
+        )
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, timeout: float
+    ) -> None:
+        try:
+            result = self._client._quorum(
+                group_rank=self._group_rank,
+                step=self._step,
+                checkpoint_metadata=self._checkpoint_transport.metadata(),
+                shrink_only=shrink_only,
+                timeout=timeout,
+                init_sync=self._init_sync,
+                commit_failures=self._commit_failures,
+            )
+        except Exception as e:
+            self._logger.exception(f"quorum failed: {e}")
+            self.report_error(e)
+            raise
+
+        quorum_id_changed = result.quorum_id != self._quorum_id
+        heal = result.heal and allow_heal
+
+        # Participation (reference: manager.py:621-640). Async quorums train
+        # with the max-step group only (healing ranks rejoin next step);
+        # sync quorums include everyone because recovery completes in-step.
+        if self._use_async_quorum:
+            if heal:
+                self._participating_rank = None
+                self._participating_world_size = result.max_world_size
+            else:
+                self._participating_rank = result.replica_rank
+                self._participating_world_size = result.max_world_size
+        else:
+            self._participating_rank = result.replica_rank
+            self._participating_world_size = result.replica_world_size
+
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # Bench ranks beyond the fixed size (they contribute zeros).
+            fixed = self._min_replica_size
+            self._participating_world_size = min(
+                self._participating_world_size, fixed
+            )
+            if (
+                self._participating_rank is not None
+                and self._participating_rank >= fixed
+            ):
+                self._participating_rank = None
+
+        if quorum_id_changed:
+            store_prefixed = (
+                f"{result.store_address}/torchft/{result.quorum_id}/"
+                f"{self._group_rank}"
+            )
+            self._logger.info(
+                f"reconfiguring pg: quorum {result.quorum_id}, rank "
+                f"{result.replica_rank}/{result.replica_world_size}"
+            )
+            try:
+                self._pg.configure(
+                    store_prefixed, result.replica_rank, result.replica_world_size
+                )
+                self._quorum_id = result.quorum_id
+            except Exception as e:
+                self._logger.exception(f"pg configure failed: {e}")
+                self.report_error(e)
+                return
+
+        self._commit_failures = max(self._commit_failures, result.commit_failures)
+
+        # Recovery (reference: manager.py:662-729, "recovery stream").
+        if allow_heal:
+            try:
+                if result.recover_dst_replica_ranks:
+                    self._logger.info(
+                        f"sending checkpoint to {result.recover_dst_replica_ranks}"
+                    )
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=result.recover_dst_replica_ranks,
+                        step=result.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+                if heal:
+                    self._healing = True
+                    src_client = ManagerClient(
+                        result.recover_src_manager_address, self._connect_timeout
+                    )
+                    try:
+                        metadata = src_client._checkpoint_metadata(
+                            self._group_rank, timeout=self._timeout
+                        )
+                    finally:
+                        src_client.close()
+                    self._logger.info(
+                        f"healing from replica_rank="
+                        f"{result.recover_src_replica_rank} at step "
+                        f"{result.max_step}"
+                    )
+                    state = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=(result.recover_src_replica_rank or 0),
+                        metadata=metadata,
+                        step=result.max_step,
+                        timeout=self._timeout,
+                    )
+                    # torchft state applies immediately; user state is
+                    # deferred to the main thread (manager.py:716-720).
+                    self.load_state_dict(state["torchft"])
+                    self._pending_state_dict = state["user"]
+            except Exception as e:
+                self._logger.exception(f"recovery failed: {e}")
+                self.report_error(e)
+
+    def _apply_pending_state_dict(self) -> None:
+        """Applies the healed user state from the main thread (reference:
+        manager.py:731-758)."""
+        if self._pending_state_dict is None:
+            return
+        self.wait_quorum()
+        pending, self._pending_state_dict = self._pending_state_dict, None
+        for key, value in pending.items():
+            if key in self._load_state_dicts:
+                self._load_state_dicts[key](value)
+            else:
+                self._logger.info(
+                    f"no load_state_dict registered for healed key {key!r}"
+                )
+
+    # ------------------------------------------------------------------
+    # Collectives
+    # ------------------------------------------------------------------
+
+    def allreduce(
+        self, tensors: Any, should_quantize: bool = False
+    ) -> Work:
+        """Fault-tolerant averaged allreduce across the replica axis
+        (reference: manager.py:379-450). Accepts a numpy array, jax array, or
+        list thereof; result/in-place output = input averaged over live
+        participants. Returns completed-or-failed Work; errors are latched,
+        never raised here."""
+        def to_mutable(t: Any) -> np.ndarray:
+            a = np.asarray(t)
+            if not a.flags.writeable:  # e.g. a jax array's host view
+                a = np.array(a)
+            return a
+
+        is_list = isinstance(tensors, (list, tuple))
+        arrays: List[np.ndarray] = [
+            to_mutable(t) for t in (tensors if is_list else [tensors])
+        ]
+        # Every return path keeps the contract: wait() -> list of arrays.
+        if self.errored() is not None:
+            return DummyWork(arrays)
+        try:
+            self.wait_quorum()
+        except Exception:
+            # error already latched by _async_quorum
+            return DummyWork(arrays)
+        # Non-participants (healing/spares) contribute zeros
+        # (reference: manager.py:410-411).
+        if self._participating_rank is None:
+            for a in arrays:
+                a.fill(0)
+
+        num_participants = max(self.num_participants(), 1)
+        try:
+            if should_quantize:
+                from torchft_tpu.collectives import allreduce_quantized
+
+                work = allreduce_quantized(self._pg, arrays)
+            else:
+                work = self._pg.allreduce(arrays, ReduceOp.SUM)
+        except Exception as e:
+            self._logger.exception(f"allreduce failed: {e}")
+            self.report_error(e)
+            return DummyWork(arrays)
+
+        return _ManagedWork(self, work, arrays, scale=1.0 / num_participants)
+
+    # ------------------------------------------------------------------
+    # Errors / commit protocol
+    # ------------------------------------------------------------------
+
+    def report_error(self, e: Exception) -> None:
+        """Latches an error: the step continues with no-op comms and
+        should_commit votes False (reference: manager.py:452-471)."""
+        self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        pg_error = self._pg.errored()
+        if pg_error is not None and self._errored is None:
+            self._errored = pg_error
+        return self._errored
+
+    def should_commit(self, timeout: Optional[float] = None) -> bool:
+        """Distributed commit gate (reference: manager.py:760-836)."""
+        # Join the quorum thread if nothing else has (e.g. a step with no
+        # allreduce); failures are latched, not raised.
+        if self._quorum_future is not None:
+            try:
+                self.wait_quorum()
+            except Exception:  # noqa: BLE001 - latched by _async_quorum
+                pass
+        # Apply healed user state before deciding (sync path applies in
+        # start_quorum; async path applies here, manager.py:803-804).
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        err = self.errored()
+        local_ok = (
+            err is None
+            and self._participating_world_size >= self._min_replica_size
+        )
+        try:
+            answer = self._client.should_commit(
+                self._group_rank,
+                self._step,
+                local_ok,
+                timeout=timeout if timeout is not None else self._timeout,
+            )
+        except Exception as e:
+            self._logger.exception(f"should_commit RPC failed: {e}")
+            answer = False
+
+        # Fence the serving checkpoint before mutating params
+        # (manager.py:818). The staged checkpoint is an immutable host
+        # snapshot, so a fence failure is not a correctness problem — latch
+        # rather than crash the healthy trainer.
+        try:
+            self._checkpoint_transport.disallow_checkpoint()
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"disallow_checkpoint failed: {e}")
+
+        if answer:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+            self._consecutive_commit_failures = 0
+            self._healing = False
+        else:
+            self._commit_failures += 1
+            self._consecutive_commit_failures += 1
+            if (
+                self._max_retries is not None
+                and self._consecutive_commit_failures > self._max_retries
+            ):
+                raise ExceededMaxRetriesError(
+                    f"exceeded max_retries={self._max_retries} consecutive "
+                    "commit failures"
+                )
+        self._logger.info(f"should_commit={answer} (local_ok={local_ok})")
+        return answer
+
+    # ------------------------------------------------------------------
+    # Introspection (reference: manager.py:896-946)
+    # ------------------------------------------------------------------
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def num_participants(self) -> int:
+        return self._participating_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        return self._participating_rank
+
+    def is_participating(self) -> bool:
+        return self._participating_rank is not None
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        self._checkpoint_transport.shutdown()
+        self._client.close()
+        if self._manager_server is not None:
+            self._manager_server.shutdown()
+        if self._store_server is not None:
+            self._store_server.shutdown()
+
+
+class _ManagedWork(Work):
+    """Wraps a pg Work with deferred normalization and error latching
+    (reference: _ManagedWork/_ManagedFuture, manager.py:973-1251): the
+    divide-by-N runs when the caller waits, and any failure is converted to
+    a latched manager error with the unreduced tensors returned."""
+
+    def __init__(
+        self, manager: Manager, work: Work, arrays: List[np.ndarray], scale: float
+    ) -> None:
+        self._manager = manager
+        self._work = work
+        self._arrays = arrays
+        self._scale = scale
+        self._finished = False
+        self._lock = threading.Lock()
+
+    def _finish(self, timeout: Optional[float]) -> None:
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            try:
+                self._work.wait(
+                    timeout if timeout is not None else self._manager._timeout
+                )
+                for a in self._arrays:
+                    a *= self._scale
+            except Exception as e:  # noqa: BLE001
+                self._manager._logger.exception(f"allreduce work failed: {e}")
+                self._manager.report_error(e)
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        self._finish(timeout)
+        return self._arrays
+
+    def done(self) -> bool:
+        return self._finished or self._work.done()
+
+    def exception(self) -> Optional[BaseException]:
+        return None  # errors are latched on the manager
+
+    def add_done_callback(self, fn: Callable[[Work], None]) -> None:
+        self._work.add_done_callback(lambda _w: fn(self))
+
+
+class _ManagerLogger:
+    """Prefixed logger (reference: manager.py:949-966)."""
+
+    def __init__(self, manager: Manager) -> None:
+        self._manager = manager
+
+    def _prefix(self) -> str:
+        m = self._manager
+        return (
+            f"[{m._replica_id}/{m._group_rank} - step {m._step}]"
+        )
+
+    def info(self, msg: str) -> None:
+        logger.info("%s %s", self._prefix(), msg)
+
+    def warn(self, msg: str) -> None:
+        logger.warning("%s %s", self._prefix(), msg)
+
+    def exception(self, msg: str) -> None:
+        logger.exception("%s %s", self._prefix(), msg)
