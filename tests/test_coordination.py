@@ -193,3 +193,70 @@ def test_store_basic() -> None:
     assert result["v"] == b"arrived"
     client.close()
     server.shutdown()
+
+
+def test_manager_server_dies_with_parent():
+    """kill -9 of the trainer must not orphan its manager server: a zombie
+    heartbeater permanently wedges the lighthouse's split-brain guard."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from torchft_tpu.coordination import LighthouseServer
+
+    import os as _os
+    import select
+
+    def server_alive(pid: int) -> bool:
+        # /proc-based so an unreaped zombie (state Z) counts as dead —
+        # os.kill(pid, 0) would keep succeeding on it.
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            return state not in ("Z", "X")
+        except (FileNotFoundError, ProcessLookupError, IndexError):
+            return False
+
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1)
+    child = None
+    try:
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                (
+                    "import sys, time; sys.path.insert(0, %r); "
+                    "from torchft_tpu.coordination import ManagerServer; "
+                    "ms = ManagerServer(replica_id='orphan:x', "
+                    "lighthouse_addr=%r, store_address='127.0.0.1:1/x', "
+                    "world_size=1); print('PID', ms._server._proc.pid, "
+                    "flush=True); time.sleep(60)"
+                )
+                % (str(__import__('pathlib').Path(__file__).parent.parent), lh.address()),
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        ready, _, _ = select.select([child.stdout], [], [], 30)
+        assert ready, "child never printed its server PID"
+        line = child.stdout.readline()
+        assert line.startswith("PID"), line
+        server_pid = int(line.split()[1])
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not server_alive(server_pid):
+                break  # server died with its parent
+            time.sleep(0.2)
+        else:
+            _os.kill(server_pid, signal.SIGKILL)
+            raise AssertionError(
+                f"manager server {server_pid} survived parent SIGKILL"
+            )
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+        lh.shutdown()

@@ -124,6 +124,9 @@ class Runner:
             lighthouse_addr=self.lighthouse_addr,
             group_rank=0,
             group_world_size=1,
+            # Bound retry live-lock: persistent commit failure must fail the
+            # test loudly, not spin the step loop forever.
+            max_retries=8,
         )
         self.manager_ref.append(manager)
         try:
@@ -148,9 +151,23 @@ class Runner:
 
 
 def _run_replicas(runners: List[Runner]) -> List[Dict[str, np.ndarray]]:
-    with ThreadPoolExecutor(max_workers=len(runners)) as pool:
+    # No `with`: executor __exit__ joins worker threads unconditionally, so a
+    # wedged replica would hang the whole suite instead of failing this test.
+    pool = ThreadPoolExecutor(max_workers=len(runners))
+    try:
         futures = [pool.submit(r.run) for r in runners]
         return [f.result(timeout=120) for f in futures]
+    except Exception:
+        # Tear down managers so stuck replica threads unblock and exit.
+        for r in runners:
+            for m in r.manager_ref:
+                try:
+                    m.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+        raise
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 @pytest.fixture

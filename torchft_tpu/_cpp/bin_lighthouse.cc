@@ -43,6 +43,8 @@ int main(int argc, char** argv) {
       opts.quorum_tick_ms = std::stoll(next());
     } else if (a == "--heartbeat-timeout-ms") {
       opts.heartbeat_timeout_ms = std::stoll(next());
+    } else if (a == "--parent-pid") {
+      tft::watch_parent(std::stoll(next()));
     } else {
       fprintf(stderr, "unknown flag '%s'\n%s", a.c_str(), kUsage);
       return 2;

@@ -49,6 +49,8 @@ int main(int argc, char** argv) {
       opts.connect_timeout_ms = std::stoll(next());
     } else if (a == "--quorum-retries") {
       opts.quorum_retries = std::stoll(next());
+    } else if (a == "--parent-pid") {
+      tft::watch_parent(std::stoll(next()));
     } else {
       fprintf(stderr, "unknown flag '%s'\n%s", a.c_str(), kUsage);
       return 2;

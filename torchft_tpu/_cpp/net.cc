@@ -259,6 +259,19 @@ int peek_bytes(int fd, char* buf, int n, int timeout_ms) {
   return static_cast<int>(recv(fd, buf, n, MSG_PEEK));
 }
 
+void watch_parent(int64_t parent_pid) {
+  std::thread([parent_pid] {
+    while (true) {
+      if (static_cast<int64_t>(getppid()) != parent_pid) {
+        fprintf(stderr, "parent %lld died; exiting\n",
+                static_cast<long long>(parent_pid));
+        _exit(2);
+      }
+      sleep_ms(500);
+    }
+  }).detach();
+}
+
 std::string read_http_request(int fd, int timeout_ms) {
   // Reads headers up to the blank line (control-plane GET/POSTs carry no body
   // we care about).

@@ -17,6 +17,16 @@ namespace tft {
 // same clock consistently).
 int64_t now_ms();
 
+// Starts a detached watchdog thread that _exit(2)s this process as soon as
+// getppid() != parent_pid (poll every 500 ms). Used by the control-plane
+// binaries (--parent-pid): a server orphaned by `kill -9` of its trainer
+// would keep heartbeating and wedge the lighthouse's split-brain majority
+// guard. Polling the ppid is immune to the PR_SET_PDEATHSIG pitfalls
+// (fires on spawning-*thread* exit; exec-window race under subreapers) —
+// if the parent died before this call, getppid() already differs and the
+// first poll exits.
+void watch_parent(int64_t parent_pid);
+
 // Sleep helper.
 void sleep_ms(int64_t ms);
 

@@ -88,7 +88,13 @@ def join_state(
             arr = buf.reshape(x.shape)
             if inplace_into is not None and x.index < len(inplace_leaves):
                 dst = inplace_leaves[x.index]
-                if dst is not None and dst.shape == arr.shape:
+                # Read-only leaves (np.asarray views of jax arrays) can't be
+                # written in place; fall through to the fresh buffer.
+                if (
+                    dst is not None
+                    and dst.shape == arr.shape
+                    and dst.flags.writeable
+                ):
                     np.copyto(dst, arr.astype(dst.dtype, copy=False))
                     return dst
             return arr
@@ -129,9 +135,8 @@ def _read_exact(fileobj: BinaryIO, n: int) -> bytes:
     return bytes(out)
 
 
-def load_stream(fileobj: BinaryIO, inplace_into: Optional[Any] = None) -> Any:
-    meta_len = _LEN.unpack(_read_exact(fileobj, 8))[0]
-    meta = pickle.loads(_read_exact(fileobj, meta_len))
+def collect_refs(meta: Any) -> List[_TensorRef]:
+    """All `_TensorRef`s in a meta skeleton, sorted by buffer index."""
     refs: List[_TensorRef] = []
 
     def collect(x: Any) -> None:
@@ -146,6 +151,13 @@ def load_stream(fileobj: BinaryIO, inplace_into: Optional[Any] = None) -> Any:
 
     collect(meta)
     refs.sort(key=lambda r: r.index)
+    return refs
+
+
+def load_stream(fileobj: BinaryIO, inplace_into: Optional[Any] = None) -> Any:
+    meta_len = _LEN.unpack(_read_exact(fileobj, 8))[0]
+    meta = pickle.loads(_read_exact(fileobj, meta_len))
+    refs = collect_refs(meta)
     buffers: List[Optional[np.ndarray]] = [None] * len(refs)
     for ref in refs:
         size = _LEN.unpack(_read_exact(fileobj, 8))[0]

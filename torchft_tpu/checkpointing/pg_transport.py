@@ -60,22 +60,9 @@ class PGTransport(CheckpointTransport):
         (blob,) = self._pg.recv(src_rank, tag=f"ckpt{step}.meta").wait(timeout)
         meta = pickle.loads(blob.tobytes()[: int(length[0])])
 
-        from torchft_tpu.checkpointing._serialization import _TensorRef
+        from torchft_tpu.checkpointing._serialization import collect_refs
 
-        refs: List[_TensorRef] = []
-
-        def collect(x: Any) -> None:
-            if isinstance(x, _TensorRef):
-                refs.append(x)
-            elif isinstance(x, dict):
-                for v in x.values():
-                    collect(v)
-            elif isinstance(x, (list, tuple)):
-                for v in x:
-                    collect(v)
-
-        collect(meta)
-        refs.sort(key=lambda r: r.index)
+        refs = collect_refs(meta)
         buffers: List[Optional[np.ndarray]] = [None] * len(refs)
         for ref in refs:
             (buf,) = self._pg.recv(src_rank, tag=f"ckpt{step}.t{ref.index}").wait(

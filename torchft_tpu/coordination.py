@@ -31,11 +31,17 @@ _BIN_DIR = _CPP_DIR / "bin"
 _BUILD_LOCK = threading.Lock()
 
 
+_BUILT_THIS_PROCESS = False
+
+
 def _ensure_built() -> None:
     """Builds the C++ control plane on first use (idempotent; safe across
-    concurrent processes via a file lock on the build directory)."""
-    binaries = [_BIN_DIR / "lighthouse", _BIN_DIR / "torchft_manager"]
-    if all(b.exists() for b in binaries):
+    concurrent processes via a file lock on the build directory). Always
+    invokes make — an incremental no-op when current — so stale binaries
+    can't outlive a source change (a mere existence check would run old
+    binaries that reject newer CLI flags)."""
+    global _BUILT_THIS_PROCESS
+    if _BUILT_THIS_PROCESS:
         return
     import fcntl
 
@@ -44,8 +50,6 @@ def _ensure_built() -> None:
         with open(lock_path, "w") as lock_file:
             fcntl.flock(lock_file, fcntl.LOCK_EX)
             try:
-                if all(b.exists() for b in binaries):
-                    return
                 proc = subprocess.run(
                     ["make", "-j4", "all"],
                     cwd=_CPP_DIR,
@@ -57,6 +61,7 @@ def _ensure_built() -> None:
                         "failed to build torchft_tpu C++ control plane:\n"
                         f"{proc.stderr}"
                     )
+                _BUILT_THIS_PROCESS = True
             finally:
                 fcntl.flock(lock_file, fcntl.LOCK_UN)
 
@@ -229,13 +234,24 @@ class _FramedClient:
 
 
 class _ServerProcess:
-    """A spawned control-plane binary that prints ``LISTENING <port>``."""
+    """A spawned control-plane binary that prints ``LISTENING <port>``.
+
+    Every spawn passes ``--parent-pid`` so the binary self-terminates when
+    its spawner dies: ``kill -9`` of a trainer must not orphan its manager
+    server — a zombie heartbeater makes the lighthouse count it healthy
+    forever and the split-brain majority guard then blocks every smaller
+    quorum, wedging the cluster. (The reference's Rust server runs in-process
+    via pyo3 and dies with the trainer implicitly; a child process needs this
+    wired up. The binary polls getppid() against the passed pid — unlike
+    PR_SET_PDEATHSIG it can't misfire when the spawning *thread* exits, and
+    unlike a fork preexec hook it is safe in multithreaded JAX parents.)
+    """
 
     def __init__(self, argv: List[str], name: str) -> None:
         _ensure_built()
         self._name = name
         self._proc = subprocess.Popen(
-            argv,
+            argv + ["--parent-pid", str(os.getpid())],
             stdout=subprocess.PIPE,
             stderr=None,  # inherit: server logs go to our stderr
             text=True,

@@ -1,0 +1,15 @@
+"""Model zoo for the TPU-native fault-tolerant trainer.
+
+The flagship is a Llama-3-style decoder (``torchft_tpu.models.llama``) used
+by the HSDP benchmark config (BASELINE.json config #4). The reference drives
+external models (torchtitan Llama, CIFAR CNN in train_ddp.py:116-146); here
+the models are in-repo so the framework is standalone.
+"""
+
+from torchft_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    Transformer,
+    llama3_8b,
+    llama_debug,
+    llama_small,
+)

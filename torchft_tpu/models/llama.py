@@ -1,0 +1,285 @@
+"""Llama-3-style decoder-only transformer, TPU-first.
+
+Design notes (why this is not a torch port):
+- flax.linen + einsum contractions keep every FLOP on the MXU; compute in
+  bfloat16, params in float32 (standard TPU mixed precision).
+- The layer stack is an ``nn.scan`` over a single remat'd block: one XLA
+  while-loop body compiled once regardless of depth (fast compiles, and
+  rematerialization trades HBM for FLOPs as the scaling playbook suggests).
+- Attention is pluggable: ``dense`` (single-chip / short context) or
+  ``ring`` (context parallelism over a mesh axis via shard_map + ppermute —
+  see torchft_tpu/parallel/ring_attention.py). Long-context is first-class,
+  not an afterthought.
+- Sharding is by parameter-path rules (torchft_tpu/parallel/sharding.py),
+  so the model itself stays mesh-agnostic; pjit + the rules place every
+  matmul shard on the right chips.
+
+Reference parity: the reference repo trains external models (torchtitan
+Llama for HSDP, a CIFAR CNN in train_ddp.py:116-146); this module provides
+the in-repo flagship for the BASELINE.json HSDP Llama-3-8B config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    tie_embeddings: bool = False
+    remat: bool = True
+    # 'dense' | 'ring'; ring shards the sequence over the 'sp' mesh axis.
+    attn_impl: str = "dense"
+    # Bound by parallel.train when attn_impl == 'ring'.
+    attn_fn: Optional[Callable[..., jax.Array]] = None
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+def llama3_8b(**overrides: Any) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(), **overrides)
+
+
+def llama_small(**overrides: Any) -> LlamaConfig:
+    """~125M model for single-chip benchmarking."""
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=768,
+        intermediate_size=2048,
+        num_layers=12,
+        num_heads=12,
+        num_kv_heads=4,
+        head_dim=64,
+        max_seq_len=2048,
+    )
+    return dataclasses.replace(cfg, **overrides)
+
+
+def llama_debug(**overrides: Any) -> LlamaConfig:
+    """Tiny config for tests and the driver's dryrun (CPU-friendly)."""
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        remat=False,
+    )
+    return dataclasses.replace(cfg, **overrides)
+
+
+def rope_table(
+    positions: jax.Array, head_dim: int, theta: float, dtype: Dtype
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape [..., head_dim/2] for given positions."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotary embedding on the last dim of x: [B, S, H, Dh]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    return jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Plain causal GQA attention. q: [B,S,Hq,Dh], k/v: [B,S,Hkv,Dh].
+
+    Single large einsum pair so XLA tiles it onto the MXU; softmax in fp32.
+    """
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, s, hkv, g, dh)
+    scale = dh**-0.5
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+    return out.reshape(b, s, hq, dh)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype
+        )
+        norm = x * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x), axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            features=(heads, cfg.head_dim),
+            axis=-1,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name=name,
+        )
+        q = dense(cfg.num_heads, "wq")(x)
+        k = dense(cfg.num_kv_heads, "wk")(x)
+        v = dense(cfg.num_kv_heads, "wv")(x)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if cfg.attn_impl == "ring":
+            assert cfg.attn_fn is not None, "ring attention needs cfg.attn_fn"
+            out = cfg.attn_fn(q, k, v)
+        else:
+            out = dense_attention(q, k, v)
+        return nn.DenseGeneral(
+            features=cfg.hidden_size,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="wo",
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        proj = lambda f, name: nn.Dense(  # noqa: E731
+            f,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name=name,
+        )
+        gate = proj(cfg.intermediate_size, "gate")(x)
+        up = proj(cfg.intermediate_size, "up")(x)
+        return proj(cfg.hidden_size, "down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, cos: jax.Array, sin: jax.Array
+    ) -> jax.Array:
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x), cos, sin
+        )
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
+        )
+        return x
+
+
+class _ScanBlock(Block):
+    """Block with the (carry, ys) return contract nn.scan requires."""
+
+    @nn.compact
+    def __call__(self, x, cos, sin):  # type: ignore[override]
+        return super().__call__(x, cos, sin), None
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM. __call__(tokens [B,S], positions [B,S]) -> logits."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed",
+        )
+        x = embed(tokens)
+        cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta, cfg.dtype)
+
+        block = _ScanBlock
+        if cfg.remat:
+            block = nn.remat(
+                _ScanBlock,
+                prevent_cse=False,
+                static_argnums=(),
+            )
+        # One compiled body for the whole stack: params get a leading
+        # [num_layers] dim which the sharding rules treat as unsharded.
+        stack = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.num_layers,
+            in_axes=(nn.broadcast, nn.broadcast),
+        )(cfg, name="layers")
+        x, _ = stack(x, cos, sin)
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)

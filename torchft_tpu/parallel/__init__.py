@@ -1,0 +1,27 @@
+"""Parallelism layer: device meshes, sharding rules, ring attention, and the
+sharded train step.
+
+TPU-first equivalent of the reference's composition story (SURVEY.md §2.3):
+inner axes (data/FSDP/TP/sequence) are native ``jax.sharding.Mesh`` axes —
+XLA inserts the ICI collectives; the fault-tolerant *replica* axis stays
+outside the compiled program and is carried by the Manager over DCN
+(reference: torchft/device_mesh.py:50-336 splices a ManagedProcessGroup into
+a torch DeviceMesh; here the managed axis wraps the jax mesh instead).
+"""
+
+from torchft_tpu.parallel.mesh import MESH_AXES, auto_mesh, make_mesh  # noqa: F401
+from torchft_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_shardings,
+    param_specs,
+)
+from torchft_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention,
+    ring_attention_shard,
+)
+from torchft_tpu.parallel.train import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_train_step,
+)

@@ -1,0 +1,79 @@
+"""Mesh construction for the inner (SPMD) axes.
+
+Axes, in physical-locality order (outermost = slowest-varying over the
+device order, so ``tp``/``sp`` land on ICI-adjacent chips):
+
+- ``dp``   pure data parallelism (gradients all-reduced by XLA),
+- ``fsdp`` sharded data parallelism (params/opt state sharded, all-gathered
+           per layer by XLA — the HSDP inner axis of BASELINE config #4),
+- ``sp``   sequence/context parallelism (ring attention over this axis),
+- ``tp``   tensor parallelism (innermost: highest-bandwidth neighbors).
+
+The fault-tolerant replica axis is deliberately NOT a mesh axis — it is the
+Manager's host-side axis over DCN (see torchft_tpu/device_mesh.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "fsdp", "sp", "tp")
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = dp * fsdp * sp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def auto_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Factor ``n_devices`` into a (dp, fsdp, sp, tp) mesh that exercises
+    every axis it can: repeatedly gives the smallest prime factor to the
+    axis with the smallest current size, preferring fsdp > tp > sp > dp
+    (matches the HSDP flagship config where fsdp carries most of the
+    scaling and tp/sp stay within ICI reach)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    sizes = {"dp": 1, "fsdp": 1, "sp": 1, "tp": 1}
+    priority = ("fsdp", "tp", "sp", "dp")
+
+    def prime_factors(n: int) -> list:
+        out, d = [], 2
+        while d * d <= n:
+            while n % d == 0:
+                out.append(d)
+                n //= d
+            d += 1
+        if n > 1:
+            out.append(n)
+        return sorted(out, reverse=True)
+
+    for f in prime_factors(n_devices):
+        target = min(priority, key=lambda a: (sizes[a], priority.index(a)))
+        sizes[target] *= f
+    return make_mesh(
+        dp=sizes["dp"],
+        fsdp=sizes["fsdp"],
+        sp=sizes["sp"],
+        tp=sizes["tp"],
+        devices=devices,
+    )

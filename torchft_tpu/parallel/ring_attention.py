@@ -1,0 +1,130 @@
+"""Ring attention: exact causal attention with the sequence sharded over a
+mesh axis (context parallelism for long sequences).
+
+Each chip holds one query block and streams every key/value block past it on
+the ICI ring via ``ppermute``, folding each block into a numerically-stable
+online softmax (flash-attention accumulation in fp32). Communication
+overlaps compute — XLA schedules the ppermute DMA of block i+1 against the
+matmuls of block i.
+
+The reference has no long-context code (SURVEY.md §2.3: CP/ring absent —
+delegated to torchtitan); here it is first-class because the TPU design
+treats sequence as just another mesh axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax>=0.4.35 moved shard_map to the public namespace
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    # The replication-check kwarg was renamed check_rep -> check_vma across
+    # jax versions; we need it off (ppermute inside fori_loop).
+    try:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+def ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard body (run under shard_map). q: [B, Sq, Hq, Dh] local block;
+    k/v: [B, Skv, Hkv, Dh] local block. Returns [B, Sq, Hq, Dh]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, dh = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    scale = dh**-0.5
+    qg = q.reshape(b, sq, hkv, g, dh).astype(jnp.float32) * scale
+    q_pos = idx * sq + jnp.arange(sq)
+
+    m0 = jnp.full((b, hkv, g, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, sq, dh), jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def fold(i, k_blk, v_blk, m, l, acc):
+        # After i forward rotations this chip holds the block that started
+        # on chip (idx - i) mod axis_size.
+        src = (idx - i) % axis_size
+        scores = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, k_blk.astype(jnp.float32)
+        )
+        if causal:
+            k_pos = src * skv + jnp.arange(skv)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        correction = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
+        )
+        probs = jnp.exp(scores - safe_m[..., None])  # masked -> exp(-inf)=0
+        l = l * correction + probs.sum(axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bkgqs,bskd->bkgqd", probs, v_blk.astype(jnp.float32)
+        )
+        return new_m, l, acc
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        m, l, acc = fold(i, k_blk, v_blk, m, l, acc)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, acc
+
+    # Rotate only axis_size-1 times; the last block folds outside the loop
+    # so its ppermute (whose result would be discarded) is never issued.
+    k_blk, v_blk, m, l, acc = jax.lax.fori_loop(
+        0, axis_size - 1, body, (k, v, m0, l0, acc0)
+    )
+    _, l, acc = fold(axis_size - 1, k_blk, v_blk, m, l, acc)
+    out = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, dh)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+):
+    """Returns attn_fn(q, k, v) usable inside a pjit'd program: shards
+    [B, S, H, Dh] with batch over ``batch_axes``, sequence over ``seq_axis``,
+    heads over ``head_axis``, and runs the ring per shard."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def attn_fn(q, k, v):
+        return ring_attention_shard(q, k, v, axis_name=seq_axis, causal=causal)
+
+    return attn_fn

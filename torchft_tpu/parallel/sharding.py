@@ -1,0 +1,99 @@
+"""Parameter-path sharding rules for the Llama family.
+
+The model stays mesh-agnostic; these rules map each parameter to a
+PartitionSpec over the (dp, fsdp, sp, tp) mesh. The scan-stacked layer dim
+(leading axis of every ``layers/*`` param) is unsharded — XLA scans over it.
+
+Layout (standard HSDP+TP recipe, cf. the public scaling playbook):
+- contraction-input dims shard over ``fsdp`` (all-gathered per layer),
+- head/feature output dims shard over ``tp`` (ICI-adjacent),
+- norms replicate; activations shard batch over (dp, fsdp) and sequence
+  over ``sp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# name of innermost param container -> spec for the trailing dims
+_RULES: Dict[Tuple[str, str], Tuple[Any, ...]] = {
+    ("embed", "embedding"): ("tp", "fsdp"),
+    ("wq", "kernel"): ("fsdp", "tp", None),
+    ("wk", "kernel"): ("fsdp", "tp", None),
+    ("wv", "kernel"): ("fsdp", "tp", None),
+    ("wo", "kernel"): ("tp", None, "fsdp"),
+    ("gate", "kernel"): ("fsdp", "tp"),
+    ("up", "kernel"): ("fsdp", "tp"),
+    ("down", "kernel"): ("tp", "fsdp"),
+    ("lm_head", "kernel"): ("fsdp", "tp"),
+}
+
+
+def _spec_for(path: Tuple[str, ...], ndim: int) -> P:
+    key = tuple(path[-2:]) if len(path) >= 2 else tuple(path)
+    rule = _RULES.get(key)  # type: ignore[arg-type]
+    if rule is None:
+        return P()  # norms / scalars: replicated
+    pad = ndim - len(rule)
+    return P(*((None,) * pad + tuple(rule)))
+
+
+def _path_keys(path) -> Tuple[str, ...]:
+    keys = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            keys.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            keys.append(str(entry.idx))
+        else:
+            keys.append(str(entry))
+    return tuple(keys)
+
+
+def param_specs(params: Any) -> Any:
+    """Pytree of PartitionSpec matching ``params`` (works on real arrays or
+    ShapeDtypeStructs)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for(_path_keys(path), leaf.ndim), params
+    )
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(params)
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, S]-shaped token batches: batch over (dp, fsdp), seq over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def tree_specs_like(tree: Any, params_spec_by_path: Dict[Tuple[str, ...], P]) -> Any:
+    """Specs for an arbitrary pytree (e.g. optax state) whose leaves mirror
+    parameter subtrees: a leaf whose path *ends with* a known param path gets
+    that param's spec; everything else (counts, scalars) replicates."""
+
+    def lookup(path, leaf):
+        keys = _path_keys(path)
+        for start in range(len(keys)):
+            suffix = keys[start:]
+            if suffix in params_spec_by_path:
+                return params_spec_by_path[suffix]
+        return P()
+
+    return jax.tree_util.tree_map_with_path(lookup, tree)
+
+
+def params_spec_dict(params: Any) -> Dict[Tuple[str, ...], P]:
+    out: Dict[Tuple[str, ...], P] = {}
+
+    def record(path, leaf):
+        out[_path_keys(path)] = _spec_for(_path_keys(path), leaf.ndim)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(record, params)
+    return out

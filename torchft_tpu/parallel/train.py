@@ -1,0 +1,184 @@
+"""Sharded training step: init, loss, grads, optimizer update — all compiled
+as one pjit program over the (dp, fsdp, sp, tp) mesh.
+
+This is the inner (per-replica-group) step of the fault-tolerant trainer:
+everything here rides ICI via XLA collectives; the outer replica-axis
+gradient/pseudograd averaging is host-driven by the Manager (DDP: per-step;
+DiLoCo: per-outer-step). Reference analog: the torchtitan train step the
+reference composes with (SURVEY.md §2.3) — here it is in-repo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models.llama import LlamaConfig, Transformer
+from torchft_tpu.parallel.ring_attention import make_ring_attention
+from torchft_tpu.parallel.sharding import (
+    batch_sharding,
+    param_specs,
+    params_spec_dict,
+    tree_specs_like,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def build_model(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Transformer:
+    """Binds ring attention to the mesh when requested."""
+    if cfg.attn_impl == "ring":
+        assert mesh is not None, "ring attention requires a mesh"
+        cfg = dataclasses.replace(cfg, attn_fn=make_ring_attention(mesh))
+    return Transformer(cfg)
+
+
+def state_shardings(
+    model: Transformer,
+    mesh: Mesh,
+    sample_tokens_shape: Tuple[int, int],
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> TrainState:
+    """TrainState-of-NamedShardings, derived from abstract init (no FLOPs)."""
+    optimizer = optimizer or _DEFAULT_OPT
+
+    def abstract_init():
+        tokens = jnp.zeros(sample_tokens_shape, jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        return params
+
+    params_shape = jax.eval_shape(abstract_init)
+    specs = param_specs(params_shape)
+    spec_dict = params_spec_dict(params_shape)
+    opt_shape = jax.eval_shape(lambda p: optimizer.init(p), params_shape)
+    opt_specs = tree_specs_like(opt_shape, spec_dict)
+    to_sharding = lambda s: NamedSharding(mesh, s)  # noqa: E731
+    return TrainState(
+        step=to_sharding(P()),
+        params=jax.tree_util.tree_map(to_sharding, specs),
+        opt_state=jax.tree_util.tree_map(
+            to_sharding, opt_specs, is_leaf=lambda x: isinstance(x, P)
+        ),
+    )
+
+
+_DEFAULT_OPT = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
+def init_train_state(
+    model: Transformer,
+    mesh: Mesh,
+    rng: jax.Array,
+    sample_tokens_shape: Tuple[int, int],
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> Tuple[TrainState, TrainState]:
+    """Initializes the state *born sharded* (out_shardings on init — no
+    host-side full copy, required at 8B scale). Returns (state, shardings)."""
+    optimizer = optimizer or _DEFAULT_OPT
+    shardings = state_shardings(model, mesh, sample_tokens_shape, optimizer)
+
+    def init_fn(rng):
+        tokens = jnp.zeros(sample_tokens_shape, jnp.int32)
+        params = model.init(rng, tokens)["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def _loss_fn(model: Transformer, params, inputs, targets, mask):
+    logits = model.apply({"params": params}, inputs)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = mask.astype(jnp.float32)
+    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(
+    model: Transformer,
+    mesh: Mesh,
+    shardings: TrainState,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    donate: bool = True,
+) -> Callable[[TrainState, Any], Tuple[TrainState, Any]]:
+    """batch = {"inputs": [B,S] i32, "targets": [B,S] i32, "mask": [B,S]}.
+    Returns jitted (state, batch) -> (state, metrics)."""
+    optimizer = optimizer or _DEFAULT_OPT
+    bsh = batch_sharding(mesh)
+    batch_sh = {"inputs": bsh, "targets": bsh, "mask": bsh}
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Any]:
+        inputs = jax.lax.with_sharding_constraint(
+            batch["inputs"], bsh
+        )
+        loss, grads = jax.value_and_grad(
+            lambda p: _loss_fn(
+                model, p, inputs, batch["targets"], batch["mask"]
+            )
+        )(state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state
+        )
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_grad_step(
+    model: Transformer,
+    mesh: Mesh,
+    shardings: TrainState,
+) -> Callable[[Any, Any], Tuple[jax.Array, Any]]:
+    """(params, batch) -> (loss, grads): the DDP variant where the optimizer
+    update is applied *after* the Manager's outer-axis gradient allreduce."""
+    bsh = batch_sharding(mesh)
+    batch_sh = {"inputs": bsh, "targets": bsh, "mask": bsh}
+
+    def fn(params, batch):
+        return jax.value_and_grad(
+            lambda p: _loss_fn(
+                model, p, batch["inputs"], batch["targets"], batch["mask"]
+            )
+        )(params)
+
+    return jax.jit(
+        fn,
+        in_shardings=(shardings.params, batch_sh),
+        out_shardings=(None, shardings.params),
+    )
+
+
+def make_eval_step(model: Transformer, mesh: Mesh, shardings: TrainState):
+    bsh = batch_sharding(mesh)
+    batch_sh = {"inputs": bsh, "targets": bsh, "mask": bsh}
+
+    def fn(params, batch):
+        return _loss_fn(
+            model, params, batch["inputs"], batch["targets"], batch["mask"]
+        )
+
+    return jax.jit(fn, in_shardings=(shardings.params, batch_sh))

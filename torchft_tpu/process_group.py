@@ -147,7 +147,14 @@ class _PeerConn:
             while True:
                 header = _net.recv_json(self.sock)
                 payload = _net.recv_frame(self.sock)
-                self._queue(header["tag"]).put((header, payload))
+                # Put under the lock so recv()'s delete-when-empty can never
+                # strand a message in an unlinked queue.
+                with self._queues_lock:
+                    tag = header["tag"]
+                    q = self._queues.get(tag)
+                    if q is None:
+                        q = self._queues[tag] = queue_mod.Queue()
+                    q.put((header, payload))
         except Exception as e:  # noqa: BLE001 - propagate to all waiters
             self.dead = e if isinstance(e, Exception) else RuntimeError(str(e))
             with self._queues_lock:
@@ -176,6 +183,12 @@ class _PeerConn:
             self._queue(tag).put(item)
             raise RuntimeError(f"connection to rank {self.peer} died") from item
         header, payload = item
+        # Tags are single-use per message: drop the drained queue so a long
+        # stable-quorum run doesn't accumulate one dead Queue per collective.
+        with self._queues_lock:
+            q = self._queues.get(tag)
+            if q is not None and q.empty():
+                del self._queues[tag]
         return np.frombuffer(payload, dtype=np.dtype(header["dtype"])).reshape(
             header["shape"]
         ).copy()
@@ -222,7 +235,6 @@ class ProcessGroupSocket(ProcessGroup):
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._configure_lock = threading.Lock()
-        self._generation = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -232,7 +244,6 @@ class ProcessGroupSocket(ProcessGroup):
             self._errored = None
             self._rank = rank
             self._world = world_size
-            self._generation += 1
             # Collective tags restart at every (re)configure: configure is a
             # quorum boundary, so all members agree on the sequence again —
             # a restarted member would otherwise never match a survivor's tags.
