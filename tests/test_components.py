@@ -1,0 +1,156 @@
+"""Tests for DistributedSampler, ManagedMesh, and the parameter server."""
+
+import numpy as np
+import pytest
+
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.device_mesh import ManagedMesh, ft_init_device_mesh
+from torchft_tpu.parallel import make_mesh
+from torchft_tpu.parameter_server import ParameterServer, ParameterServerClient
+
+
+# ---------------------------------------------------------------------------
+# DistributedSampler (reference: data.py:24-77, data_test.py)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_partitions_disjoint_and_complete():
+    n = 100
+    grid = [(r, g) for r in range(2) for g in range(2)]
+    all_idx = []
+    for replica_rank, group_rank in grid:
+        s = DistributedSampler(
+            n,
+            replica_rank=replica_rank,
+            num_replica_groups=2,
+            group_rank=group_rank,
+            num_replicas=2,
+            shuffle=True,
+            seed=7,
+        )
+        idx = list(s)
+        assert len(idx) == len(s) == 25
+        all_idx.extend(idx)
+    assert sorted(all_idx) == list(range(100))
+
+
+def test_sampler_epoch_determinism_and_reshuffle():
+    s = DistributedSampler(50, 0, 2, shuffle=True, seed=1)
+    e0 = list(s)
+    assert e0 == list(s)  # same epoch -> same order
+    s.set_epoch(1)
+    assert e0 != list(s)  # new epoch -> reshuffled
+
+
+def test_sampler_global_rank_formula():
+    # global_rank = group_rank + num_replicas * replica_rank (data.py:24-77)
+    s = DistributedSampler(10, replica_rank=1, num_replica_groups=2,
+                           group_rank=1, num_replicas=3)
+    assert s.global_rank == 1 + 3 * 1
+    assert s.global_world_size == 6
+    with pytest.raises(ValueError):
+        DistributedSampler(10, replica_rank=2, num_replica_groups=2)
+
+
+def test_sampler_drop_last_false_pads():
+    s = DistributedSampler(7, 0, 2, shuffle=False, drop_last=False)
+    s2 = DistributedSampler(7, 1, 2, shuffle=False, drop_last=False)
+    assert len(list(s)) == len(list(s2)) == 4
+
+
+# ---------------------------------------------------------------------------
+# ManagedMesh (reference: device_mesh.py:50-336)
+# ---------------------------------------------------------------------------
+
+
+class _FakeManager:
+    def __init__(self):
+        self.participants = 3
+        self.rank = 1
+        self.allreduced = []
+
+    def num_participants(self):
+        return self.participants
+
+    def participating_rank(self):
+        return self.rank
+
+    def allreduce(self, tensors, should_quantize=False):
+        from torchft_tpu.work import DummyWork
+
+        arrays = [np.array(t) for t in (
+            tensors if isinstance(tensors, list) else [tensors]
+        )]
+        self.allreduced.append(arrays)
+        return DummyWork(arrays)
+
+
+def test_managed_mesh_dynamic_replica_size():
+    mesh = make_mesh(dp=1, fsdp=2, sp=2, tp=2)
+    fm = _FakeManager()
+    mm = ManagedMesh(fm, mesh)
+    assert mm.axis_names == ("replica", "dp", "fsdp", "sp", "tp")
+    assert mm.size("replica") == 3
+    assert mm.size("fsdp") == 2
+    assert mm.size() == 3 * 8
+    fm.participants = 0  # pre-quorum: clamped to 1 (device_mesh.py:165-180)
+    assert mm.size("replica") == 1
+    assert mm.replica_rank() == 1
+
+
+def test_managed_mesh_outer_allreduce_roundtrip():
+    mesh = make_mesh(dp=1, fsdp=2, sp=2, tp=2)
+    fm = _FakeManager()
+    mm = ManagedMesh(fm, mesh)
+    grads = {"a": np.ones((8, 8), np.float32), "b": np.ones((4,), np.float32)}
+    out = mm.allreduce_grads(grads)
+    assert set(out) == {"a", "b"}
+    assert out["a"].shape == (8, 8)
+    assert fm.allreduced  # went through the manager
+
+
+def test_ft_init_device_mesh():
+    fm = _FakeManager()
+    mm = ft_init_device_mesh(fm, fsdp=2, tp=2, sp=2)
+    assert mm.inner_size() == 8
+
+
+# ---------------------------------------------------------------------------
+# Parameter server (reference: parameter_server.py:31-195)
+# ---------------------------------------------------------------------------
+
+
+def test_parameter_server_sessions():
+    class Doubler(ParameterServer):
+        def forward(self, session_id, request):
+            return request * 2.0
+
+    server = Doubler()
+    try:
+        c1 = ParameterServerClient(server.address(), timeout=15.0)
+        c2 = ParameterServerClient(server.address(), timeout=15.0)
+        try:
+            r1 = c1.call(np.full((4,), 3.0, np.float32))
+            r2 = c2.call(np.full((2, 2), 5.0, np.float32))
+            np.testing.assert_allclose(r1, np.full((4,), 6.0))
+            np.testing.assert_allclose(r2, np.full((2, 2), 10.0))
+            # sessions are independent and reusable
+            np.testing.assert_allclose(
+                c1.call(np.ones(1, np.float32)), np.full((1,), 2.0)
+            )
+        finally:
+            c1.close()
+            c2.close()
+    finally:
+        server.shutdown()
+
+
+def test_sampler_tiny_dataset_large_world():
+    # pad > dataset_len: every rank still gets exactly len(self) indices
+    for rank in range(8):
+        s = DistributedSampler(
+            3, replica_rank=rank // 4, num_replica_groups=2,
+            group_rank=rank % 4, num_replicas=4,
+            shuffle=False, drop_last=False,
+        )
+        assert len(list(s)) == len(s) == 1

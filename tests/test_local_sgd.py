@@ -1,0 +1,268 @@
+"""LocalSGD / DiLoCo tests.
+
+Unit tests drive the schedule/bookkeeping against a fake manager (reference
+style: local_sgd_test.py with create_autospec(Manager)); the integration
+test runs two replica-group threads against a real lighthouse + managers
+and asserts bitwise-equal global state (reference: local_sgd_integ_test.py).
+"""
+
+from typing import Any, List
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD, partition_fragments
+from torchft_tpu.work import DummyWork
+
+
+class FakeManager:
+    """Just enough Manager surface for the schedule tests."""
+
+    def __init__(self) -> None:
+        self.allreduce_calls: List[List[np.ndarray]] = []
+        self.quorums = 0
+        self.commits = 0
+        self.commit_answer = True
+        self.num = 2
+        self._step = 0
+        self.registered = {}
+
+    def register_state_dict_fn(self, key, state_fn, load_fn):
+        self.registered[key] = (state_fn, load_fn)
+
+    def start_quorum(self, **kw):
+        self.quorums += 1
+
+    def allreduce(self, tensors, should_quantize=False):
+        arrays = [np.array(t, dtype=np.float32) for t in tensors]
+        # Simulate averaging with a peer holding zeros: result = x / num.
+        out = [a / self.num for a in arrays]
+        self.allreduce_calls.append(arrays)
+        return DummyWork(out)
+
+    def should_commit(self, **kw):
+        self.commits += 1
+        if self.commit_answer:
+            self._step += 1
+        return self.commit_answer
+
+    def current_step(self):
+        return self._step
+
+
+def make_params():
+    return {
+        "w": np.full((4, 4), 2.0, np.float32),
+        "b": np.full((4,), 4.0, np.float32),
+    }
+
+
+class Box:
+    def __init__(self, params: Any) -> None:
+        self.params = params
+
+    def get(self):
+        return self.params
+
+    def set(self, p):
+        self.params = {k: np.asarray(v) for k, v in p.items()}
+
+
+def test_local_sgd_schedule_and_average():
+    m = FakeManager()
+    box = Box(make_params())
+    ls = LocalSGD(m, box.get, box.set, sync_every=3)
+    assert ls.step() is None
+    assert ls.step() is None
+    assert m.quorums == 0
+    committed = ls.step()  # third step syncs
+    assert committed is True
+    assert m.quorums == 1
+    # averaged with the fake's zero-peer: halved
+    np.testing.assert_allclose(box.params["w"], np.full((4, 4), 1.0))
+    np.testing.assert_allclose(box.params["b"], np.full((4,), 2.0))
+    # healed-state registry present
+    assert "LocalSGD" in m.registered
+
+
+def test_local_sgd_failed_commit_keeps_params():
+    m = FakeManager()
+    m.commit_answer = False
+    box = Box(make_params())
+    ls = LocalSGD(m, box.get, box.set, sync_every=1)
+    assert ls.step() is False
+    np.testing.assert_allclose(box.params["w"], np.full((4, 4), 2.0))
+
+
+def test_diloco_validation():
+    m = FakeManager()
+    box = Box(make_params())
+    frag = (["w", "b"], box.get, box.set)
+    with pytest.raises(ValueError):
+        DiLoCo(m, [frag, frag], sync_every=3)  # 3 % 2 != 0
+    with pytest.raises(ValueError):
+        DiLoCo(m, [frag], sync_every=4, fragment_sync_delay=4)
+    with pytest.raises(ValueError):
+        DiLoCo(m, [frag], sync_every=4, fragment_update_alpha=1.5)
+
+
+def test_diloco_single_fragment_outer_sgd():
+    """Pseudograd math: backup=2, local drifts to 0 -> pseudograd=2;
+    fake manager halves it (zero peer); outer sgd lr=1 -> global = 2 - 1."""
+    m = FakeManager()
+    box = Box(make_params())
+    diloco = DiLoCo(
+        m,
+        [(["w", "b"], box.get, box.set)],
+        sync_every=2,
+        outer_optimizer=optax.sgd(1.0),
+    )
+    # drift local params to zero (as if inner steps ran)
+    box.set({"w": np.zeros((4, 4)), "b": np.zeros(4)})
+    assert diloco.step() is None  # local step 1
+    committed = diloco.step()  # local step 2: sync
+    assert committed is True
+    # backup was w=2: pseudograd=2-0=2, averaged -> 1, sgd lr=1 -> 2-1=1
+    np.testing.assert_allclose(box.params["w"], np.full((4, 4), 1.0))
+    assert m.quorums == 1
+
+
+def test_diloco_failed_sync_restores_global():
+    m = FakeManager()
+    m.commit_answer = False
+    box = Box(make_params())
+    diloco = DiLoCo(
+        m, [(["w", "b"], box.get, box.set)], sync_every=1,
+    )
+    box.set({"w": np.zeros((4, 4)), "b": np.zeros(4)})
+    committed = diloco.step()
+    assert committed is False
+    # reset to last global state (the initial backup)
+    np.testing.assert_allclose(box.params["w"], np.full((4, 4), 2.0))
+
+
+def test_streaming_fragments_round_robin():
+    m = FakeManager()
+    box = Box(make_params())
+
+    def getter(keys):
+        return lambda: {k: box.params[k] for k in keys}
+
+    def setter(keys):
+        def s(p):
+            for k in keys:
+                box.params[k] = np.asarray(p[k])
+
+        return s
+
+    diloco = DiLoCo(
+        m,
+        [(["w"], getter(["w"]), setter(["w"])),
+         (["b"], getter(["b"]), setter(["b"]))],
+        sync_every=4,
+        fragment_sync_delay=1,
+    )
+    for i in range(8):
+        diloco.step()
+    # two syncs happened (steps 4 and 8), one per fragment
+    assert m.quorums == 2
+    assert m.commits == 2
+    # allreduce payloads alternate fragments: first w (16 elems), then b (4)
+    assert [a[0].size for a in m.allreduce_calls] == [16, 4]
+
+
+def test_partition_fragments_balanced():
+    params = {
+        "a": np.zeros((100,)),
+        "b": np.zeros((100,)),
+        "c": np.zeros((100,)),
+        "d": np.zeros((100,)),
+    }
+    groups = partition_fragments(params, 2)
+    assert len(groups) == 2
+    assert sum(len(g) for g in groups) == 4
+    assert all(groups)
+
+
+def test_diloco_integration_two_replicas():
+    """Two replica-group threads, real lighthouse + managers: after N inner
+    steps with replica-dependent drift, both replicas' *global* (backup)
+    state is bitwise identical (reference: local_sgd_integ_test.py:132-167)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupSocket
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+        quorum_tick_ms=20,
+    )
+    results = {}
+
+    def run(replica: int):
+        box = Box(make_params())
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            min_replica_size=2,
+            use_async_quorum=False,
+            timeout=15.0,
+            quorum_timeout=20.0,
+            replica_id=f"diloco{replica}",
+            lighthouse_addr=lighthouse.address(),
+            group_rank=0,
+            group_world_size=1,
+            max_retries=5,
+        )
+        diloco = DiLoCo(
+            manager,
+            [(["w", "b"], box.get, box.set)],
+            sync_every=2,
+            outer_optimizer=optax.sgd(0.5),
+        )
+        try:
+            for inner in range(6):
+                # Replica-dependent drift: local params diverge, the outer
+                # sync must re-converge the global state.
+                box.set({
+                    "w": box.params["w"] - 0.1 * (replica + 1),
+                    "b": box.params["b"] - 0.05 * (replica + 1),
+                })
+                diloco.step()
+            return {
+                "backup": {
+                    k: np.asarray(v).copy()
+                    for k, v in diloco.fragments[0]._backup.items()
+                }
+            }
+        finally:
+            manager.shutdown()
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = {r: pool.submit(run, r) for r in (0, 1)}
+            results = {r: f.result(timeout=90) for r, f in futs.items()}
+    finally:
+        lighthouse.shutdown()
+
+    for key in ("w", "b"):
+        np.testing.assert_array_equal(
+            results[0]["backup"][key], results[1]["backup"][key]
+        )
+
+
+def test_partition_fragments_front_loaded_sizes():
+    # One giant key followed by small ones must still fill every fragment.
+    params = {
+        "big": np.zeros((1000,)),
+        "s1": np.zeros((1,)),
+        "s2": np.zeros((1,)),
+        "s3": np.zeros((1,)),
+    }
+    groups = partition_fragments(params, 4)
+    assert len(groups) == 4
+    assert all(groups), groups
+
+    with pytest.raises(ValueError):
+        partition_fragments({"only": np.zeros(1)}, 2)

@@ -11,4 +11,41 @@ fault-tolerant replica axis runs host-driven over DCN.
 
 __version__ = "0.1.0"
 
-__all__ = []  # populated as runtime modules land; see torchft_tpu.manager etc.
+from torchft_tpu.data import DistributedSampler  # noqa: E402,F401
+from torchft_tpu.ddp import (  # noqa: E402,F401
+    DistributedDataParallel,
+    PureDistributedDataParallel,
+)
+from torchft_tpu.device_mesh import (  # noqa: E402,F401
+    ManagedMesh,
+    ft_init_device_mesh,
+)
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD  # noqa: E402,F401
+from torchft_tpu.manager import Manager, WorldSizeMode  # noqa: E402,F401
+from torchft_tpu.optim import OptimizerWrapper  # noqa: E402,F401
+from torchft_tpu.process_group import (  # noqa: E402,F401
+    ManagedProcessGroup,
+    ProcessGroup,
+    ProcessGroupDummy,
+    ProcessGroupSocket,
+    ReduceOp,
+)
+
+__all__ = [
+    "DiLoCo",
+    "DistributedDataParallel",
+    "DistributedSampler",
+    "LocalSGD",
+    "ManagedMesh",
+    "ManagedProcessGroup",
+    "Manager",
+    "OptimizerWrapper",
+    "ProcessGroup",
+    "ProcessGroupDummy",
+    "ProcessGroupSocket",
+    "PureDistributedDataParallel",
+    "ReduceOp",
+    "WorldSizeMode",
+    "ft_init_device_mesh",
+    "__version__",
+]

@@ -44,6 +44,18 @@ def _ensure_built() -> None:
     if _BUILT_THIS_PROCESS:
         return
     import fcntl
+    import shutil
+
+    if shutil.which("make") is None:
+        # Toolchain-free deployment image: accept prebuilt binaries.
+        binaries = [_BIN_DIR / "lighthouse", _BIN_DIR / "torchft_manager"]
+        if all(b.exists() for b in binaries):
+            _BUILT_THIS_PROCESS = True
+            return
+        raise RuntimeError(
+            "torchft_tpu C++ control plane is not built and `make` is not "
+            f"on PATH; prebuild {_BIN_DIR} or install a toolchain"
+        )
 
     with _BUILD_LOCK:
         lock_path = _CPP_DIR / ".build.lock"

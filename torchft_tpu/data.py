@@ -1,0 +1,86 @@
+"""Data sharding across the fault-tolerant replica axis.
+
+Reference: ``torchft/data.py:24-77`` — a DistributedSampler that treats the
+job as ``num_replica_groups x num_replicas`` workers with
+``global_rank = group_rank + num_replicas * replica_rank``; documented as
+lossy under faults (a failed group's shard for that step is simply dropped).
+
+JAX translation: no torch DataLoader; the sampler yields index streams (or
+shards a numpy array of indices) usable by any host data pipeline. For
+replica-group-local determinism, pair with the Manager's
+``batches_committed()`` to resume the stream after heal (the reference
+recommends torchdata StatefulDataLoader for the same reason, data.py:13-14).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Shards ``dataset_len`` indices over the global worker grid.
+
+    Args:
+        dataset_len: number of examples.
+        replica_rank: this replica group's rank on the FT axis.
+        num_replica_groups: total replica groups (the FT world size the job
+            was *launched* with; membership changes drop shards, they don't
+            reshuffle).
+        group_rank / num_replicas: position inside the replica group (the
+            inner DP axis), matching the reference's rank/num_replicas.
+        shuffle / seed: epoch-deterministic shuffling shared by all workers.
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        replica_rank: int,
+        num_replica_groups: int,
+        group_rank: int = 0,
+        num_replicas: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> None:
+        if num_replica_groups < 1 or num_replicas < 1:
+            raise ValueError("world dims must be >= 1")
+        self._len = dataset_len
+        self.global_rank = group_rank + num_replicas * replica_rank
+        self.global_world_size = num_replicas * num_replica_groups
+        if self.global_rank >= self.global_world_size:
+            raise ValueError(
+                f"global_rank {self.global_rank} >= world "
+                f"{self.global_world_size}"
+            )
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        if self._drop_last:
+            return self._len // self.global_world_size
+        return (self._len + self.global_world_size - 1) // self.global_world_size
+
+    def indices(self) -> np.ndarray:
+        order = np.arange(self._len)
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(order)
+        if self._drop_last:
+            usable = len(self) * self.global_world_size
+            order = order[:usable]
+        else:
+            # Cyclic repeat covers pads larger than the dataset itself
+            # (tiny datasets on large worlds), so every rank gets exactly
+            # len(self) indices and loops stay in lockstep.
+            order = np.resize(order, len(self) * self.global_world_size)
+        return order[self.global_rank :: self.global_world_size]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices().tolist())

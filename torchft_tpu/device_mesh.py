@@ -1,0 +1,129 @@
+"""ManagedMesh: splice the fault-tolerant replica axis onto a JAX mesh.
+
+Reference: ``torchft/device_mesh.py:50-336`` (``ManagedDeviceMesh`` /
+``ft_init_device_mesh``) splices a ``ManagedProcessGroup`` replica dimension
+into a torch ``DeviceMesh`` so HSDP/FSDP2+TP see a resizable replicate dim.
+
+TPU-first translation: XLA SPMD compiles for a *fixed* topology, so the
+replica axis must never be a compiled mesh axis (SURVEY.md hard-part #1).
+``ManagedMesh`` therefore pairs:
+
+- an inner ``jax.sharding.Mesh`` over this replica group's chips — its axes
+  (dp/fsdp/sp/tp) are static, compiled, and ride ICI; and
+- the Manager's dynamic replica axis — host-driven over DCN, sized by the
+  live quorum (``num_participants``), contributing the outer gradient (or
+  pseudogradient) average.
+
+The object answers the same questions the reference's mesh answers (axis
+sizes incl. the dynamic replicate dim, ranks/coordinates, sub-axis lookup)
+and carries the outer collective (``allreduce_grads``) so trainers write
+mesh-relative code without touching the Manager directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from jax.sharding import Mesh
+
+from torchft_tpu.ddp import DistributedDataParallel
+from torchft_tpu.manager import Manager
+
+
+class ManagedMesh:
+    """An inner SPMD mesh + the managed (fault-tolerant) replica axis.
+
+    ``size()`` of the replica axis is dynamic — it reflects the current
+    quorum (clamped >= 1 like the reference's ``ManagedDeviceMesh.size``,
+    device_mesh.py:165-180); all other axes are the static jax mesh sizes.
+    """
+
+    REPLICA_AXIS = "replica"
+
+    def __init__(
+        self,
+        manager: Manager,
+        mesh: Mesh,
+        bucket_cap_mb: float = 32.0,
+    ) -> None:
+        self.manager = manager
+        self.mesh = mesh
+        self._ddp = DistributedDataParallel(manager, bucket_cap_mb=bucket_cap_mb)
+
+    # -- shape ------------------------------------------------------------
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return (self.REPLICA_AXIS,) + tuple(self.mesh.axis_names)
+
+    def size(self, axis: Optional[str] = None) -> int:
+        if axis is None:
+            return self.replica_size() * self.inner_size()
+        if axis == self.REPLICA_AXIS:
+            return self.replica_size()
+        return self.mesh.shape[axis]
+
+    def replica_size(self) -> int:
+        """Live replica-group count (>=1 even before the first quorum)."""
+        return max(self.manager.num_participants(), 1)
+
+    def inner_size(self) -> int:
+        n = 1
+        for s in self.mesh.shape.values():
+            n *= s
+        return n
+
+    def shape(self) -> Dict[str, int]:
+        out = {self.REPLICA_AXIS: self.replica_size()}
+        out.update(self.mesh.shape)
+        return out
+
+    # -- coordinates ------------------------------------------------------
+
+    def replica_rank(self) -> Optional[int]:
+        """This group's rank on the replica axis (None while healing/spare —
+        reference: participating_rank)."""
+        return self.manager.participating_rank()
+
+    def coordinate(self) -> Dict[str, Any]:
+        return {self.REPLICA_AXIS: self.replica_rank(), **{
+            a: None for a in self.mesh.axis_names
+        }}
+
+    # -- collectives ------------------------------------------------------
+
+    def allreduce_grads(self, grads: Any, should_quantize: bool = False) -> Any:
+        """Average a gradient pytree across the replica axis (the managed
+        dim's allreduce — what ManagedProcessGroup.allreduce is to DDP in the
+        reference, process_group.py:1205-1238)."""
+        return self._ddp.allreduce_grads(grads, should_quantize=should_quantize)
+
+    def __repr__(self) -> str:
+        return (
+            f"ManagedMesh(replica~{self.replica_size()}, "
+            f"inner={dict(self.mesh.shape)})"
+        )
+
+
+def ft_init_device_mesh(
+    manager: Manager,
+    *,
+    dp: int = 1,
+    fsdp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Any = None,
+    mesh: Optional[Mesh] = None,
+) -> ManagedMesh:
+    """Builds the inner mesh and wraps it with the managed replica axis
+    (reference: ft_init_device_mesh, device_mesh.py:303-336)."""
+    if mesh is None:
+        # Imported lazily: the FT control plane must not require the model
+        # stack (flax/optax via torchft_tpu.parallel) at import time.
+        from torchft_tpu.parallel.mesh import auto_mesh, make_mesh
+
+        if dp == fsdp == sp == tp == 1 and devices is None:
+            mesh = auto_mesh()
+        else:
+            mesh = make_mesh(dp=dp, fsdp=fsdp, sp=sp, tp=tp, devices=devices)
+    return ManagedMesh(manager, mesh)

@@ -1,0 +1,354 @@
+"""LocalSGD and (Streaming) DiLoCo: communication-reducing fault-tolerant
+data parallelism over the replica axis.
+
+Capability parity with the reference's ``torchft/local_sgd.py``:
+- ``LocalSGD`` (local_sgd.py:43-170): run ``sync_every`` local optimizer
+  steps, then average parameters across replica groups and commit iff the
+  quorum agrees.
+- ``DiLoCo`` / Streaming DiLoCo (local_sgd.py:173-789): keep a backup of the
+  last globally-agreed parameters; every ``sync_every`` steps compute
+  *pseudogradients* (backup - local), allreduce them across groups, feed
+  them to an **outer optimizer** on the backup params, and lerp the result
+  into the local params with ``fragment_update_alpha``. Streaming splits the
+  model into fragments whose syncs are staggered (offset round-robin) and
+  overlapped with ``fragment_sync_delay`` inner steps of compute.
+
+TPU-first design: parameters live as sharded jax arrays on device; the
+outer allreduce crosses pods over DCN, so pseudogradients are pulled to
+host exactly once per fragment sync (amortized over ``sync_every`` inner
+steps — this is why DiLoCo is the flagship cross-pod config,
+BASELINE.json #5). The inner optimizer/step function is arbitrary jitted
+user code; this layer never enters jit.
+
+Fault semantics mirror the reference (local_sgd.py:444-451): a failed sync
+restores the fragment to the last global (backup) state, so every replica
+that commits step N has bitwise-identical global state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import optax
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
+
+
+def _to_host(tree: Any) -> Any:
+    """Device pytree -> host numpy pytree (one transfer per leaf)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _leaves(tree: Any) -> List[Any]:
+    return jax.tree_util.tree_leaves(tree)
+
+
+class LocalSGD:
+    """Averages full parameters across replica groups every ``sync_every``
+    local steps (reference: local_sgd.py:43-170).
+
+    Usage::
+
+        local_sgd = LocalSGD(manager, get_params, set_params, sync_every=32)
+        for batch in data:
+            params = train_step(params, batch)     # jitted, on device
+            local_sgd.step()                       # counts; syncs on schedule
+
+    ``get_params``/``set_params`` bridge to the caller's (possibly sharded)
+    param pytree; this class never holds device state itself.
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        get_params: Callable[[], Any],
+        set_params: Callable[[Any], None],
+        sync_every: int,
+    ) -> None:
+        assert sync_every >= 1
+        self._manager = manager
+        self._get = get_params
+        self._set = set_params
+        self._sync_every = sync_every
+        self._local_step = 0
+        manager.register_state_dict_fn(
+            "LocalSGD",
+            lambda: _to_host(self._get()),
+            lambda state: self._set(state),
+        )
+
+    def step(self) -> Optional[bool]:
+        """Counts one local step; returns the commit decision on sync steps,
+        None otherwise."""
+        self._local_step += 1
+        if self._local_step < self._sync_every:
+            return None
+        self._local_step = 0
+        return self.sync()
+
+    def sync(self) -> bool:
+        """Quorum + parameter average + conditional commit (reference:
+        local_sgd.py:126-155)."""
+        manager = self._manager
+        manager.start_quorum()
+        params = self._get()
+        host = _to_host(params)
+        flat, treedef = jax.tree_util.tree_flatten(host)
+        work = manager.allreduce(list(flat))
+        averaged = work.wait()
+        if manager.should_commit():
+            self._set(jax.tree_util.tree_unflatten(treedef, list(averaged)))
+            return True
+        return False
+
+
+class _Fragment:
+    """One model fragment's DiLoCo state machine (reference:
+    _StreamingDiLoCoFragment, local_sgd.py:173-560).
+
+    Keeps ``backup`` = the last globally-committed values of this fragment's
+    params (host-side — the reference offers CPU backup too, 235-247);
+    ``prepare_sync`` snapshots pseudograds and launches the outer allreduce;
+    ``perform_sync`` votes, steps the outer optimizer on the backup, and
+    lerps the result into the live params.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        manager: Manager,
+        keys: Sequence[str],
+        get_fragment: Callable[[], Any],
+        set_fragment: Callable[[Any], None],
+        outer_optimizer: optax.GradientTransformation,
+        fragment_update_alpha: float,
+        should_quantize: bool,
+    ) -> None:
+        self.index = index
+        self._manager = manager
+        self.keys = list(keys)
+        self._get = get_fragment
+        self._set = set_fragment
+        self._opt = outer_optimizer
+        self._alpha = fragment_update_alpha
+        self._should_quantize = should_quantize
+
+        self._backup = _to_host(get_fragment())
+        self._opt_state = self._opt.init(self._backup)
+        self._pending: Optional[Work] = None
+        self._pending_treedef = None
+
+        # Healed replicas must receive the *global* state: backup + outer
+        # optimizer state (reference registers fragments as
+        # "StreamingDiLoCoFragment_{i}", local_sgd.py:249-275).
+        manager.register_state_dict_fn(
+            f"DiLoCoFragment_{index}",
+            self._state_dict,
+            self._load_state_dict,
+        )
+
+    def _state_dict(self) -> Dict[str, Any]:
+        return {"backup": self._backup, "opt_state": self._opt_state}
+
+    def _load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._backup = state["backup"]
+        self._opt_state = state["opt_state"]
+        # The healed local params restart from the global state.
+        self._set(self._backup)
+
+    def prepare_sync(self) -> None:
+        """Pseudograd = backup - local, launched as an async outer allreduce
+        (reference: local_sgd.py:313-326, 390-409)."""
+        local = _to_host(self._get())
+        pseudograd = jax.tree_util.tree_map(
+            lambda b, l: (np.asarray(b, np.float32) - np.asarray(l, np.float32)),
+            self._backup,
+            local,
+        )
+        flat, treedef = jax.tree_util.tree_flatten(pseudograd)
+        self._pending_treedef = treedef
+        self._pending = self._manager.allreduce(
+            list(flat), should_quantize=self._should_quantize
+        )
+
+    def perform_sync(self) -> bool:
+        """Waits the allreduce, votes, and merges (reference:
+        local_sgd.py:411-464). Returns the commit decision."""
+        if self._pending is None:
+            return self._manager.should_commit()
+        averaged = self._pending.wait()
+        self._pending = None
+        pseudograd = jax.tree_util.tree_unflatten(
+            self._pending_treedef, list(averaged)
+        )
+
+        if self._manager.should_commit():
+            updates, self._opt_state = self._opt.update(
+                pseudograd, self._opt_state, self._backup
+            )
+            new_global = optax.apply_updates(self._backup, updates)
+            self._backup = jax.tree_util.tree_map(np.asarray, new_global)
+            if self._alpha >= 1.0:
+                merged = self._backup
+            else:
+                # local' = alpha * global + (1-alpha) * local
+                local = _to_host(self._get())
+                merged = jax.tree_util.tree_map(
+                    lambda g, l: self._alpha * np.asarray(g, np.float32)
+                    + (1.0 - self._alpha) * np.asarray(l, np.float32),
+                    self._backup,
+                    local,
+                )
+            self._set(merged)
+            return True
+        # Failed sync: reset to the last global state so all committed
+        # replicas stay bitwise-identical (reference: local_sgd.py:444-451).
+        self._set(self._backup)
+        return False
+
+
+class DiLoCo:
+    """(Streaming) DiLoCo driver (reference: DiLoCo, local_sgd.py:563-789).
+
+    ``fragments`` is a list of (keys, get_fn, set_fn) triples partitioning
+    the model; with one fragment this is classic DiLoCo. Each inner step::
+
+        diloco.step()
+
+    drives the schedule: at local step ``sync_every - fragment_sync_delay``
+    (mod sync_every) the current fragment's pseudograd allreduce launches
+    (overlapping ``fragment_sync_delay`` more inner steps of compute); at
+    ``sync_every`` it completes and commits. Fragments take turns round-robin
+    by ``manager.current_step() % n_fragments`` (local_sgd.py:732-767).
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        fragments: Sequence[tuple],
+        sync_every: int,
+        outer_optimizer: Optional[optax.GradientTransformation] = None,
+        fragment_sync_delay: int = 0,
+        fragment_update_alpha: float = 1.0,
+        should_quantize: bool = False,
+    ) -> None:
+        n = len(fragments)
+        assert n >= 1, "need at least one fragment"
+        # Validation mirrors local_sgd.py:616-632.
+        if sync_every % n != 0:
+            raise ValueError(f"sync_every={sync_every} % n_fragments={n} != 0")
+        if fragment_sync_delay >= sync_every // n:
+            raise ValueError(
+                f"fragment_sync_delay={fragment_sync_delay} must be < "
+                f"sync_every/n_fragments={sync_every // n}"
+            )
+        if not 0.0 <= fragment_update_alpha <= 1.0:
+            raise ValueError("fragment_update_alpha must be in [0, 1]")
+
+        self._manager = manager
+        self._sync_every = sync_every
+        self._delay = fragment_sync_delay
+        outer_optimizer = outer_optimizer or optax.sgd(0.7, momentum=0.9, nesterov=True)
+        self._fragments = [
+            _Fragment(
+                i,
+                manager,
+                keys,
+                get_fn,
+                set_fn,
+                outer_optimizer,
+                fragment_update_alpha,
+                should_quantize,
+            )
+            for i, (keys, get_fn, set_fn) in enumerate(fragments)
+        ]
+        self._local_step = 0
+        self._prepared: Optional[_Fragment] = None
+
+    @property
+    def fragments(self) -> List[_Fragment]:
+        return self._fragments
+
+    def _current_fragment(self) -> _Fragment:
+        step = self._manager.current_step()
+        return self._fragments[step % len(self._fragments)]
+
+    def step(self) -> Optional[bool]:
+        """One inner step tick; returns commit decision when a sync
+        completes, else None (reference: _step_post_hook,
+        local_sgd.py:739-785)."""
+        self._local_step += 1
+        result: Optional[bool] = None
+        if self._local_step == self._sync_every - self._delay:
+            # Quorum overlaps the remaining `delay` inner steps.
+            frag = self._current_fragment()
+            self._manager.start_quorum()
+            frag.prepare_sync()
+            self._prepared = frag
+            if self._delay == 0:
+                result = self._finish_sync()
+        elif self._local_step >= self._sync_every:
+            result = self._finish_sync()
+        return result
+
+    def _finish_sync(self) -> bool:
+        frag = self._prepared
+        assert frag is not None, "sync finished without prepare"
+        self._prepared = None
+        self._local_step = 0
+        committed = frag.perform_sync()
+        if not committed:
+            logger.warning(
+                "DiLoCo sync of fragment %d failed; params reset to last "
+                "global state",
+                frag.index,
+            )
+        return committed
+
+
+def partition_fragments(
+    params: Any, n_fragments: int
+) -> List[List[str]]:
+    """Splits a flat-dict-of-pytrees param container into exactly
+    ``n_fragments`` contiguous, NON-empty key groups of roughly equal byte
+    size (the reference splits via torch.distributed.pipelining; here
+    top-level keys are the unit). Raises if there are fewer keys than
+    fragments — an empty fragment would silently skew the sync cadence."""
+    keys = list(params.keys())
+    if n_fragments < 1:
+        raise ValueError("n_fragments must be >= 1")
+    if len(keys) < n_fragments:
+        raise ValueError(
+            f"cannot split {len(keys)} top-level params into "
+            f"{n_fragments} fragments"
+        )
+    sizes = {
+        k: sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(params[k])
+        )
+        for k in keys
+    }
+    target = sum(sizes.values()) / n_fragments
+    groups: List[List[str]] = [[] for _ in range(n_fragments)]
+    gi = 0
+    acc = 0
+    for j, k in enumerate(keys):
+        keys_left = len(keys) - j
+        groups_after = n_fragments - gi - 1
+        # Advance when the current group is full — or must, so every
+        # remaining group still gets at least one key.
+        if groups[gi] and gi < n_fragments - 1 and (
+            acc >= target or keys_left <= groups_after
+        ):
+            gi += 1
+            acc = 0
+        groups[gi].append(k)
+        acc += sizes[k]
+    return groups

@@ -45,8 +45,8 @@ def auto_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Factor ``n_devices`` into a (dp, fsdp, sp, tp) mesh that exercises
-    every axis it can: repeatedly gives the smallest prime factor to the
-    axis with the smallest current size, preferring fsdp > tp > sp > dp
+    every axis it can: hands out prime factors largest-first, each to the
+    currently-smallest axis, preferring fsdp > tp > sp > dp on ties
     (matches the HSDP flagship config where fsdp carries most of the
     scaling and tp/sp stay within ICI reach)."""
     if devices is None:

@@ -1,0 +1,137 @@
+"""Prototype fault-tolerant parameter server (no Lighthouse involved).
+
+Reference: ``torchft/parameter_server.py:31-195`` — an HTTP endpoint
+``/new_session`` hands out a session id + store address; server and client
+then each ``configure`` a fresh 2-rank process group (server rank 0) and the
+per-session handler thread serves the user's ``forward`` over pg send/recv.
+
+Here sessions run over :class:`ProcessGroupSocket`; payloads are numpy
+pytrees moved with the process-group send/recv primitives. Subclass and
+implement :meth:`forward`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional
+
+import numpy as np
+
+from torchft_tpu.process_group import ProcessGroupSocket
+from torchft_tpu.store import TCPStoreServer
+
+_SESSION_PREFIX = "ps_session"
+
+
+class ParameterServer:
+    """Serves parameters / computation to dynamically-joining clients."""
+
+    def __init__(self, port: int = 0, timeout: float = 30.0) -> None:
+        self._timeout = timeout
+        self._store = TCPStoreServer()
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 (http.server API)
+                if self.path != "/new_session":
+                    self.send_error(404)
+                    return
+                session_id = str(uuid.uuid4())
+                thread = threading.Thread(
+                    target=ps._serve_session,
+                    args=(session_id,),
+                    name=f"ps-session-{session_id[:8]}",
+                    daemon=True,
+                )
+                thread.start()
+                body = json.dumps(
+                    {
+                        "session_id": session_id,
+                        "store_addr": ps._store.address(),
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass
+
+        self._http = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        self._http_thread.start()
+
+    def address(self) -> str:
+        host, port = self._http.server_address[:2]
+        return f"http://127.0.0.1:{port}"
+
+    # -- session plumbing --------------------------------------------------
+
+    def _session_store(self, session_id: str) -> str:
+        return f"{self._store.address()}/{_SESSION_PREFIX}/{session_id}"
+
+    def _serve_session(self, session_id: str) -> None:
+        pg = ProcessGroupSocket(timeout=self._timeout)
+        try:
+            pg.configure(self._session_store(session_id), rank=0, world_size=2)
+            while True:
+                try:
+                    (request,) = pg.recv(src=1, tag="ps.req").wait(self._timeout)
+                except TimeoutError:
+                    continue  # idle-but-live client: keep the session open
+                except Exception:  # connection closed/aborted: session over
+                    return
+                response = self.forward(session_id, request)
+                pg.send([np.asarray(response)], dst=1, tag="ps.resp").wait(
+                    self._timeout
+                )
+        finally:
+            pg.shutdown()
+
+    # -- override me -------------------------------------------------------
+
+    def forward(self, session_id: str, request: np.ndarray) -> np.ndarray:
+        """Handles one request tensor; override in subclasses (reference:
+        parameter_server.py:107-195 example echoes/updates params)."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        self._store.shutdown()
+
+
+class ParameterServerClient:
+    """Client side: POST /new_session, then exchange tensors over the pg."""
+
+    def __init__(self, server_url: str, timeout: float = 30.0) -> None:
+        import urllib.request
+
+        self._timeout = timeout
+        with urllib.request.urlopen(
+            urllib.request.Request(f"{server_url}/new_session", method="POST"),
+            timeout=timeout,
+        ) as resp:
+            info = json.loads(resp.read())
+        self._pg = ProcessGroupSocket(timeout=timeout)
+        self._pg.configure(
+            f"{info['store_addr']}/{_SESSION_PREFIX}/{info['session_id']}",
+            rank=1,
+            world_size=2,
+        )
+
+    def call(self, request: np.ndarray) -> np.ndarray:
+        self._pg.send([np.asarray(request)], dst=0, tag="ps.req").wait(
+            self._timeout
+        )
+        (resp,) = self._pg.recv(src=0, tag="ps.resp").wait(self._timeout)
+        return resp
+
+    def close(self) -> None:
+        self._pg.shutdown()
