@@ -3,10 +3,16 @@
 
 Measures the fault-tolerance throughput tax: steps/sec of the flagship
 training step running under the full FT protocol (in-proc lighthouse +
-manager, quorum per outer round, commit gate) divided by steps/sec of the
-bare compiled step. The reference's north-star budget is <5% loss
+manager, quorum per step, commit gate) divided by steps/sec of the bare
+compiled step. The reference's north-star budget is <5% loss
 (BASELINE.json), i.e. ratio >= 0.95; vs_baseline = ratio / 0.95 so > 1.0
 beats the reference target.
+
+Timing note: on the tunneled TPU backend, ``block_until_ready`` returns
+before device work completes and a host pull costs a full tunnel round
+trip (~150 ms). Loops are therefore timed as N chained async steps plus ONE
+forced scalar materialization, with the measured round-trip latency
+subtracted — both loops pay identical sync costs, so the ratio is clean.
 
 The reference repo publishes no absolute numbers (BASELINE.md), so the
 ratio-vs-budget is the honest comparable metric. Falls back to a pure
@@ -19,6 +25,29 @@ import json
 import os
 import sys
 import time
+
+
+def _materialize(x) -> float:
+    """Forces device execution to finish by pulling one scalar to host."""
+    import numpy as np
+
+    return float(np.asarray(x.reshape(-1)[0]))
+
+
+def _measure_rtt(n: int = 3) -> float:
+    """Host<->device round-trip latency of a scalar pull (tunnel cost).
+
+    Times the FIRST pull of each fresh array — jax.Array caches the host
+    copy, so re-pulling a materialized array measures nothing.
+    """
+    import jax.numpy as jnp
+
+    _materialize(jnp.full((1,), -1.0))  # warm the transfer path once
+    xs = [jnp.full((1,), float(i)) + 0.0 for i in range(n)]
+    t0 = time.perf_counter()
+    for x in xs:
+        _materialize(x)
+    return (time.perf_counter() - t0) / n
 
 
 def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
@@ -34,12 +63,11 @@ def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
         make_train_step,
     )
 
-    n_warmup = int(os.environ.get("BENCH_WARMUP", n_warmup))
+    # >=1: the post-warmup sync point reads the last warmup step's metrics.
+    n_warmup = max(1, int(os.environ.get("BENCH_WARMUP", n_warmup)))
     n_steps = int(os.environ.get("BENCH_STEPS", n_steps))
     n_dev = len(jax.devices())
     mesh = auto_mesh(n_dev)
-    # llama_small dims divide any of this machine's mesh factorizations for
-    # n_dev in {1, 2, 4, 8}; benchmark seq length keeps one step ~O(100ms).
     if os.environ.get("BENCH_TINY"):
         cfg = llama_debug()
         B, S = 4, 64
@@ -64,26 +92,44 @@ def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
         "mask": jnp.ones((B, S), jnp.int32),
     }
 
-    # Bare step.
+    # Warmup (compile) + RTT calibration.
     for _ in range(n_warmup):
-        state, _ = step(state, batch)
-    jax.block_until_ready(state.params)
+        state, metrics = step(state, batch)
+    _materialize(metrics["loss"])
+    rtt = _measure_rtt()
+
+    def _per_step(total: float, label: str) -> float:
+        corrected = total - rtt
+        if corrected <= 0:
+            print(
+                f"WARNING: {label} loop ({total*1e3:.1f} ms) shorter than "
+                f"measured rtt ({rtt*1e3:.1f} ms); reporting uncorrected "
+                "time — use more BENCH_STEPS",
+                file=sys.stderr,
+            )
+            corrected = total
+        return corrected / n_steps
+
+    # Bare loop: chained async dispatch, one forced sync at the end.
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
-    raw_dt = (time.perf_counter() - t0) / n_steps
+    _materialize(metrics["loss"])
+    raw_dt = _per_step(time.perf_counter() - t0, "raw")
 
-    # FT-wrapped loop: quorum + commit gate every step (DDP protocol shape,
-    # single replica group; outer allreduce handled by DiLoCo in prod —
-    # the per-step cost here is the control-plane + gating overhead).
     try:
-        ft_dt = _bench_ft(step, state, batch, n_warmup, n_steps)
+        ft_total = _bench_ft(step, state, batch, n_warmup, n_steps)
+        ft_dt = _per_step(ft_total, "ft")
     except Exception as e:  # pragma: no cover - sandbox fallback
         print(f"FT bench unavailable ({e}); reporting raw only", file=sys.stderr)
         ft_dt = None
 
     tokens_per_sec = B * S / raw_dt
+    print(
+        f"raw {raw_dt*1e3:.2f} ms/step ({tokens_per_sec:.0f} tok/s), "
+        f"ft {(ft_dt or 0)*1e3:.2f} ms/step, rtt {rtt*1e3:.1f} ms",
+        file=sys.stderr,
+    )
     if ft_dt is None:
         return {
             "metric": "train_step_tokens_per_sec",
@@ -92,6 +138,16 @@ def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
             "vs_baseline": 1.0,
         }
     ratio = raw_dt / ft_dt
+    if ratio > 1.02:
+        # Physically impossible beyond noise: warn loudly, and clamp so a
+        # machine consumer of vs_baseline never sees a fake target beat
+        # caused by a timing anomaly.
+        print(
+            f"WARNING: measured ratio {ratio:.4f} > 1 — timing anomaly "
+            "(clamped to 1.0); treat this run as suspect",
+            file=sys.stderr,
+        )
+    ratio = min(ratio, 1.0)
     return {
         "metric": "ft_throughput_ratio_vs_nofault",
         "value": round(ratio, 4),
@@ -101,10 +157,8 @@ def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
 
 
 def _bench_ft(step, state, batch, n_warmup: int, n_steps: int) -> float:
-    """Times the step under the live FT protocol (lighthouse + manager
-    in-proc, quorum + should_commit per step)."""
-    import jax
-
+    """Total wall time of n_steps under the live FT protocol (lighthouse +
+    manager in-proc, quorum + should_commit per step)."""
     from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.manager import Manager
     from torchft_tpu.process_group import ProcessGroupSocket
@@ -123,16 +177,16 @@ def _bench_ft(step, state, batch, n_warmup: int, n_steps: int) -> float:
         )
         for _ in range(n_warmup):
             manager.start_quorum()
-            state, _ = step(state, batch)
+            state, metrics = step(state, batch)
             manager.should_commit()
-        jax.block_until_ready(state.params)
+        _materialize(metrics["loss"])
         t0 = time.perf_counter()
         for _ in range(n_steps):
             manager.start_quorum()
-            state, _ = step(state, batch)
+            state, metrics = step(state, batch)
             manager.should_commit()
-        jax.block_until_ready(state.params)
-        return (time.perf_counter() - t0) / n_steps
+        _materialize(metrics["loss"])
+        return time.perf_counter() - t0
     finally:
         if manager is not None:
             manager.shutdown()

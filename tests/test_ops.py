@@ -1,0 +1,148 @@
+"""Pallas quantization kernel tests (interpret mode on the CPU backend).
+
+Mirrors the reference's quantization_test.py: roundtrip error bounds and
+exact parity with the host-side numpy quantizer in collectives.py, so either
+end of a DCN transfer can (de)quantize the other's payload.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchft_tpu.collectives import (
+    BLOCK as HOST_BLOCK,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+from torchft_tpu.ops import (
+    BLOCK,
+    fused_dequantize_int8,
+    fused_quantize_int8,
+    fused_reduce_int8,
+)
+
+
+def test_block_sizes_match_host():
+    assert BLOCK == HOST_BLOCK
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 3.0, (5000,)).astype(np.float32))
+    q, s, n = fused_quantize_int8(x)
+    assert q.dtype == jnp.int8
+    assert n == 5000
+    out = fused_dequantize_int8(q, s, n)
+    # max error is scale/2; scale = absmax/127 (global bound here)
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    bound = np.abs(np.asarray(x)).max() / 127.0 / 2 + 1e-6
+    assert err.max() <= bound * 1.01
+
+
+def test_quantize_matches_host_quantizer():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1.0, (2048,)).astype(np.float32)
+    q_dev, s_dev, n = fused_quantize_int8(jnp.asarray(x))
+    q_host, s_host = quantize_blockwise(x)
+    blocks = (n + BLOCK - 1) // BLOCK
+    np.testing.assert_array_equal(
+        np.asarray(q_dev).reshape(-1)[: blocks * BLOCK], q_host
+    )
+    np.testing.assert_allclose(np.asarray(s_dev)[:blocks], s_host, rtol=1e-6)
+
+
+def test_device_quantize_host_dequantize():
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 2.0, (1000,)).astype(np.float32)
+    q, s, n = fused_quantize_int8(jnp.asarray(x))
+    blocks = (n + BLOCK - 1) // BLOCK
+    host_out = dequantize_blockwise(
+        np.asarray(q).reshape(-1)[: blocks * BLOCK],
+        np.asarray(s)[:blocks],
+        n,
+    )
+    dev_out = np.asarray(fused_dequantize_int8(q, s, n))
+    np.testing.assert_allclose(host_out, dev_out, rtol=1e-6)
+
+
+def test_zero_blocks_are_exact():
+    x = jnp.zeros((1024,), jnp.float32)
+    q, s, n = fused_quantize_int8(x)
+    out = fused_dequantize_int8(q, s, n)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(1024))
+
+
+def test_fused_reduce_matches_fp32_sum():
+    rng = np.random.default_rng(3)
+    ranks = 4
+    xs = [rng.normal(0, 1.0, (2000,)).astype(np.float32) for _ in range(ranks)]
+    qs, ss = [], []
+    for x in xs:
+        q, s, n = fused_quantize_int8(jnp.asarray(x))
+        qs.append(q)
+        ss.append(s)
+    q_stack = jnp.stack(qs)
+    s_stack = jnp.stack(ss)
+    qo, so = fused_reduce_int8(q_stack, s_stack, avg=False)
+    out = np.asarray(fused_dequantize_int8(qo, so, n))
+    exact = sum(xs)
+    # one quantize + one requantize round trip of error
+    scale_in = max(np.abs(x).max() for x in xs) / 127.0
+    scale_out = np.abs(exact).max() / 127.0
+    bound = ranks * scale_in / 2 + scale_out / 2 + 1e-6
+    assert np.abs(out - exact).max() <= bound * 1.05
+
+
+def test_fused_reduce_avg():
+    ranks = 2
+    xs = [np.full((512,), 4.0, np.float32), np.full((512,), 2.0, np.float32)]
+    qs, ss = [], []
+    for x in xs:
+        q, s, n = fused_quantize_int8(jnp.asarray(x))
+        qs.append(q)
+        ss.append(s)
+    qo, so = fused_reduce_int8(jnp.stack(qs), jnp.stack(ss), avg=True)
+    out = np.asarray(fused_dequantize_int8(qo, so, n))
+    np.testing.assert_allclose(out, np.full((512,), 3.0), rtol=1e-2)
+
+
+def test_host_quantized_payload_device_dequantize():
+    """Host-quantized payloads have exactly `blocks` rows (not a _TILE
+    multiple); the device kernels must pad internally, not silently zero."""
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1.0, (5 * BLOCK,)).astype(np.float32)  # 5 rows
+    q_host, s_host = quantize_blockwise(x)
+    out = np.asarray(
+        fused_dequantize_int8(jnp.asarray(q_host), jnp.asarray(s_host), x.size)
+    )
+    expect = dequantize_blockwise(q_host, s_host, x.size)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    assert np.abs(out).max() > 0  # would be all-zero before the pad fix
+
+
+def test_host_payload_device_reduce():
+    rng = np.random.default_rng(5)
+    xs = [rng.normal(0, 1.0, (3 * BLOCK,)).astype(np.float32) for _ in range(2)]
+    qs, ss = zip(*(quantize_blockwise(x) for x in xs))
+    qo, so = fused_reduce_int8(
+        jnp.stack([jnp.asarray(q).reshape(-1, BLOCK) for q in qs]),
+        jnp.stack([jnp.asarray(s) for s in ss]),
+    )
+    out = np.asarray(fused_dequantize_int8(qo, so, xs[0].size))
+    exact = xs[0] + xs[1]
+    bound = 2 * max(np.abs(x).max() for x in xs) / 127 / 2 + np.abs(exact).max() / 127 / 2
+    assert np.abs(out - exact).max() <= bound * 1.05
+
+
+def test_quantize_for_transfer_layout():
+    from torchft_tpu.ops import quantize_for_transfer
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(0, 1.0, (1000,)).astype(np.float32)
+    q, s, n = quantize_for_transfer(jnp.asarray(x))
+    assert n == 1000
+    # decodable by the host-side decoder directly
+    out = dequantize_blockwise(q, s, n)
+    np.testing.assert_allclose(out, np.asarray(
+        fused_dequantize_int8(jnp.asarray(q), jnp.asarray(s), n)
+    ), rtol=1e-6)

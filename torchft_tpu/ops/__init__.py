@@ -1,0 +1,14 @@
+"""Device-native ops (Pallas TPU kernels).
+
+The reference keeps its device-native kernels in Triton
+(torchft/quantization.py); the TPU equivalents live here as Pallas kernels
+with interpret-mode fallback so the same code paths run in CPU tests.
+"""
+
+from torchft_tpu.ops.quantization import (  # noqa: F401
+    BLOCK,
+    fused_dequantize_int8,
+    fused_quantize_int8,
+    fused_reduce_int8,
+    quantize_for_transfer,
+)
