@@ -107,6 +107,36 @@ def test_diloco_validation():
         DiLoCo(m, [frag], sync_every=4, fragment_update_alpha=1.5)
 
 
+def test_diloco_rejects_async_quorum_manager():
+    m = FakeManager()
+    m.use_async_quorum = True
+    box = Box(make_params())
+    with pytest.raises(ValueError, match="async"):
+        DiLoCo(m, [(["w", "b"], box.get, box.set)], sync_every=2)
+
+
+def test_diloco_alpha_is_local_weight():
+    """alpha = weight of the LOCAL params: local' = (1-a)*global + a*local
+    (reference lerp convention, local_sgd.py:355-373)."""
+    m = FakeManager()
+    box = Box(make_params())
+    diloco = DiLoCo(
+        m,
+        [(["w", "b"], box.get, box.set)],
+        sync_every=1,
+        outer_optimizer=optax.sgd(1.0),
+        fragment_update_alpha=0.5,
+    )
+    box.set({"w": np.zeros((4, 4)), "b": np.zeros(4)})
+    assert diloco.step() is True
+    # new global: backup=2, pseudograd 2 -> averaged 1, sgd lr=1 -> 1.0
+    # merged: 0.5*global(1.0) + 0.5*local(0.0) = 0.5
+    np.testing.assert_allclose(box.params["w"], np.full((4, 4), 0.5))
+    np.testing.assert_allclose(
+        diloco.fragments[0]._backup["w"], np.full((4, 4), 1.0)
+    )
+
+
 def test_diloco_single_fragment_outer_sgd():
     """Pseudograd math: backup=2, local drifts to 0 -> pseudograd=2;
     fake manager halves it (zero peer); outer sgd lr=1 -> global = 2 - 1."""
@@ -165,11 +195,13 @@ def test_streaming_fragments_round_robin():
     )
     for i in range(8):
         diloco.step()
-    # two syncs happened (steps 4 and 8), one per fragment
-    assert m.quorums == 2
-    assert m.commits == 2
-    # allreduce payloads alternate fragments: first w (16 elems), then b (4)
-    assert [a[0].size for a in m.allreduce_calls] == [16, 4]
+    # One sync round every sync_every // n_fragments = 2 inner steps, so
+    # each fragment completes one sync per sync_every=4 steps (reference
+    # interval, local_sgd.py:629): 4 rounds over 8 steps.
+    assert m.quorums == 4
+    assert m.commits == 4
+    # allreduce payloads alternate fragments round-robin: w (16 elems), b (4)
+    assert [a[0].size for a in m.allreduce_calls] == [16, 4, 16, 4]
 
 
 def test_partition_fragments_balanced():

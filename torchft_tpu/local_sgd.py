@@ -194,14 +194,16 @@ class _Fragment:
             )
             new_global = optax.apply_updates(self._backup, updates)
             self._backup = jax.tree_util.tree_map(np.asarray, new_global)
-            if self._alpha >= 1.0:
+            if self._alpha <= 0.0:
                 merged = self._backup
             else:
-                # local' = alpha * global + (1-alpha) * local
+                # alpha = weight of the LOCAL params (reference lerp
+                # convention, local_sgd.py:355-373):
+                # local' = (1-alpha) * global + alpha * local
                 local = _to_host(self._get())
                 merged = jax.tree_util.tree_map(
-                    lambda g, l: self._alpha * np.asarray(g, np.float32)
-                    + (1.0 - self._alpha) * np.asarray(l, np.float32),
+                    lambda g, l: (1.0 - self._alpha) * np.asarray(g, np.float32)
+                    + self._alpha * np.asarray(l, np.float32),
                     self._backup,
                     local,
                 )
@@ -221,11 +223,18 @@ class DiLoCo:
 
         diloco.step()
 
-    drives the schedule: at local step ``sync_every - fragment_sync_delay``
-    (mod sync_every) the current fragment's pseudograd allreduce launches
-    (overlapping ``fragment_sync_delay`` more inner steps of compute); at
-    ``sync_every`` it completes and commits. Fragments take turns round-robin
-    by ``manager.current_step() % n_fragments`` (local_sgd.py:732-767).
+    drives the schedule: one sync round happens every
+    ``sync_every // n_fragments`` inner steps with fragments taking turns
+    round-robin by ``manager.current_step() % n_fragments``, so every
+    fragment completes exactly one sync per ``sync_every`` inner steps
+    (reference interval: local_sgd.py:629,732-767). Within a round the
+    pseudograd allreduce launches ``fragment_sync_delay`` steps early,
+    overlapping that much inner compute.
+
+    ``fragment_update_alpha`` is the weight of the LOCAL params in the
+    post-commit merge (``local' = (1-alpha)*global + alpha*local``); the
+    default 0.0 snaps local params to the new global state, matching the
+    reference's lerp convention (local_sgd.py:355-373).
     """
 
     def __init__(
@@ -235,12 +244,18 @@ class DiLoCo:
         sync_every: int,
         outer_optimizer: Optional[optax.GradientTransformation] = None,
         fragment_sync_delay: int = 0,
-        fragment_update_alpha: float = 1.0,
+        fragment_update_alpha: float = 0.0,
         should_quantize: bool = False,
     ) -> None:
         n = len(fragments)
         assert n >= 1, "need at least one fragment"
         # Validation mirrors local_sgd.py:616-632.
+        if getattr(manager, "use_async_quorum", False):
+            raise ValueError(
+                "DiLoCo requires a Manager with use_async_quorum=False: an "
+                "async quorum can heal (overwrite params) mid-inner-step "
+                "(reference: local_sgd.py:616-620)"
+            )
         if sync_every % n != 0:
             raise ValueError(f"sync_every={sync_every} % n_fragments={n} != 0")
         if fragment_sync_delay >= sync_every // n:
@@ -253,6 +268,10 @@ class DiLoCo:
 
         self._manager = manager
         self._sync_every = sync_every
+        # One fragment syncs per interval; with round-robin selection every
+        # fragment completes one sync per `sync_every` inner steps
+        # (reference: local_sgd.py:629).
+        self._interval = sync_every // n
         self._delay = fragment_sync_delay
         outer_optimizer = outer_optimizer or optax.sgd(0.7, momentum=0.9, nesterov=True)
         self._fragments = [
@@ -285,7 +304,7 @@ class DiLoCo:
         local_sgd.py:739-785)."""
         self._local_step += 1
         result: Optional[bool] = None
-        if self._local_step == self._sync_every - self._delay:
+        if self._local_step == self._interval - self._delay:
             # Quorum overlaps the remaining `delay` inner steps.
             frag = self._current_fragment()
             self._manager.start_quorum()
@@ -293,7 +312,7 @@ class DiLoCo:
             self._prepared = frag
             if self._delay == 0:
                 result = self._finish_sync()
-        elif self._local_step >= self._sync_every:
+        elif self._local_step >= self._interval:
             result = self._finish_sync()
         return result
 

@@ -529,6 +529,10 @@ class Manager:
     # Introspection (reference: manager.py:896-946)
     # ------------------------------------------------------------------
 
+    @property
+    def use_async_quorum(self) -> bool:
+        return self._use_async_quorum
+
     def current_step(self) -> int:
         return self._step
 

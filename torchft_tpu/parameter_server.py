@@ -69,8 +69,12 @@ class ParameterServer:
         self._http_thread.start()
 
     def address(self) -> str:
-        host, port = self._http.server_address[:2]
-        return f"http://127.0.0.1:{port}"
+        from torchft_tpu.coordination import advertise_host
+
+        port = self._http.server_address[1]
+        # Advertise a host remote clients can actually reach (the wildcard
+        # bind accepts them; TORCHFT_HOST_ADDR overrides for multi-host).
+        return f"http://{advertise_host()}:{port}"
 
     # -- session plumbing --------------------------------------------------
 

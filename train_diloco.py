@@ -44,7 +44,8 @@ def main() -> int:
     parser.add_argument("--sync-every", type=int, default=20)
     parser.add_argument("--n-fragments", type=int, default=2)
     parser.add_argument("--fragment-sync-delay", type=int, default=2)
-    parser.add_argument("--fragment-update-alpha", type=float, default=1.0)
+    parser.add_argument("--fragment-update-alpha", type=float, default=0.0,
+                        help="weight of LOCAL params in the post-commit merge")
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--quantize", action="store_true")
     args = parser.parse_args()
