@@ -242,3 +242,49 @@ def test_three_replicas_one_crash(lighthouse) -> None:
     results = _run_replicas(runners)
     assert injector.count == 1
     assert_params_equal(results)
+
+
+def test_manager_quantized_jax_allreduce(lighthouse) -> None:
+    """manager.allreduce(jax_arrays, should_quantize=True) takes the
+    device-quantized path end-to-end across two live replica groups:
+    device Pallas quantize -> int8 over the socket PG -> device dequantize,
+    averaged over participants (VERDICT r1 item 3)."""
+    import jax
+    import jax.numpy as jnp
+
+    ws = 2
+    n = 4096
+    grads = {r: np.full(n, float(r + 1), dtype=np.float32) for r in range(ws)}
+    expected = (grads[0] + grads[1]) / ws
+
+    def run(replica: int):
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=5.0),
+            min_replica_size=2,
+            use_async_quorum=False,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            replica_id=f"qjax{replica}",
+            lighthouse_addr=lighthouse.address(),
+            group_rank=0,
+            group_world_size=1,
+        )
+        try:
+            manager.start_quorum()
+            arr = jnp.asarray(grads[replica])
+            work = manager.allreduce(arr, should_quantize=True)
+            outs = work.wait(timeout=30)
+            assert manager.should_commit()
+            assert isinstance(outs[0], jax.Array), type(outs[0])
+            return np.asarray(outs[0])
+        finally:
+            manager.shutdown()
+
+    pool = ThreadPoolExecutor(max_workers=ws)
+    try:
+        futs = [pool.submit(run, r) for r in range(ws)]
+        results = [f.result(timeout=60) for f in futs]
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=np.abs(expected).max() * 0.05)

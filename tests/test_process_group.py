@@ -301,3 +301,140 @@ def test_allreduce_quantized_accuracy(store):
     assert err < 0.02, f"mean relative error too high: {err}"
     for g in groups:
         g.shutdown()
+
+
+def _count_wire_bytes(groups):
+    """Wraps every peer connection's send to count actual wire payload
+    bytes; returns the counter dict."""
+    sent = {"bytes": 0}
+    for g in groups:
+        inner = getattr(g, "_pg", g)  # unwrap wrappers
+        for conn in inner._peers.values():
+            orig = conn.send
+
+            def wrapped(tag, arr, _orig=orig):
+                sent["bytes"] += arr.nbytes
+                return _orig(tag, arr)
+
+            conn.send = wrapped
+    return sent
+
+
+def test_allreduce_quantized_jax_device_path(store):
+    """Device-quantized allreduce: Pallas quantize -> int8 over the wire ->
+    Pallas dequantize. Asserts numerics vs the exact fp32 sum AND >=3.5x
+    wire byte reduction vs the fp32 ring allreduce (reference:
+    collectives.py:297-415)."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import allreduce_quantized_jax
+
+    ws = 2
+    n = 65536
+    groups = _make_group(store, ws, prefix="qjax")
+    rng = np.random.default_rng(1)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = sum(d.copy() for d in data)
+
+    sent = _count_wire_bytes(groups)
+
+    def run(rank):
+        arr = jnp.asarray(data[rank])
+        outs = allreduce_quantized_jax(groups[rank], [arr]).wait(timeout=60)
+        return np.asarray(outs[0])
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    quant_bytes = sent["bytes"]
+    for r in results:
+        np.testing.assert_allclose(
+            r, expected, atol=np.abs(expected).max() * 0.05
+        )
+    err = np.abs(results[0] - expected).mean() / (np.abs(expected).mean() + 1e-9)
+    assert err < 0.02, f"mean relative error too high: {err}"
+
+    # Same payload through the plain fp32 ring allreduce.
+    sent["bytes"] = 0
+    def run_fp32(rank):
+        arr = data[rank].copy()
+        groups[rank].allreduce([arr]).wait(timeout=60)
+        return arr
+
+    _run_parallel([lambda r=r: run_fp32(r) for r in range(ws)])
+    fp32_bytes = sent["bytes"]
+    reduction = fp32_bytes / max(quant_bytes, 1)
+    assert reduction >= 3.5, (
+        f"wire byte reduction {reduction:.2f}x < 3.5x "
+        f"(fp32={fp32_bytes}, quant={quant_bytes})"
+    )
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_quantized_jax_scale_and_multi_array(store):
+    """scale (divide-by-N) fuses into the device dequantize; multiple arrays
+    of different shapes round-trip through one flat buffer."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import allreduce_quantized_jax
+
+    ws = 2
+    groups = _make_group(store, ws, prefix="qjax2")
+    rng = np.random.default_rng(2)
+    shapes = [(128, 33), (700,), (5, 5, 5)]
+    data = {
+        r: [rng.standard_normal(s).astype(np.float32) for s in shapes]
+        for r in range(ws)
+    }
+    expected = [
+        (data[0][i] + data[1][i]) / ws for i in range(len(shapes))
+    ]
+
+    def run(rank):
+        arrs = [jnp.asarray(a) for a in data[rank]]
+        outs = allreduce_quantized_jax(
+            groups[rank], arrs, scale=1.0 / ws
+        ).wait(timeout=60)
+        return [np.asarray(o) for o in outs]
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    for outs in results:
+        assert [o.shape for o in outs] == shapes
+        for o, e in zip(outs, expected):
+            np.testing.assert_allclose(o, e, atol=np.abs(e).max() * 0.05)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_quantized_mixed_entry_points_interop(store):
+    """One replica calls the numpy entry point, the other the jax entry
+    point — the wire protocol is shared, so mixed-type replicas must
+    produce the same (correct) result."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import (
+        allreduce_quantized,
+        allreduce_quantized_jax,
+    )
+
+    ws = 2
+    n = 4096
+    groups = _make_group(store, ws, prefix="qmix")
+    rng = np.random.default_rng(3)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = data[0] + data[1]
+
+    def run(rank):
+        if rank == 0:
+            arr = data[0].copy()
+            allreduce_quantized(groups[0], [arr]).wait(timeout=60)
+            return arr
+        outs = allreduce_quantized_jax(
+            groups[1], [jnp.asarray(data[1])]
+        ).wait(timeout=60)
+        return np.asarray(outs[0])
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=np.abs(expected).max() * 0.05)
+    for g in groups:
+        g.shutdown()

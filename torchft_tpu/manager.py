@@ -411,17 +411,51 @@ class Manager:
         (reference: manager.py:379-450). Accepts a numpy array, jax array, or
         list thereof; result/in-place output = input averaged over live
         participants. Returns completed-or-failed Work; errors are latched,
-        never raised here."""
+        never raised here.
+
+        With ``should_quantize=True`` and jax-array inputs, quantization runs
+        ON DEVICE (Pallas kernels) before the device->host pull, so both the
+        PCIe pull and the DCN wire move int8 + per-block scales instead of
+        fp32 (~4x fewer bytes); the result is dequantized on device and
+        wait() returns NEW jax arrays."""
+        import jax
+
+        items = list(tensors) if isinstance(tensors, (list, tuple)) else [tensors]
+        jax_path = should_quantize and all(
+            isinstance(t, jax.Array) for t in items
+        )
+
+        if jax_path:
+            if self.errored() is not None:
+                return DummyWork(items)
+            try:
+                self.wait_quorum()
+            except Exception:
+                return DummyWork(items)
+            if self._participating_rank is None:
+                import jax.numpy as jnp
+
+                items = [jnp.zeros_like(t) for t in items]
+            num_participants = max(self.num_participants(), 1)
+            try:
+                from torchft_tpu.collectives import allreduce_quantized_jax
+
+                work = allreduce_quantized_jax(
+                    self._pg, items, scale=1.0 / num_participants
+                )
+            except Exception as e:
+                self._logger.exception(f"quantized allreduce failed: {e}")
+                self.report_error(e)
+                return DummyWork(items)
+            return _ManagedWork(self, work, items, scale=1.0, in_place=False)
+
         def to_mutable(t: Any) -> np.ndarray:
             a = np.asarray(t)
             if not a.flags.writeable:  # e.g. a jax array's host view
                 a = np.array(a)
             return a
 
-        is_list = isinstance(tensors, (list, tuple))
-        arrays: List[np.ndarray] = [
-            to_mutable(t) for t in (tensors if is_list else [tensors])
-        ]
+        arrays: List[np.ndarray] = [to_mutable(t) for t in items]
         # Every return path keeps the contract: wait() -> list of arrays.
         if self.errored() is not None:
             return DummyWork(arrays)
@@ -570,12 +604,21 @@ class _ManagedWork(Work):
     a latched manager error with the unreduced tensors returned."""
 
     def __init__(
-        self, manager: Manager, work: Work, arrays: List[np.ndarray], scale: float
+        self,
+        manager: Manager,
+        work: Work,
+        arrays: List[Any],
+        scale: float,
+        in_place: bool = True,
     ) -> None:
         self._manager = manager
         self._work = work
         self._arrays = arrays
         self._scale = scale
+        # in_place=False: the work's result REPLACES arrays (jax device
+        # arrays are immutable; scaling already fused into the device
+        # dequantize). On failure the original inputs are returned.
+        self._in_place = in_place
         self._finished = False
         self._lock = threading.Lock()
 
@@ -585,11 +628,14 @@ class _ManagedWork(Work):
                 return
             self._finished = True
             try:
-                self._work.wait(
+                result = self._work.wait(
                     timeout if timeout is not None else self._manager._timeout
                 )
-                for a in self._arrays:
-                    a *= self._scale
+                if self._in_place:
+                    for a in self._arrays:
+                        a *= self._scale
+                else:
+                    self._arrays = list(result)
             except Exception as e:  # noqa: BLE001
                 self._manager._logger.exception(f"allreduce work failed: {e}")
                 self._manager.report_error(e)

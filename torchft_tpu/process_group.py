@@ -124,6 +124,11 @@ class _PeerConn:
     """One TCP connection to a peer rank with a tag-routing reader thread."""
 
     def __init__(self, sock: socket.socket, peer: int) -> None:
+        # The connect/accept path may leave a short socket timeout armed; the
+        # reader must block indefinitely on an IDLE connection (gaps between
+        # collectives are unbounded, e.g. DiLoCo inner steps). Stall/death
+        # detection belongs to recv()'s per-tag timeout, not the socket.
+        sock.settimeout(None)
         self.sock = sock
         self.peer = peer
         self.send_lock = threading.Lock()
