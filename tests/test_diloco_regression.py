@@ -1,0 +1,217 @@
+"""DiLoCo numerical regression suite with golden fixtures (reference:
+diloco_regression_test.py:30-127 + test_fixtures/*.json).
+
+Runs the REAL stack — two replica-group threads, each with its own Manager
+(C++ manager-server subprocess), a real in-proc C++ lighthouse, and socket
+process groups — under fully deterministic inner updates, and pins the full
+per-inner-step parameter history against committed JSON fixtures. Any
+silent numerics drift in the DiLoCo state machines (pseudograd math, outer
+optimizer, alpha merge, rollback-on-failure) between rounds fails here.
+
+Regenerate fixtures with:  WRITE_FIXTURE=true pytest tests/test_diloco_regression.py
+
+All values are exact in float32 (multiples of 2^-4), replicas run identical
+updates, and averaging over 2 identical replicas is exact — so comparisons
+are bitwise, not approximate.
+"""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import (
+    FakeProcessGroupWrapper,
+    ProcessGroupSocket,
+)
+
+FIXTURE_DIR = Path(__file__).parent / "fixtures"
+WRITE_FIXTURE = os.environ.get("WRITE_FIXTURE", "").lower() in ("1", "true")
+
+INNER_STEPS = 8
+DRIFT = 0.25  # inner update: p -= DRIFT each step (exact in fp32)
+OUTER_LR = 0.5
+
+
+def _initial_params() -> Dict[str, np.ndarray]:
+    return {
+        "w1": np.asarray([1.0, 2.0, 3.0, 4.0], np.float32),
+        "w2": np.asarray([-1.0, 0.5], np.float32),
+    }
+
+
+def _snapshot(params: Dict[str, np.ndarray]) -> Dict[str, List[float]]:
+    return {k: [float(x) for x in v] for k, v in params.items()}
+
+
+def _run_replica(
+    replica: int,
+    lighthouse_addr: str,
+    n_fragments: int,
+    delay: int,
+    alpha: float,
+    fail_before_step: Optional[int],
+    barrier: threading.Barrier,
+    pg_timeout: float,
+) -> List[Dict[str, List[float]]]:
+    params = _initial_params()
+
+    class Box:
+        @staticmethod
+        def get_keys(keys):
+            return lambda: {k: params[k] for k in keys}
+
+        @staticmethod
+        def set_keys(keys):
+            def setter(p):
+                for k in keys:
+                    params[k] = np.asarray(p[k], np.float32)
+
+            return setter
+
+    pg = FakeProcessGroupWrapper(ProcessGroupSocket(timeout=pg_timeout))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=2,
+        use_async_quorum=False,
+        timeout=15.0,
+        quorum_timeout=30.0,
+        replica_id=f"regr{replica}",
+        lighthouse_addr=lighthouse_addr,
+        group_rank=0,
+        group_world_size=1,
+        max_retries=5,
+        # Replicas start from identical params: skip the step-0 force
+        # recovery so no replica's local drift is overwritten by a heal
+        # (reference: manager.py init_sync, manager.rs:537).
+        init_sync=False,
+    )
+    key_groups = (
+        [["w1", "w2"]] if n_fragments == 1 else [["w1"], ["w2"]]
+    )
+    diloco = DiLoCo(
+        manager,
+        [(ks, Box.get_keys(ks), Box.set_keys(ks)) for ks in key_groups],
+        sync_every=4 if n_fragments == 2 else 4,
+        outer_optimizer=optax.sgd(OUTER_LR),
+        fragment_sync_delay=delay,
+        fragment_update_alpha=alpha,
+    )
+    history: List[Dict[str, List[float]]] = []
+    try:
+        for inner in range(INNER_STEPS):
+            # Lockstep: keeps the two replicas' quorums aligned per step so
+            # the commit pattern (and thus the history) is deterministic.
+            barrier.wait(timeout=60)
+            if fail_before_step is not None and inner == fail_before_step:
+                if replica == 1:
+                    # The NEXT collective (this sync round's pseudograd
+                    # allreduce, issued after start_quorum) fails on this
+                    # replica; the peer's ring times out; both replicas'
+                    # commits fail and roll back to the global backup
+                    # (reference: diloco regression failure-recovery golden).
+                    pg.report_future_error(
+                        RuntimeError("injected regression failure")
+                    )
+            for k in params:
+                params[k] = params[k] - np.float32(DRIFT)
+            diloco.step()
+            history.append(_snapshot(params))
+        return history
+    finally:
+        manager.shutdown()
+
+
+def _run_case(
+    n_fragments: int,
+    delay: int,
+    alpha: float,
+    fail_before_step: Optional[int] = None,
+    pg_timeout: float = 10.0,
+) -> List[Dict[str, List[float]]]:
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=10000,
+        quorum_tick_ms=20,
+    )
+    barrier = threading.Barrier(2)
+    try:
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            futs = [
+                pool.submit(
+                    _run_replica,
+                    r,
+                    lighthouse.address(),
+                    n_fragments,
+                    delay,
+                    alpha,
+                    fail_before_step,
+                    barrier,
+                    pg_timeout,
+                )
+                for r in (0, 1)
+            ]
+            histories = [f.result(timeout=120) for f in futs]
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+    finally:
+        lighthouse.shutdown()
+    # Replicas ran identical updates: their histories must be identical.
+    assert histories[0] == histories[1], "replica histories diverged"
+    return histories[0]
+
+
+def _check_golden(name: str, history: List[Dict[str, List[float]]]) -> None:
+    path = FIXTURE_DIR / f"{name}.json"
+    if WRITE_FIXTURE:
+        FIXTURE_DIR.mkdir(exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(history, f, indent=1)
+        pytest.skip(f"wrote fixture {path}")
+    assert path.exists(), (
+        f"missing fixture {path}; regenerate with WRITE_FIXTURE=true"
+    )
+    with open(path) as f:
+        golden = json.load(f)
+    assert history == golden, (
+        f"parameter history drifted from golden {name}; if the change is "
+        "intentional, regenerate with WRITE_FIXTURE=true"
+    )
+
+
+@pytest.mark.parametrize("n_fragments", [1, 2])
+@pytest.mark.parametrize("delay", [0, 1])
+@pytest.mark.parametrize("alpha", [0.0, 0.5])
+def test_diloco_golden(n_fragments: int, delay: int, alpha: float) -> None:
+    history = _run_case(n_fragments, delay, alpha)
+    # Sanity: params actually moved, and syncs actually happened (an inner
+    # step without syncs would end at exactly initial - INNER_STEPS*DRIFT).
+    drift_only = {
+        k: [float(np.float32(x) - np.float32(INNER_STEPS * DRIFT)) for x in v]
+        for k, v in _snapshot(_initial_params()).items()
+    }
+    assert history[-1] != drift_only, "no outer sync ever applied"
+    _check_golden(f"diloco_f{n_fragments}_d{delay}_a{alpha}", history)
+
+
+def test_diloco_golden_failure_recovery() -> None:
+    """One injected manager error makes the first sync's commit fail on both
+    replicas (rollback to the global backup), after which training recovers —
+    the full history including the rollback step is pinned."""
+    history = _run_case(1, 0, 0.0, fail_before_step=3, pg_timeout=3.0)
+    # The rollback must be visible: the sync at inner step 4 (index 3) fails
+    # and resets params to the global backup (= initial values).
+    initial = _snapshot(_initial_params())
+    assert history[3] == initial, "failed sync did not roll back to backup"
+    _check_golden("diloco_failure_recovery", history)
