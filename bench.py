@@ -1,29 +1,36 @@
 """Benchmark driver. Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Measures the fault-tolerance throughput tax: steps/sec of the flagship
-training step running under the full FT protocol (in-proc lighthouse +
-manager, quorum per step, commit gate) divided by steps/sec of the bare
-compiled step. The reference's north-star budget is <5% loss
-(BASELINE.json), i.e. ratio >= 0.95; vs_baseline = ratio / 0.95 so > 1.0
-beats the reference target.
+Measures the fault-tolerance throughput tax with REAL payload: a second
+replica-group process (CPU platform) joins the lighthouse, and every FT
+step/sync pushes the full gradient-sized pytree device->host and through
+the manager's socket allreduce between the two OS processes.
 
-Timing note: on the tunneled TPU backend, ``block_until_ready`` returns
-before device work completes and a host pull costs a full tunnel round
-trip (~150 ms). Loops are therefore timed as N chained async steps plus ONE
-forced scalar materialization, with the measured round-trip latency
-subtracted — both loops pay identical sync costs, so the ratio is clean.
+Three measured loops on the flagship model:
+1. raw       — the bare compiled train step (async-chained); also yields
+               tokens/sec and estimated MFU.
+2. ddp_ft    — per-step fault-tolerant DDP: grad step on device, full grad
+               pytree bucketed through ddp.allreduce_grads (device->host
+               pull + 2-process socket allreduce), jitted optimizer apply.
+3. diloco_ft — the flagship cross-pod config (BASELINE.json #5): sync_every
+               compiled inner steps, then a param-sized pseudograd
+               allreduce through manager.allreduce(should_quantize=True)
+               (device Pallas int8 quantize -> wire -> device dequantize).
 
-The reference repo publishes no absolute numbers (BASELINE.md), so the
-ratio-vs-budget is the honest comparable metric. Falls back to a pure
-throughput metric if the control plane cannot start (e.g. sandboxed).
+Headline = diloco ratio vs the reference's <5% budget (BASELINE.md). All
+raw numbers are reported UNCLAMPED in the JSON; nothing is subtracted or
+corrected. The per-step ddp ratio is reported alongside — on a tunneled
+single-chip dev backend the per-step device->host grad pull dominates it,
+which is exactly why DiLoCo is the cross-pod flagship.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -34,39 +41,131 @@ def _materialize(x) -> float:
     return float(np.asarray(x.reshape(-1)[0]))
 
 
-def _measure_rtt(n: int = 3) -> float:
-    """Host<->device round-trip latency of a scalar pull (tunnel cost).
+_PEAK_BF16_TFLOPS = [
+    ("v6", 918.0),  # Trillium
+    ("v5p", 459.0),
+    ("v5", 197.0),  # v5e / v5 lite
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
 
-    Times the FIRST pull of each fresh array — jax.Array caches the host
-    copy, so re-pulling a materialized array measures nothing.
-    """
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for key, tf in _PEAK_BF16_TFLOPS:
+        if key in kind:
+            return tf
+    return None
+
+
+def _flops_per_step(n_params: int, cfg, B: int, S: int) -> float:
+    """Standard 6ND estimate + causal attention term (fwd+bwd)."""
+    dense = 6.0 * n_params * B * S
+    attn = 6.0 * cfg.num_layers * B * S * S * cfg.num_heads * cfg.head_dim
+    return dense + attn
+
+
+# ---------------------------------------------------------------------------
+# Peer replica (second OS process, CPU platform)
+# ---------------------------------------------------------------------------
+
+
+def peer_main(config_path: str) -> int:
+    """The second replica group: joins the same lighthouse and mirrors the
+    parent's deterministic schedule of manager collectives with zero-valued
+    payloads of identical shapes (so socket tags and bucket layout align)."""
     import jax.numpy as jnp
+    import numpy as np
 
-    _materialize(jnp.full((1,), -1.0))  # warm the transfer path once
-    xs = [jnp.full((1,), float(i)) + 0.0 for i in range(n)]
-    t0 = time.perf_counter()
-    for x in xs:
-        _materialize(x)
-    return (time.perf_counter() - t0) / n
+    from torchft_tpu.ddp import DistributedDataParallel
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupSocket
+
+    with open(config_path) as f:
+        cfg = json.load(f)
+    shapes = [tuple(s) for s in cfg["shapes"]]
+    grads_np = [np.zeros(s, np.float32) for s in shapes]
+    manager = Manager(
+        pg=ProcessGroupSocket(timeout=float(cfg["timeout"])),
+        min_replica_size=2,
+        use_async_quorum=True,
+        timeout=float(cfg["timeout"]),
+        quorum_timeout=float(cfg["quorum_timeout"]),
+        replica_id="bench-peer",
+        lighthouse_addr=cfg["lighthouse"],
+        group_rank=0,
+        group_world_size=1,
+    )
+    ddp = DistributedDataParallel(manager, bucket_cap_mb=cfg["bucket_cap_mb"])
+    try:
+        grads_dev = [jnp.zeros(s, jnp.float32) for s in shapes]
+        for _ in range(1 + cfg["diloco_syncs"]):  # 1 untimed warmup sync
+            manager.start_quorum()
+            manager.allreduce(grads_dev, should_quantize=True).wait(
+                timeout=float(cfg["timeout"])
+            )
+            manager.should_commit()
+        del grads_dev
+        for _ in range(cfg["ddp_iters"]):
+            manager.start_quorum()
+            ddp.allreduce_grads(grads_np)
+            manager.should_commit()
+    finally:
+        manager.shutdown()
+    return 0
 
 
-def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
+def _spawn_peer(config_path: str) -> subprocess.Popen:
+    """Re-exec this file in peer mode on a CPU jax platform (the container
+    pins an accelerator platform via jax.config at import, so the child must
+    re-pin cpu before any backend initializes; only one process may own the
+    real chip anyway)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r}); "
+        f"import bench; sys.exit(bench.peer_main({config_path!r}))"
+    )
+    with open(config_path + ".log", "w") as log:
+        return subprocess.Popen(
+            [sys.executable, "-c", code],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Main benchmark
+# ---------------------------------------------------------------------------
+
+
+def _bench() -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
+    import optax
 
     from torchft_tpu.models import llama_debug, llama_small
     from torchft_tpu.parallel import auto_mesh
     from torchft_tpu.parallel.train import (
         build_model,
         init_train_state,
+        make_grad_step,
         make_train_step,
     )
 
-    # >=1: the post-warmup sync point reads the last warmup step's metrics.
-    n_warmup = max(1, int(os.environ.get("BENCH_WARMUP", n_warmup)))
-    n_steps = int(os.environ.get("BENCH_STEPS", n_steps))
+    n_warmup = max(1, int(os.environ.get("BENCH_WARMUP", 3)))
+    n_steps = int(os.environ.get("BENCH_STEPS", 20))
+    ddp_steps = int(os.environ.get("BENCH_DDP_STEPS", 4))
+    sync_every = int(os.environ.get("BENCH_SYNC_EVERY", 20))
+    diloco_syncs = int(os.environ.get("BENCH_DILOCO_SYNCS", 2))
+    timeout = float(os.environ.get("BENCH_TIMEOUT", 120.0))
+
     n_dev = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
     mesh = auto_mesh(n_dev)
     if os.environ.get("BENCH_TINY"):
         cfg = llama_debug()
@@ -83,117 +182,273 @@ def _bench(n_warmup: int = 3, n_steps: int = 20) -> dict:
     step = make_train_step(model, mesh, shardings)
     rng = np.random.default_rng(0)
     batch = {
-        "inputs": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32
-        ),
-        "targets": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32
-        ),
+        "inputs": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
         "mask": jnp.ones((B, S), jnp.int32),
     }
 
-    # Warmup (compile) + RTT calibration.
+    param_shapes = [
+        p.shape for p in jax.tree_util.tree_leaves(state.params)
+    ]
+    n_params = sum(int(np.prod(s)) for s in param_shapes)
+    payload_mb = n_params * 4 / 1e6
+
+    # ---- loop 1: raw (async-chained, one forced sync) --------------------
     for _ in range(n_warmup):
         state, metrics = step(state, batch)
     _materialize(metrics["loss"])
-    rtt = _measure_rtt()
-
-    def _per_step(total: float, label: str) -> float:
-        corrected = total - rtt
-        if corrected <= 0:
-            print(
-                f"WARNING: {label} loop ({total*1e3:.1f} ms) shorter than "
-                f"measured rtt ({rtt*1e3:.1f} ms); reporting uncorrected "
-                "time — use more BENCH_STEPS",
-                file=sys.stderr,
-            )
-            corrected = total
-        return corrected / n_steps
-
-    # Bare loop: chained async dispatch, one forced sync at the end.
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, batch)
     _materialize(metrics["loss"])
-    raw_dt = _per_step(time.perf_counter() - t0, "raw")
-
-    try:
-        ft_total = _bench_ft(step, state, batch, n_warmup, n_steps)
-        ft_dt = _per_step(ft_total, "ft")
-    except Exception as e:  # pragma: no cover - sandbox fallback
-        print(f"FT bench unavailable ({e}); reporting raw only", file=sys.stderr)
-        ft_dt = None
+    raw_dt = (time.perf_counter() - t0) / n_steps
 
     tokens_per_sec = B * S / raw_dt
-    print(
-        f"raw {raw_dt*1e3:.2f} ms/step ({tokens_per_sec:.0f} tok/s), "
-        f"ft {(ft_dt or 0)*1e3:.2f} ms/step, rtt {rtt*1e3:.1f} ms",
-        file=sys.stderr,
+    flops = _flops_per_step(n_params, cfg, B, S)
+    peak = _peak_tflops(device_kind)
+    mfu = (flops / raw_dt / 1e12) / (peak * n_dev) if peak else None
+
+    # ---- FT loops (2-process replica pair) -------------------------------
+    state_box = [state]
+    del state, metrics  # _bench_ft owns the only TrainState reference now
+    ft = _bench_ft(
+        model=model,
+        mesh=mesh,
+        shardings=shardings,
+        state_box=state_box,
+        batch=batch,
+        step=step,
+        make_grad_step=make_grad_step,
+        optax=optax,
+        ddp_steps=ddp_steps,
+        sync_every=sync_every,
+        diloco_syncs=diloco_syncs,
+        timeout=timeout,
     )
-    if ft_dt is None:
-        return {
-            "metric": "train_step_tokens_per_sec",
-            "value": round(tokens_per_sec, 1),
-            "unit": "tokens/sec",
-            "vs_baseline": 1.0,
-        }
-    ratio = raw_dt / ft_dt
-    if ratio > 1.02:
-        # Physically impossible beyond noise: warn loudly, and clamp so a
-        # machine consumer of vs_baseline never sees a fake target beat
-        # caused by a timing anomaly.
-        print(
-            f"WARNING: measured ratio {ratio:.4f} > 1 — timing anomaly "
-            "(clamped to 1.0); treat this run as suspect",
-            file=sys.stderr,
-        )
-    ratio = min(ratio, 1.0)
-    return {
-        "metric": "ft_throughput_ratio_vs_nofault",
-        "value": round(ratio, 4),
-        "unit": "ratio (1.0 = zero FT overhead; reference budget 0.95)",
-        "vs_baseline": round(ratio / 0.95, 4),
+
+    result = {
+        "raw_ms_per_step": round(raw_dt * 1e3, 2),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu_est": round(mfu, 4) if mfu is not None else None,
+        "n_params": n_params,
+        "payload_mb": round(payload_mb, 1),
+        "device_kind": device_kind,
+        "n_devices": n_dev,
+        "batch": [B, S],
+        "sync_every": sync_every,
     }
+    result.update(ft)
+
+    if ft.get("diloco_ft_ms_per_step") is not None:
+        ratio = raw_dt * 1e3 / ft["diloco_ft_ms_per_step"]
+        result.update(
+            {
+                "metric": "diloco_ft_throughput_ratio_vs_nofault",
+                "value": round(ratio, 4),
+                "unit": (
+                    "ratio, unclamped (1.0 = zero FT overhead; reference "
+                    "budget 0.95); real param-sized quantized pseudograd "
+                    "allreduce between 2 OS processes every "
+                    f"{sync_every} steps"
+                ),
+                "vs_baseline": round(ratio / 0.95, 4),
+            }
+        )
+        if ft.get("ddp_ft_ms_per_step"):
+            result["ddp_ratio"] = round(
+                raw_dt * 1e3 / ft["ddp_ft_ms_per_step"], 4
+            )
+    else:
+        result.update(
+            {
+                "metric": "train_step_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec (FT control plane unavailable)",
+                "vs_baseline": 1.0,
+            }
+        )
+    return result
 
 
-def _bench_ft(step, state, batch, n_warmup: int, n_steps: int) -> float:
-    """Total wall time of n_steps under the live FT protocol (lighthouse +
-    manager in-proc, quorum + should_commit per step)."""
+def _bench_ft(
+    *,
+    model,
+    mesh,
+    shardings,
+    state_box,
+    batch,
+    step,
+    make_grad_step,
+    optax,
+    ddp_steps: int,
+    sync_every: int,
+    diloco_syncs: int,
+    timeout: float,
+) -> dict:
+    import jax
+    import numpy as np
+
     from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.ddp import DistributedDataParallel
     from torchft_tpu.manager import Manager
     from torchft_tpu.process_group import ProcessGroupSocket
 
-    lighthouse = LighthouseServer(bind="127.0.0.1:0", min_replicas=1)
+    out: dict = {}
+    ddp_warmup = 1
+    lighthouse = None
     manager = None
+    peer = None
+    config_path = None
     try:
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=30000
+        )
+        state = state_box.pop()
+        shapes = [
+            list(p.shape) for p in jax.tree_util.tree_leaves(state.params)
+        ]
+        fd, config_path = tempfile.mkstemp(suffix=".json", prefix="bench_peer_")
+        with os.fdopen(fd, "w") as f:
+            json.dump(
+                {
+                    "shapes": shapes,
+                    "lighthouse": lighthouse.address(),
+                    "ddp_iters": ddp_warmup + ddp_steps,
+                    "diloco_syncs": diloco_syncs,
+                    "bucket_cap_mb": 32.0,
+                    "timeout": timeout,
+                    "quorum_timeout": timeout,
+                },
+                f,
+            )
+        peer = _spawn_peer(config_path)
         manager = Manager(
-            pg=ProcessGroupSocket(timeout=30.0),
-            min_replica_size=1,
-            replica_id="bench",
+            pg=ProcessGroupSocket(timeout=timeout),
+            min_replica_size=2,
+            use_async_quorum=True,
+            timeout=timeout,
+            quorum_timeout=timeout,
+            replica_id="bench-main",
             lighthouse_addr=lighthouse.address(),
             group_rank=0,
             group_world_size=1,
-            use_async_quorum=True,
         )
-        for _ in range(n_warmup):
-            manager.start_quorum()
-            state, metrics = step(state, batch)
-            manager.should_commit()
-        _materialize(metrics["loss"])
+        ddp = DistributedDataParallel(manager, bucket_cap_mb=32.0)
+
+        # ---- loop 2: DiLoCo flagship (runs first: reuses the raw loop's
+        # live train state, keeping peak HBM down) -------------------------
+        # Warmup sync (compiles the Pallas quantize/dequantize kernels and
+        # warms the wire path); untimed, mirrored by the peer.
+        st = state
+        manager.start_quorum()
+        manager.allreduce(
+            jax.tree_util.tree_leaves(st.params), should_quantize=True
+        ).wait(timeout=timeout)
+        manager.should_commit()
+
+        allreduce_secs = []
         t0 = time.perf_counter()
-        for _ in range(n_steps):
+        for _ in range(diloco_syncs):
+            for _ in range(sync_every):
+                st, metrics = step(st, batch)
             manager.start_quorum()
-            state, metrics = step(state, batch)
+            # Param-sized device pytree as the pseudograd payload: device
+            # Pallas int8 quantize -> socket wire -> device dequantize.
+            t_ar = time.perf_counter()
+            work = manager.allreduce(
+                jax.tree_util.tree_leaves(st.params), should_quantize=True
+            )
+            work.wait(timeout=timeout)
+            allreduce_secs.append(time.perf_counter() - t_ar)
             manager.should_commit()
         _materialize(metrics["loss"])
-        return time.perf_counter() - t0
+        total = time.perf_counter() - t0
+        inner_steps = diloco_syncs * sync_every
+        out["diloco_ft_ms_per_step"] = round(total / inner_steps * 1e3, 2)
+        out["outer_allreduce_ms"] = round(
+            float(np.mean(allreduce_secs)) * 1e3, 1
+        )
+        out["n_replicas"] = manager.num_participants()
+
+        # ---- loop 3: per-step fault-tolerant DDP -------------------------
+        grad_step = make_grad_step(model, mesh, shardings)
+        from torchft_tpu.parallel.train import default_optimizer
+
+        opt = default_optimizer()  # must match init_train_state's opt_state
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        apply_step = jax.jit(
+            apply_fn,
+            in_shardings=(
+                shardings.params,
+                shardings.opt_state,
+                shardings.params,
+            ),
+            out_shardings=(shardings.params, shardings.opt_state),
+            donate_argnums=(0, 1, 2),
+        )
+
+        params, opt_state = st.params, st.opt_state
+        del st, state, metrics  # free the extra TrainState references
+
+        def ddp_step(params, opt_state):
+            manager.start_quorum()
+            loss, grads = grad_step(params, batch)
+            grads = ddp.allreduce_grads(grads)  # device->host + wire + back
+            if manager.should_commit():
+                params, opt_state = apply_step(params, opt_state, grads)
+            return params, opt_state
+
+        for _ in range(ddp_warmup):
+            params, opt_state = ddp_step(params, opt_state)
+        jax.block_until_ready(params)
+        t0 = time.perf_counter()
+        for _ in range(ddp_steps):
+            params, opt_state = ddp_step(params, opt_state)
+        jax.block_until_ready(params)
+        out["ddp_ft_ms_per_step"] = round(
+            (time.perf_counter() - t0) / ddp_steps * 1e3, 2
+        )
+        if manager.num_participants() < 2:
+            out["degraded"] = "peer missing: allreduce short-circuited"
+        if manager.errored() is not None:
+            out["degraded"] = f"manager errored: {manager.errored()}"
+    except Exception as e:  # pragma: no cover - sandbox fallback
+        print(f"FT bench unavailable ({e})", file=sys.stderr)
+        out["ft_error"] = str(e)
+        # Keep any already-completed measurement (e.g. DiLoCo done, DDP
+        # phase failed): only default the headline to None if never set.
+        out.setdefault("diloco_ft_ms_per_step", None)
     finally:
         if manager is not None:
             manager.shutdown()
-        lighthouse.shutdown()
+        if peer is not None:
+            try:
+                peer.wait(timeout=30)
+            except Exception:
+                peer.kill()
+        if lighthouse is not None:
+            lighthouse.shutdown()
+        if config_path:
+            try:
+                os.unlink(config_path)
+            except OSError:
+                pass
+            # Keep the peer log only when something went wrong (diagnosis).
+            if "ft_error" not in out and "degraded" not in out:
+                try:
+                    os.unlink(config_path + ".log")
+                except OSError:
+                    pass
+    return out
 
 
 def main() -> int:
+    if len(sys.argv) > 2 and sys.argv[1] == "--peer":
+        return peer_main(sys.argv[2])
     result = _bench()
     print(json.dumps(result), flush=True)
     return 0

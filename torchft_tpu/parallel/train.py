@@ -76,6 +76,12 @@ def state_shardings(
 _DEFAULT_OPT = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
 
 
+def default_optimizer() -> optax.GradientTransformation:
+    """The optimizer init_train_state uses when none is given; callers that
+    later apply updates to that opt_state must use this same transform."""
+    return _DEFAULT_OPT
+
+
 def init_train_state(
     model: Transformer,
     mesh: Mesh,
