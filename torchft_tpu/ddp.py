@@ -38,6 +38,28 @@ class DistributedDataParallel:
         async manager allreduces for all buckets, waits, and rebuilds the
         pytree (values averaged over live participants)."""
         leaves, treedef = jax.tree_util.tree_flatten(grads)
+        dev_leaves = [x for x in leaves if isinstance(x, jax.Array)]
+        if dev_leaves:
+            # Guard the device->host pull: if the device computation feeding
+            # the grads never completes (wedged inner-mesh collective), the
+            # timeout engine latches an error and aborts the outer pg so the
+            # step fails fast instead of wedging the trainer (the reference
+            # arms stream_timeout on every wrapped future, manager.py:473-515).
+            from torchft_tpu import futures as ft_futures
+
+            manager = self._manager
+
+            def on_stall() -> None:
+                manager.report_error(
+                    TimeoutError("gradient device->host pull stalled")
+                )
+                abort = getattr(manager, "_abort_pg_on_stall", None)
+                if abort is not None:
+                    abort()
+
+            ft_futures.array_timeout(
+                dev_leaves, on_stall, getattr(manager, "_timeout", 60.0)
+            )
         host: List[np.ndarray] = [np.asarray(x) for x in leaves]
 
         buckets = self._bucketize(host)

@@ -165,7 +165,30 @@ class _Fragment:
     def prepare_sync(self) -> None:
         """Pseudograd = backup - local, launched as an async outer allreduce
         (reference: local_sgd.py:313-326, 390-409)."""
-        local = _to_host(self._get())
+        current = self._get()
+        dev_leaves = [
+            x
+            for x in jax.tree_util.tree_leaves(current)
+            if isinstance(x, jax.Array)
+        ]
+        if dev_leaves:
+            # Guard the device->host pseudograd pull (see ddp.allreduce_grads).
+            from torchft_tpu import futures as ft_futures
+
+            manager = self._manager
+
+            def on_stall() -> None:
+                manager.report_error(
+                    TimeoutError("pseudograd device->host pull stalled")
+                )
+                abort = getattr(manager, "_abort_pg_on_stall", None)
+                if abort is not None:
+                    abort()
+
+            ft_futures.array_timeout(
+                dev_leaves, on_stall, getattr(manager, "_timeout", 60.0)
+            )
+        local = _to_host(current)
         pseudograd = jax.tree_util.tree_map(
             lambda b, l: (np.asarray(b, np.float32) - np.asarray(l, np.float32)),
             self._backup,

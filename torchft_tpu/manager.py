@@ -331,9 +331,18 @@ class Manager:
                 f"{result.replica_rank}/{result.replica_world_size}"
             )
             try:
-                self._pg.configure(
-                    store_prefixed, result.replica_rank, result.replica_world_size
-                )
+                # A wedged reconfigure (peer half-joined, dead store) is
+                # actively aborted rather than waiting on socket timeouts
+                # (reference arms timeouts on every hot path,
+                # manager.py:473-515 / futures.py context_timeout).
+                with ft_futures.context_timeout(
+                    self._abort_pg_on_stall, self._connect_timeout
+                ):
+                    self._pg.configure(
+                        store_prefixed,
+                        result.replica_rank,
+                        result.replica_world_size,
+                    )
                 self._quorum_id = result.quorum_id
             except Exception as e:
                 self._logger.exception(f"pg configure failed: {e}")
@@ -494,6 +503,18 @@ class Manager:
         should_commit votes False (reference: manager.py:452-471)."""
         self._errored = e
 
+    def _abort_pg_on_stall(self) -> None:
+        """Timeout-engine callback: a collective or reconfigure exceeded its
+        deadline without erroring (WEDGED, not failed). Abort the process
+        group so every blocked wait fails fast and the next quorum
+        reconfigures — the TPU-native form of the reference's Baby-PG /
+        NCCL-abort crash isolation (process_group.py:651-714, 1241-1798)."""
+        self._logger.info("timeout engine: aborting wedged process group")
+        try:
+            self._pg.abort()
+        except Exception as e:  # noqa: BLE001 - abort must never throw
+            self._logger.exception(f"pg abort failed: {e}")
+
     def errored(self) -> Optional[Exception]:
         pg_error = self._pg.errored()
         if pg_error is not None and self._errored is None:
@@ -627,10 +648,17 @@ class _ManagedWork(Work):
             if self._finished:
                 return
             self._finished = True
+            t = timeout if timeout is not None else self._manager._timeout
             try:
-                result = self._work.wait(
-                    timeout if timeout is not None else self._manager._timeout
-                )
+                # Belt and braces: the wait carries a deadline, AND the
+                # timeout engine aborts the pg if the wait wedges past it —
+                # a stalled (non-erroring) peer mid-collective must fail
+                # fast, not hang until socket timeouts (reference:
+                # manager.py:473-515 wrap_future + stream timeouts).
+                with ft_futures.context_timeout(
+                    self._manager._abort_pg_on_stall, t
+                ):
+                    result = self._work.wait(t)
                 if self._in_place:
                     for a in self._arrays:
                         a *= self._scale
