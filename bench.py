@@ -75,7 +75,6 @@ def peer_main(config_path: str) -> int:
     """The second replica group: joins the same lighthouse and mirrors the
     parent's deterministic schedule of manager collectives with zero-valued
     payloads of identical shapes (so socket tags and bucket layout align)."""
-    import jax.numpy as jnp
     import numpy as np
 
     from torchft_tpu.ddp import DistributedDataParallel
@@ -99,14 +98,16 @@ def peer_main(config_path: str) -> int:
     )
     ddp = DistributedDataParallel(manager, bucket_cap_mb=cfg["bucket_cap_mb"])
     try:
-        grads_dev = [jnp.zeros(s, jnp.float32) for s in shapes]
+        # The numpy entry point shares the quantized wire protocol with the
+        # main process's device (Pallas) path — and vectorized numpy is the
+        # right quantizer on a CPU-only peer (interpret-mode Pallas at
+        # 500MB scale is unusably slow).
         for _ in range(1 + cfg["diloco_syncs"]):  # 1 untimed warmup sync
             manager.start_quorum()
-            manager.allreduce(grads_dev, should_quantize=True).wait(
+            manager.allreduce(grads_np, should_quantize=True).wait(
                 timeout=float(cfg["timeout"])
             )
             manager.should_commit()
-        del grads_dev
         for _ in range(cfg["ddp_iters"]):
             manager.start_quorum()
             ddp.allreduce_grads(grads_np)
@@ -162,7 +163,7 @@ def _bench() -> dict:
     ddp_steps = int(os.environ.get("BENCH_DDP_STEPS", 4))
     sync_every = int(os.environ.get("BENCH_SYNC_EVERY", 20))
     diloco_syncs = int(os.environ.get("BENCH_DILOCO_SYNCS", 2))
-    timeout = float(os.environ.get("BENCH_TIMEOUT", 120.0))
+    timeout = float(os.environ.get("BENCH_TIMEOUT", 300.0))
 
     n_dev = len(jax.devices())
     device_kind = jax.devices()[0].device_kind

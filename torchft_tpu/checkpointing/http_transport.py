@@ -11,6 +11,7 @@ reassemble. ``metadata()`` is the server URL.
 from __future__ import annotations
 
 import pickle
+import time
 
 import numpy as np
 import threading
@@ -176,8 +177,20 @@ class HTTPTransport(CheckpointTransport):
 
     @staticmethod
     def _fetch(url: str, timeout: float) -> bytes:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            return resp.read()
+        """GET with bounded retry on 404: sender and receiver learn the
+        recovery plan from the same quorum result concurrently, so the
+        receiver's first fetch can legitimately race the sender's
+        ``allow_checkpoint`` staging — poll until the step is served or the
+        deadline passes."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code != 404 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()
