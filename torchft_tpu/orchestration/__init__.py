@@ -1,0 +1,15 @@
+"""Process-level orchestration: topology rendering, keep-alive supervision,
+and chaos injection (reference: torchft/torchx.py, examples/slurm/runner.py,
+examples/slurm/punisher.py)."""
+
+from torchft_tpu.orchestration.launcher import ProcessSpec, render_topology
+from torchft_tpu.orchestration.punisher import Punisher, kill_via_lighthouse
+from torchft_tpu.orchestration.runner import ReplicaGroupRunner
+
+__all__ = [
+    "ProcessSpec",
+    "render_topology",
+    "ReplicaGroupRunner",
+    "Punisher",
+    "kill_via_lighthouse",
+]

@@ -1,0 +1,141 @@
+"""One-command chaos demo: N replica groups + keep-alive runner + punisher.
+
+Starts an in-proc lighthouse, launches ``--replicas`` demo trainers under
+the keep-alive runner, SIGKILLs random groups on an MTBF schedule while
+they train ``--steps`` steps, and verifies every group's final parameters
+are bitwise identical — the north-star fault story
+(reference: examples/slurm/runner.py + punisher.py, run as one command).
+
+    python -m torchft_tpu.orchestration.chaos_demo --replicas 3 --steps 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.orchestration.launcher import render_topology
+from torchft_tpu.orchestration.punisher import Punisher
+from torchft_tpu.orchestration.runner import ReplicaGroupRunner
+
+logger = logging.getLogger(__name__)
+
+
+def run_demo(
+    replicas: int = 3,
+    steps: int = 200,
+    mtbf_secs: float = 10.0,
+    step_sleep: float = 0.01,
+    timeout: float = 600.0,
+    max_kills: int = 3,
+    seed: int = 0,
+    result_dir: str | None = None,
+) -> dict:
+    """Runs the demo; returns {"ok", "kills", "restarts", "results"}."""
+    own_dir = result_dir is None
+    if own_dir:
+        result_dir = tempfile.mkdtemp(prefix="torchft_chaos_")
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=min(2, replicas),
+        join_timeout_ms=10000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=3000,
+    )
+    punisher = None
+    runner = None
+    try:
+        specs = render_topology(
+            [
+                sys.executable, "-m",
+                "torchft_tpu.orchestration.demo_trainer",
+                "--steps", str(steps),
+                "--result-dir", result_dir,
+                "--step-sleep", str(step_sleep),
+            ],
+            num_replica_groups=replicas,
+            lighthouse_addr=lighthouse.address(),
+        )
+        runner = ReplicaGroupRunner(
+            specs, max_restarts=20, log_dir=os.path.join(result_dir, "logs")
+        )
+        runner.start()
+        punisher = Punisher(
+            runner,
+            mtbf_secs=mtbf_secs,
+            interval_secs=0.5,
+            seed=seed,
+            max_kills=max_kills,
+        )
+        punisher.start()
+        ok = runner.run_until_done(timeout)
+        punisher.stop()
+
+        results = {}
+        for g in range(replicas):
+            path = os.path.join(result_dir, f"group{g}.json")
+            with open(path) as f:
+                results[g] = json.load(f)
+        ws = [np.asarray(r["w"], np.float32) for r in results.values()]
+        equal = all(np.array_equal(ws[0], w) for w in ws[1:])
+        return {
+            "ok": ok and equal,
+            "state_equal": equal,
+            "kills": punisher.kills,
+            "restarts": runner.restarts,
+            "results": results,
+        }
+    finally:
+        if punisher is not None:
+            punisher.stop()
+        if runner is not None:
+            runner.stop()
+        lighthouse.shutdown()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--mtbf", type=float, default=10.0)
+    parser.add_argument("--step-sleep", type=float, default=0.01)
+    parser.add_argument("--max-kills", type=int, default=3)
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    out = run_demo(
+        replicas=args.replicas,
+        steps=args.steps,
+        mtbf_secs=args.mtbf,
+        step_sleep=args.step_sleep,
+        timeout=args.timeout,
+        max_kills=args.max_kills,
+        seed=args.seed,
+    )
+    sps = [r["steps_per_sec"] for r in out["results"].values()]
+    print(
+        json.dumps(
+            {
+                "ok": out["ok"],
+                "state_equal": out["state_equal"],
+                "kills": out["kills"],
+                "restarts": sum(out["restarts"].values()),
+                "steps_per_sec_min": round(min(sps), 2),
+                "steps_per_sec_max": round(max(sps), 2),
+            }
+        )
+    )
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Minimal deterministic FT trainer used by the chaos demo and tests.
+
+Numpy-only data plane (no accelerator is touched, so any number of these
+can run as subprocesses on one machine): each replica group trains a small
+parameter vector with gradients that are a pure function of the committed
+step, so EVERY replica group that reaches step N — regardless of how many
+times it was killed, restarted, and healed — must hold bitwise-identical
+parameters. That is the north-star fault-tolerance contract
+(reference: manager_integ_test state-equality asserts; BASELINE.md).
+
+Run under the keep-alive runner with a punisher to demonstrate it::
+
+    python -m torchft_tpu.orchestration.chaos_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import ProcessGroupSocket
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--dim", type=int, default=64)
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--result-dir", type=str, default=None)
+    parser.add_argument("--step-sleep", type=float, default=0.0,
+                        help="artificial per-step compute time")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    group = os.environ.get("REPLICA_GROUP_ID", "0")
+    params: Dict[str, np.ndarray] = {
+        "w": np.zeros(args.dim, np.float32),
+    }
+
+    manager = Manager(
+        pg=ProcessGroupSocket(timeout=15.0),
+        state_dict=lambda: {k: v.copy() for k, v in params.items()},
+        load_state_dict=lambda s: params.update(
+            {k: np.asarray(v) for k, v in s.items()}
+        ),
+        min_replica_size=args.min_replicas,
+        use_async_quorum=True,
+        timeout=15.0,
+        quorum_timeout=30.0,
+        connect_timeout=15.0,
+        max_retries=20,
+    )
+    t0 = time.monotonic()
+    committed = 0
+    try:
+        while manager.current_step() < args.steps:
+            step = manager.current_step()
+            manager.start_quorum()
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+            # Gradient = pure function of the committed step: replicas that
+            # commit the same steps compute identical params, bitwise.
+            grad = np.full(
+                args.dim, np.float32(1.0 + (step % 7) * 0.5), np.float32
+            )
+            out = manager.allreduce(grad).wait(timeout=30)[0]
+            if manager.should_commit():
+                params["w"] -= np.float32(0.01) * out
+                committed += 1
+        wall = time.monotonic() - t0
+        if args.result_dir:
+            os.makedirs(args.result_dir, exist_ok=True)
+            path = os.path.join(args.result_dir, f"group{group}.json")
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "group": group,
+                        "w": [float(x) for x in params["w"]],
+                        "final_step": manager.current_step(),
+                        "committed_this_life": committed,
+                        "wall_secs": wall,
+                        "steps_per_sec": args.steps / wall if wall > 0 else 0,
+                    },
+                    f,
+                )
+        logging.info(
+            "group %s done: step=%d committed_this_life=%d wall=%.1fs",
+            group, manager.current_step(), committed, wall,
+        )
+        return 0
+    finally:
+        manager.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

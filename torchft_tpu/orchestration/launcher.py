@@ -1,0 +1,84 @@
+"""Topology rendering: the torchx-component analog (reference:
+torchft/torchx.py:11-83 hsdp()).
+
+Renders an N-replica-group x workers_per_replica job into per-process
+launch specs carrying the full FT environment (``REPLICA_GROUP_ID``,
+``NUM_REPLICA_GROUPS``, ``TORCHFT_LIGHTHOUSE``, and per-group
+``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT`` so multi-rank
+groups rendezvous on their group store). The runner (runner.py) consumes
+these specs locally; a k8s/slurm integration renders the same specs into
+its own job descriptions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class ProcessSpec:
+    """One OS process of the job."""
+
+    replica_group: int
+    group_rank: int
+    cmd: List[str]
+    env: Dict[str, str]
+
+    @property
+    def name(self) -> str:
+        return f"replica{self.replica_group}/rank{self.group_rank}"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def render_topology(
+    cmd: Sequence[str],
+    num_replica_groups: int,
+    lighthouse_addr: str,
+    workers_per_replica: int = 1,
+    env: Optional[Dict[str, str]] = None,
+    timeout_sec: Optional[float] = None,
+    quorum_timeout_sec: Optional[float] = None,
+) -> List[ProcessSpec]:
+    """Returns one ProcessSpec per (replica_group, group_rank).
+
+    ``cmd`` is the trainer command (e.g. ``[sys.executable, "train_ddp.py"]``);
+    the FT topology is injected purely through env vars, like the reference's
+    torchrun roles (torchx.py:70-74).
+    """
+    specs: List[ProcessSpec] = []
+    for group in range(num_replica_groups):
+        master_port = _free_port() if workers_per_replica > 1 else None
+        for rank in range(workers_per_replica):
+            e: Dict[str, str] = dict(env or {})
+            e.update(
+                {
+                    "REPLICA_GROUP_ID": str(group),
+                    "NUM_REPLICA_GROUPS": str(num_replica_groups),
+                    "TORCHFT_LIGHTHOUSE": lighthouse_addr,
+                    "RANK": str(rank),
+                    "WORLD_SIZE": str(workers_per_replica),
+                }
+            )
+            if master_port is not None:
+                e["MASTER_ADDR"] = "127.0.0.1"
+                e["MASTER_PORT"] = str(master_port)
+            if timeout_sec is not None:
+                e["TORCHFT_TIMEOUT_SEC"] = str(timeout_sec)
+            if quorum_timeout_sec is not None:
+                e["TORCHFT_QUORUM_TIMEOUT_SEC"] = str(quorum_timeout_sec)
+            specs.append(
+                ProcessSpec(
+                    replica_group=group,
+                    group_rank=rank,
+                    cmd=list(cmd),
+                    env=e,
+                )
+            )
+    return specs

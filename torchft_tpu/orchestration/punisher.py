@@ -1,0 +1,109 @@
+"""Chaos monkey (reference: examples/slurm/punisher.py:15-89).
+
+Two kill mechanisms:
+- process-level: SIGKILL a random live replica-group process managed by a
+  ``ReplicaGroupRunner`` (``kill_one`` / the ``Punisher`` MTBF loop,
+  reference kill_one/kill_loop punisher.py:25-45);
+- control-plane: the lighthouse ``POST /replica/{id}/kill`` RPC, which makes
+  the target's manager server ``exit(1)`` (reference: lighthouse dashboard
+  Kill button, lighthouse.rs:454-479).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import urllib.request
+from typing import Optional
+
+from torchft_tpu.orchestration.runner import ReplicaGroupRunner
+
+logger = logging.getLogger(__name__)
+
+
+def kill_one(
+    runner: ReplicaGroupRunner,
+    rng: Optional[random.Random] = None,
+    spare_group_zero: bool = True,
+) -> Optional[int]:
+    """SIGKILLs one random live replica group; returns the killed spec index
+    (None if nothing killable). ``spare_group_zero`` mirrors the reference's
+    never-kill-replica-0 rule (punisher.py:25-33) so at least one healthy
+    checkpoint source always survives."""
+    rng = rng or random.Random()
+    candidates = [
+        idx for idx in runner.live_pids() if not (spare_group_zero and idx == 0)
+    ]
+    if not candidates:
+        return None
+    victim = rng.choice(candidates)
+    return victim if runner.kill_group(victim) else None
+
+
+class Punisher:
+    """Background kill loop with MTBF pacing (reference: kill_loop,
+    punisher.py:36-45): every tick, kill one random group with probability
+    interval/mtbf."""
+
+    def __init__(
+        self,
+        runner: ReplicaGroupRunner,
+        mtbf_secs: float,
+        interval_secs: float = 1.0,
+        spare_group_zero: bool = True,
+        seed: Optional[int] = None,
+        max_kills: Optional[int] = None,
+    ) -> None:
+        self._runner = runner
+        self._mtbf = mtbf_secs
+        self._interval = interval_secs
+        self._spare0 = spare_group_zero
+        self._rng = random.Random(seed)
+        self._max_kills = max_kills
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="punisher", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        p_kill = min(self._interval / self._mtbf, 1.0)
+        while not self._stop.wait(self._interval):
+            if self._max_kills is not None and self.kills >= self._max_kills:
+                return
+            if self._rng.random() < p_kill:
+                victim = kill_one(
+                    self._runner, self._rng, spare_group_zero=self._spare0
+                )
+                if victim is not None:
+                    self.kills += 1
+                    logger.warning(
+                        "punisher: killed group %d (%d kills so far)",
+                        victim, self.kills,
+                    )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def kill_via_lighthouse(
+    lighthouse_addr: str, replica_id: str, timeout: float = 5.0
+) -> bool:
+    """Control-plane kill: POST /replica/{id}/kill on the lighthouse HTTP
+    dashboard port — the target replica's manager server exits(1), taking
+    the trainer's quorum with it."""
+    url = f"http://{lighthouse_addr}/replica/{replica_id}/kill"
+    req = urllib.request.Request(url, method="POST", data=b"")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception as e:  # noqa: BLE001 - chaos tooling reports, not raises
+        logger.warning("lighthouse kill of %r failed: %s", replica_id, e)
+        return False

@@ -1,0 +1,205 @@
+"""Keep-alive supervisor for replica-group trainer processes (reference:
+examples/slurm/runner.py:112-211 monitor/relaunch loop).
+
+``ReplicaGroupRunner`` launches every ProcessSpec as a subprocess and
+monitors them: a process that dies (crash, chaos kill, lighthouse Kill RPC)
+is relaunched — the process-level half of fault tolerance that torchelastic
+``max_restarts`` provides in the reference (torchx.py:56). The in-job half
+(quorum shrink, heal-on-rejoin) is the Manager's.
+
+CLI::
+
+    python -m torchft_tpu.orchestration.runner \
+        --replicas 3 --lighthouse 127.0.0.1:29510 -- python train_ddp.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from torchft_tpu.orchestration.launcher import ProcessSpec, render_topology
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaGroupRunner:
+    def __init__(
+        self,
+        specs: List[ProcessSpec],
+        max_restarts: int = 10,
+        poll_interval: float = 0.5,
+        log_dir: Optional[str] = None,
+    ) -> None:
+        self._specs = specs
+        self._max_restarts = max_restarts
+        self._poll = poll_interval
+        self._log_dir = log_dir
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._restarts: Dict[int, int] = {i: 0 for i in range(len(specs))}
+        self._clean_exit: Dict[int, bool] = {}
+        self._lock = threading.Lock()
+        self._stopping = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        for i in range(len(self._specs)):
+            self._launch(i)
+
+    def _launch(self, idx: int) -> None:
+        spec = self._specs[idx]
+        env = dict(os.environ)
+        env.update(spec.env)
+        stdout = None
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            path = os.path.join(
+                self._log_dir,
+                f"{spec.name.replace('/', '_')}.r{self._restarts[idx]}.log",
+            )
+            stdout = open(path, "w")
+        proc = subprocess.Popen(
+            spec.cmd,
+            env=env,
+            stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+        )
+        if stdout is not None:
+            stdout.close()  # the child owns the fd now
+        with self._lock:
+            self._procs[idx] = proc
+        logger.info("launched %s (pid %d)", spec.name, proc.pid)
+
+    def monitor_once(self) -> bool:
+        """One supervision pass; returns True while anything is running or
+        restartable."""
+        alive = False
+        for idx, spec in enumerate(self._specs):
+            proc = self._procs.get(idx)
+            if proc is None:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                alive = True
+                continue
+            if idx in self._clean_exit:
+                continue
+            if rc == 0:
+                self._clean_exit[idx] = True
+                logger.info("%s exited cleanly", spec.name)
+                continue
+            if self._stopping:
+                continue
+            if self._restarts[idx] >= self._max_restarts:
+                logger.error(
+                    "%s died (rc=%d) and exhausted %d restarts",
+                    spec.name, rc, self._max_restarts,
+                )
+                self._clean_exit[idx] = False
+                continue
+            self._restarts[idx] += 1
+            logger.warning(
+                "%s died (rc=%d); relaunching (restart %d/%d)",
+                spec.name, rc, self._restarts[idx], self._max_restarts,
+            )
+            self._launch(idx)
+            alive = True
+        return alive
+
+    def run_until_done(self, timeout: float) -> bool:
+        """Supervises until every process exited cleanly (True) or the
+        deadline passes / a process exhausts restarts (False)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            running = self.monitor_once()
+            done = len(self._clean_exit) == len(self._specs)
+            if done or not running:
+                return all(self._clean_exit.get(i) for i in range(len(self._specs)))
+            time.sleep(self._poll)
+        return False
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._lock:
+            procs = list(self._procs.values())
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # -- chaos interface (used by the punisher) ----------------------------
+
+    def live_pids(self) -> Dict[int, int]:
+        """spec index -> pid of currently-running processes."""
+        with self._lock:
+            return {
+                i: p.pid
+                for i, p in self._procs.items()
+                if p.poll() is None and i not in self._clean_exit
+            }
+
+    def kill_group(self, idx: int, sig: int = signal.SIGKILL) -> bool:
+        """SIGKILLs one replica group's process (chaos); the monitor loop
+        relaunches it."""
+        with self._lock:
+            proc = self._procs.get(idx)
+        if proc is None or proc.poll() is not None:
+            return False
+        logger.warning(
+            "chaos: killing %s (pid %d)", self._specs[idx].name, proc.pid
+        )
+        proc.send_signal(sig)
+        return True
+
+    @property
+    def restarts(self) -> Dict[int, int]:
+        return dict(self._restarts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--replicas", type=int, required=True)
+    parser.add_argument("--workers-per-replica", type=int, default=1)
+    parser.add_argument("--lighthouse", type=str, required=True)
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("--timeout", type=float, default=3600.0)
+    parser.add_argument("--log-dir", type=str, default=None)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="trainer command after --")
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("missing trainer command")
+    logging.basicConfig(level=logging.INFO)
+
+    specs = render_topology(
+        cmd,
+        num_replica_groups=args.replicas,
+        workers_per_replica=args.workers_per_replica,
+        lighthouse_addr=args.lighthouse,
+    )
+    runner = ReplicaGroupRunner(
+        specs, max_restarts=args.max_restarts, log_dir=args.log_dir
+    )
+    runner.start()
+    try:
+        ok = runner.run_until_done(args.timeout)
+    finally:
+        runner.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
