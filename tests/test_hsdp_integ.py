@@ -1,0 +1,83 @@
+"""HSDP flagship end-to-end (VERDICT r1 item 8): two replica-group OS
+processes, each compiling the sharded train step over its own virtual
+8-device CPU mesh (dp/fsdp/sp/tp axes + ring attention), outer gradient
+averaging through the Manager's socket PG, supervised by the keep-alive
+runner. One group is SIGKILLed mid-run, relaunches, heals params +
+optimizer state from the survivor, and both finish with BITWISE-identical
+parameters (sha256 over every leaf).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.orchestration import ReplicaGroupRunner, render_topology
+
+pytestmark = pytest.mark.slow
+
+
+def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path):
+    steps = 8
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=30000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=5000,
+    )
+    result_dir = str(tmp_path / "results")
+    runner = None
+    try:
+        specs = render_topology(
+            [
+                sys.executable, "train_hsdp.py",
+                "--model", "debug",
+                "--steps", str(steps),
+                "--min-replicas", "2",
+                "--result-dir", result_dir,
+            ],
+            num_replica_groups=2,
+            lighthouse_addr=lighthouse.address(),
+            env={
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        runner = ReplicaGroupRunner(
+            specs, max_restarts=3, log_dir=str(tmp_path / "logs")
+        )
+        runner.start()
+        # Let both groups compile and commit a few steps, then kill group 1.
+        # (Compile dominates the early wall time; poll for progress instead
+        # of guessing.)
+        deadline = time.monotonic() + 120
+        killed = False
+        while time.monotonic() < deadline and not killed:
+            time.sleep(1.0)
+            logs = (tmp_path / "logs").glob("replica1_rank0.r0.log")
+            for log in logs:
+                if "step 2" in log.read_text():
+                    assert runner.kill_group(1), "kill failed"
+                    killed = True
+                    break
+        assert killed, "group 1 never reached step 2 within the deadline"
+        ok = runner.run_until_done(timeout=300)
+        assert ok, f"runner did not finish cleanly (restarts={runner.restarts})"
+        assert runner.restarts[1] >= 1, "killed group was never relaunched"
+    finally:
+        if runner is not None:
+            runner.stop()
+        lighthouse.shutdown()
+
+    results = {}
+    for g in range(2):
+        with open(os.path.join(result_dir, f"group{g}.json")) as f:
+            results[g] = json.load(f)
+    assert results[0]["final_step"] == steps
+    assert results[1]["final_step"] == steps
+    # The north-star contract: bitwise-identical params after kill + heal.
+    assert results[0]["param_sha256"] == results[1]["param_sha256"], results
