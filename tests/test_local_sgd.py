@@ -35,6 +35,8 @@ class FakeManager:
         self.quorums += 1
 
     def allreduce(self, tensors, should_quantize=False):
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]
         arrays = [np.array(t, dtype=np.float32) for t in tensors]
         # Simulate averaging with a peer holding zeros: result = x / num.
         out = [a / self.num for a in arrays]
@@ -298,3 +300,35 @@ def test_partition_fragments_front_loaded_sizes():
 
     with pytest.raises(ValueError):
         partition_fragments({"only": np.zeros(1)}, 2)
+
+
+def test_diloco_streaming_buckets_split_and_preserve_numerics():
+    """A fragment whose leaves exceed the bucket cap issues MULTIPLE
+    allreduces per sync (streaming buckets, reference local_sgd.py:466-560)
+    and produces the same result as unbucketed."""
+    def run(bucket_cap_mb):
+        m = FakeManager()
+        params = {
+            "a": np.full((1000,), 2.0, np.float32),   # 4000 B
+            "b": np.full((1000,), 4.0, np.float32),
+            "c": np.full((500,), 6.0, np.float32),
+        }
+        box = Box(params)
+        diloco = DiLoCo(
+            m,
+            [(list(params), box.get, box.set)],
+            sync_every=1,
+            outer_optimizer=optax.sgd(1.0),
+            bucket_cap_mb=bucket_cap_mb,
+        )
+        box.set({k: np.zeros_like(v) for k, v in params.items()})
+        assert diloco.step() is True
+        return m, {k: v.copy() for k, v in box.params.items()}
+
+    # 4 KB cap: a (4000B) fills one bucket, b another, c a third.
+    m_small, out_small = run(bucket_cap_mb=4096 / (1024 * 1024))
+    assert len(m_small.allreduce_calls) == 3
+    m_big, out_big = run(bucket_cap_mb=32.0)
+    assert len(m_big.allreduce_calls) == 1
+    for k in out_small:
+        np.testing.assert_array_equal(out_small[k], out_big[k])

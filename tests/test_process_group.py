@@ -438,3 +438,51 @@ def test_allreduce_quantized_mixed_entry_points_interop(store):
         np.testing.assert_allclose(r, expected, atol=np.abs(expected).max() * 0.05)
     for g in groups:
         g.shutdown()
+
+
+def test_reduce_scatter_quantized(store):
+    """Each rank ends with its own block-aligned reduced fp32 shard; shards
+    tile the full buffer (reference: collectives.py:159-294)."""
+    from torchft_tpu.collectives import reduce_scatter_quantized
+
+    ws = 3
+    n = 3000
+    groups = _make_group(store, ws, prefix="rsq")
+    rng = np.random.default_rng(5)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = sum(d.copy() for d in data)
+
+    def run(rank):
+        return reduce_scatter_quantized(
+            groups[rank], [data[rank].copy()]
+        ).wait(timeout=60)
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    covered = np.zeros(n, bool)
+    for shard, (start, end) in results:
+        assert shard.shape == (end - start,)
+        np.testing.assert_allclose(
+            shard, expected[start:end],
+            atol=np.abs(expected).max() * 0.05,
+        )
+        covered[start:end] = True
+    assert covered.all(), "shards do not tile the buffer"
+
+    # Tiny payload (fewer blocks than ranks): allgather fallback.
+    def run_tiny(rank):
+        return reduce_scatter_quantized(
+            groups[rank], [data[rank][:700].copy()]
+        ).wait(timeout=60)
+
+    results = _run_parallel([lambda r=r: run_tiny(r) for r in range(ws)])
+    exp = expected[:700]
+    covered = np.zeros(700, bool)
+    for shard, (start, end) in results:
+        end = min(end, 700)
+        np.testing.assert_allclose(
+            shard[: end - start], exp[start:end], atol=np.abs(exp).max() * 0.05
+        )
+        covered[start:end] = True
+    assert covered.all()
+    for g in groups:
+        g.shutdown()

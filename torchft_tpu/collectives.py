@@ -16,8 +16,7 @@ quantizing on-device before the device->host pull.
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -26,18 +25,26 @@ from torchft_tpu.work import DummyWork, FutureWork, Work
 
 BLOCK = 512  # values per quantization scale
 
-_EXECUTOR: Optional[ThreadPoolExecutor] = None
-_EXECUTOR_LOCK = threading.Lock()
 
+def _spawn_collective(fn) -> "concurrent.futures.Future":
+    """One daemon thread per in-flight quantized collective. A bounded pool
+    would deadlock when several ranks live in one process (tests, parameter
+    server): every rank's pipeline must make progress concurrently for any
+    alltoall to complete."""
+    import concurrent.futures
 
-def _executor() -> ThreadPoolExecutor:
-    global _EXECUTOR
-    with _EXECUTOR_LOCK:
-        if _EXECUTOR is None:
-            _EXECUTOR = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="quant-collective"
-            )
-        return _EXECUTOR
+    fut: concurrent.futures.Future = concurrent.futures.Future()
+
+    def run() -> None:
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            fut.set_result(fn())
+        except BaseException as e:  # noqa: BLE001 - delivered via the future
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True, name="quant-collective").start()
+    return fut
 
 
 def quantize_blockwise(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -150,7 +157,85 @@ def allreduce_quantized_jax(
         jax.block_until_ready(outs)
         return outs
 
-    return FutureWork(_executor().submit(run))
+    return FutureWork(_spawn_collective(run))
+
+
+def reduce_scatter_quantized(
+    pg: ProcessGroup, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+) -> Work:
+    """Quantized reduce_scatter (reference: collectives.py:159-294): the
+    alltoall + local-fp32-reduce half of the allreduce pipeline, WITHOUT the
+    allgather — each rank keeps only its own reduced shard (block-aligned).
+
+    Returns Work whose result is ``(shard, (start, end))``: this rank's
+    fp32 reduced values covering flat elements ``[start, end)`` of the
+    concatenated input.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"reduce_scatter_quantized supports SUM/AVG, got {op}")
+    ws = pg.size()
+    arrays = list(arrays)
+
+    def run():
+        flat, _sizes = _flatten(arrays)
+        n = flat.size
+        if ws <= 1:
+            return flat, (0, n)
+        q_host, s_host = quantize_blockwise(flat)
+        blocks = s_host.size
+        me = pg.rank()
+        counts = [len(c) for c in np.array_split(np.arange(blocks), ws)]
+        starts = np.concatenate([[0], np.cumsum(counts)]) * BLOCK
+        start, end = int(starts[me]), int(min(starts[me + 1], n))
+        if blocks < ws:
+            # Tiny payload: gather-all, reduce locally, slice my range.
+            gathered = pg.allgather([q_host, s_host]).wait()
+            acc = np.zeros(n, np.float32)
+            for g_q, g_s in gathered:
+                acc += dequantize_blockwise(g_q, g_s, n)
+            shard = acc[start:end]
+        else:
+            q_chunks, s_chunks = [], []
+            off = 0
+            for c in counts:
+                q_chunks.append(q_host[off * BLOCK : (off + c) * BLOCK])
+                s_chunks.append(s_host[off : off + c])
+                off += c
+            all_q = pg.alltoall(q_chunks).wait()
+            all_s = pg.alltoall(s_chunks).wait()
+            n_me = counts[me] * BLOCK
+            acc = np.zeros(n_me, np.float32)
+            for g_q, g_s in zip(all_q, all_s):
+                acc += dequantize_blockwise(g_q, g_s, n_me)
+            shard = acc[: end - start]
+        if op == ReduceOp.AVG:
+            shard = shard / ws
+        return shard, (start, end)
+
+    return FutureWork(_spawn_collective(run))
+
+
+def bucketize(arrays: Sequence[np.ndarray], cap_bytes: int) -> List[List[int]]:
+    """Greedy same-dtype buckets up to ``cap_bytes`` (reference: <=32 MiB
+    flat buffers, local_sgd.py:466-560 / ddp bucketing). Returns index
+    groups into ``arrays``."""
+    by_dtype: dict = {}
+    for i, a in enumerate(arrays):
+        by_dtype.setdefault(a.dtype, []).append(i)
+    buckets: List[List[int]] = []
+    for idxs in by_dtype.values():
+        cur: List[int] = []
+        size = 0
+        for i in idxs:
+            nbytes = arrays[i].nbytes
+            if cur and size + nbytes > cap_bytes:
+                buckets.append(cur)
+                cur, size = [], 0
+            cur.append(i)
+            size += nbytes
+        if cur:
+            buckets.append(cur)
+    return buckets
 
 
 def _quantized_wire_pipeline(
@@ -223,4 +308,4 @@ def allreduce_quantized(
         _unflatten_into(arrays, result, sizes)
         return list(arrays)
 
-    return FutureWork(_executor().submit(run))
+    return FutureWork(_spawn_collective(run))

@@ -80,25 +80,9 @@ class DistributedDataParallel:
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def _bucketize(self, arrays: List[np.ndarray]) -> List[List[int]]:
-        """Greedy same-dtype buckets up to the cap (reference: 32 MiB flat
-        buffers, local_sgd.py:466-560)."""
-        by_dtype: dict = {}
-        for i, a in enumerate(arrays):
-            by_dtype.setdefault(a.dtype, []).append(i)
-        buckets: List[List[int]] = []
-        for idxs in by_dtype.values():
-            cur: List[int] = []
-            size = 0
-            for i in idxs:
-                nbytes = arrays[i].nbytes
-                if cur and size + nbytes > self._bucket_cap:
-                    buckets.append(cur)
-                    cur, size = [], 0
-                cur.append(i)
-                size += nbytes
-            if cur:
-                buckets.append(cur)
-        return buckets
+        from torchft_tpu.collectives import bucketize
+
+        return bucketize(arrays, self._bucket_cap)
 
 
 class PureDistributedDataParallel:

@@ -129,6 +129,7 @@ class _Fragment:
         outer_optimizer: optax.GradientTransformation,
         fragment_update_alpha: float,
         should_quantize: bool,
+        bucket_cap_mb: float = 32.0,
     ) -> None:
         self.index = index
         self._manager = manager
@@ -138,10 +139,12 @@ class _Fragment:
         self._opt = outer_optimizer
         self._alpha = fragment_update_alpha
         self._should_quantize = should_quantize
+        self._bucket_cap = int(bucket_cap_mb * 1024 * 1024)
 
         self._backup = _to_host(get_fragment())
         self._opt_state = self._opt.init(self._backup)
-        self._pending: Optional[Work] = None
+        self._pending: List[tuple] = []
+        self._pending_leaves: List[Any] = []
         self._pending_treedef = None
 
         # Healed replicas must receive the *global* state: backup + outer
@@ -194,21 +197,42 @@ class _Fragment:
             self._backup,
             local,
         )
-        flat, treedef = jax.tree_util.tree_flatten(pseudograd)
+        leaves, treedef = jax.tree_util.tree_flatten(pseudograd)
         self._pending_treedef = treedef
-        self._pending = self._manager.allreduce(
-            list(flat), should_quantize=self._should_quantize
-        )
+        # Streaming buckets: <=32 MiB flat buffers per dtype, one async
+        # allreduce each, unpacked at perform_sync (reference bucketized
+        # fragment sync, local_sgd.py:466-560).
+        from torchft_tpu.collectives import bucketize
+
+        buckets = bucketize(leaves, self._bucket_cap)
+        self._pending = []
+        for idx_list in buckets:
+            flat = np.concatenate([leaves[i].reshape(-1) for i in idx_list])
+            work = self._manager.allreduce(
+                flat, should_quantize=self._should_quantize
+            )
+            self._pending.append((work, idx_list))
+        self._pending_leaves = leaves
 
     def perform_sync(self) -> bool:
-        """Waits the allreduce, votes, and merges (reference:
+        """Waits the bucket allreduces, votes, and merges (reference:
         local_sgd.py:411-464). Returns the commit decision."""
-        if self._pending is None:
+        if not self._pending:
             return self._manager.should_commit()
-        averaged = self._pending.wait()
-        self._pending = None
+        # Unpack-on-wait: rebuild leaves from each bucket's reduced flat.
+        out: List[Any] = [None] * len(self._pending_leaves)
+        for work, idx_list in self._pending:
+            (reduced,) = work.wait()
+            offset = 0
+            for i in idx_list:
+                leaf = self._pending_leaves[i]
+                out[i] = np.asarray(
+                    reduced[offset : offset + leaf.size]
+                ).reshape(leaf.shape)
+                offset += leaf.size
+        self._pending = []
         pseudograd = jax.tree_util.tree_unflatten(
-            self._pending_treedef, list(averaged)
+            self._pending_treedef, out
         )
 
         if self._manager.should_commit():
@@ -269,6 +293,7 @@ class DiLoCo:
         fragment_sync_delay: int = 0,
         fragment_update_alpha: float = 0.0,
         should_quantize: bool = False,
+        bucket_cap_mb: float = 32.0,
     ) -> None:
         n = len(fragments)
         assert n >= 1, "need at least one fragment"
@@ -307,6 +332,7 @@ class DiLoCo:
                 outer_optimizer,
                 fragment_update_alpha,
                 should_quantize,
+                bucket_cap_mb,
             )
             for i, (keys, get_fn, set_fn) in enumerate(fragments)
         ]
