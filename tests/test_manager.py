@@ -315,3 +315,33 @@ def test_state_dict_lock_blocks_checkpoint_read():
         assert m._manager_state_dict()["user"]["default"] == {"x": 1}
     finally:
         m.shutdown()
+
+
+def test_hot_paths_emit_spans_and_metrics(tmp_path, monkeypatch):
+    """The reference wraps every hot path in record_function spans
+    (manager.py:379-793); here trace_span feeds span_stats, and
+    should_commit emits a metrics line when TORCHFT_METRICS_FILE is set."""
+    import json
+
+    from torchft_tpu import telemetry
+
+    path = str(tmp_path / "metrics.jsonl")
+    monkeypatch.setenv("TORCHFT_METRICS_FILE", path)
+    telemetry.reset_span_stats()
+    m = make_manager()
+    try:
+        m.start_quorum()
+        m.allreduce(np.ones(4, np.float32)).wait()
+        assert m.should_commit()
+    finally:
+        m.shutdown()
+    stats = telemetry.span_stats()
+    for name in (
+        "torchft::manager::start_quorum",
+        "torchft::manager::_async_quorum",
+        "torchft::manager::allreduce",
+        "torchft::manager::should_commit",
+    ):
+        assert stats[name]["count"] >= 1, name
+    rec = json.loads(open(path).readline())
+    assert rec["committed"] == 1.0 and rec["num_participants"] == 2.0

@@ -1,0 +1,179 @@
+"""Tests for the observability subsystem: spans, timing, metrics export,
+and the collective flight recorder (reference: record_function spans at
+manager.py:379-793, _timeit at http_transport.py:31-36, NCCL flight
+recorder at process_group.py:89-108)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from torchft_tpu import telemetry
+from torchft_tpu.process_group import ProcessGroupSocket, ReduceOp
+from torchft_tpu.store import TCPStoreServer
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_accumulates_stats():
+    telemetry.reset_span_stats()
+    with telemetry.trace_span("test::outer"):
+        with telemetry.trace_span("test::inner"):
+            pass
+        with telemetry.trace_span("test::inner"):
+            pass
+    stats = telemetry.span_stats()
+    assert stats["test::inner"]["count"] == 2
+    assert stats["test::outer"]["count"] == 1
+    assert stats["test::outer"]["total_s"] >= stats["test::outer"]["max_s"] > 0
+
+
+def test_trace_span_propagates_exceptions_but_still_records():
+    telemetry.reset_span_stats()
+    with pytest.raises(ValueError):
+        with telemetry.trace_span("test::boom"):
+            raise ValueError("boom")
+    assert telemetry.span_stats()["test::boom"]["count"] == 1
+
+
+def test_trace_span_threadsafe():
+    telemetry.reset_span_stats()
+
+    def worker():
+        for _ in range(50):
+            with telemetry.trace_span("test::mt"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.span_stats()["test::mt"]["count"] == 200
+
+
+def test_timeit_logs_and_records(caplog):
+    telemetry.reset_span_stats()
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="torchft_tpu"):
+        with telemetry.timeit("test::transfer"):
+            pass
+    assert telemetry.span_stats()["test::transfer"]["count"] == 1
+    assert any("test::transfer took" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_logger_writes_jsonl(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    m = telemetry.MetricsLogger(path)
+    m.log(0, loss=1.5, num_participants=3)
+    m.log(1, loss=1.25, note="healed")
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["step"] == 0 and lines[0]["loss"] == 1.5
+    assert lines[0]["num_participants"] == 3.0
+    assert lines[1]["note"] == "healed"  # non-numeric falls back to str
+
+
+def test_get_metrics_logger_env_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("TORCHFT_METRICS_FILE", raising=False)
+    assert telemetry.get_metrics_logger() is None
+    path = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("TORCHFT_METRICS_FILE", path)
+    m = telemetry.get_metrics_logger()
+    assert m is not None
+    m.log(7, loss=0.5)
+    assert json.loads(open(path).read())["step"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    fr = telemetry.FlightRecorder(capacity=4)
+    seqs = [fr.record("allreduce", nbytes=100, rank=0, world=2) for _ in range(6)]
+    # Ring: only the last 4 survive.
+    snap = fr.snapshot()
+    assert len(snap) == 4
+    assert snap[0]["seq"] == seqs[2]
+    fr.complete(seqs[-1])
+    fr.complete(seqs[-2], error="socket died")
+    snap = fr.snapshot()
+    assert snap[-1]["status"] == "ok"
+    assert snap[-2]["status"] == "error" and "socket died" in snap[-2]["error"]
+    path = fr.dump("test", path=str(tmp_path / "fr.json"))
+    payload = json.load(open(path))
+    assert payload["reason"] == "test" and len(payload["ops"]) == 4
+
+
+def test_flight_recorder_abort_gate(tmp_path, monkeypatch):
+    fr = telemetry.FlightRecorder()
+    fr.record("allreduce")
+    monkeypatch.delenv("TORCHFT_TRIGGER_FR_ON_ABORT", raising=False)
+    assert fr.maybe_dump_on_abort("off") is None
+    monkeypatch.setenv("TORCHFT_TRIGGER_FR_ON_ABORT", "true")
+    monkeypatch.setenv("TORCHFT_FR_DIR", str(tmp_path))
+    path = fr.maybe_dump_on_abort("on")
+    assert path is not None and os.path.exists(path)
+    assert json.load(open(path))["reason"] == "on"
+
+
+def test_pg_abort_dumps_flight_record(tmp_path, monkeypatch):
+    """End-to-end: a real socket PG records its collectives and dumps them
+    when aborted with the env gate set (reference: process_group.py:812-813
+    triggers the FR pipe dump inside abort)."""
+    monkeypatch.setenv("TORCHFT_TRIGGER_FR_ON_ABORT", "true")
+    monkeypatch.setenv("TORCHFT_FR_DIR", str(tmp_path / "fr"))
+
+    store = TCPStoreServer()
+    try:
+        pgs = [ProcessGroupSocket(timeout=10.0) for _ in range(2)]
+        threads = [
+            threading.Thread(
+                target=pgs[r].configure,
+                args=(f"{store.address()}/frtest", r, 2),
+            )
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        works = [
+            pg.allreduce([np.ones(4, np.float32)], ReduceOp.SUM) for pg in pgs
+        ]
+        for w in works:
+            w.wait(10.0)
+        pgs[0].abort()
+        path = os.path.join(
+            str(tmp_path / "fr"), f"torchft_tpu_fr_{os.getpid()}.json"
+        )
+        assert os.path.exists(path)
+        ops = json.load(open(path))["ops"]
+        assert any(o["op"] == "allreduce" and o["status"] == "ok" for o in ops)
+        pgs[1].abort()
+    finally:
+        store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trace windows (env-gated; off by default)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_window_noop_without_env(monkeypatch):
+    monkeypatch.delenv("TORCHFT_TRACE_DIR", raising=False)
+    for step in range(10):
+        telemetry.trace_window(step)  # must not raise or start traces
+    assert telemetry._TRACE_STATE["active"] is False

@@ -30,6 +30,13 @@ from torchft_tpu.process_group import (  # noqa: E402,F401
     ProcessGroupSocket,
     ReduceOp,
 )
+from torchft_tpu.telemetry import (  # noqa: E402,F401
+    MetricsLogger,
+    flight_recorder,
+    span_stats,
+    timeit,
+    trace_span,
+)
 
 __all__ = [
     "DiLoCo",
@@ -39,6 +46,7 @@ __all__ = [
     "ManagedMesh",
     "ManagedProcessGroup",
     "Manager",
+    "MetricsLogger",
     "OptimizerWrapper",
     "ProcessGroup",
     "ProcessGroupDummy",
@@ -46,6 +54,10 @@ __all__ = [
     "PureDistributedDataParallel",
     "ReduceOp",
     "WorldSizeMode",
+    "flight_recorder",
     "ft_init_device_mesh",
+    "span_stats",
+    "timeit",
+    "trace_span",
     "__version__",
 ]

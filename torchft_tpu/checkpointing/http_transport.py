@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
 from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.telemetry import timeit
 from torchft_tpu.checkpointing._serialization import join_state, split_state
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
@@ -131,8 +132,10 @@ class HTTPTransport(CheckpointTransport):
         # http_transport.py:220-242). The copy is required: split_state
         # aliases contiguous numpy inputs, and the optimizer mutates those
         # same arrays while peers are still fetching.
-        meta, buffers = split_state(state_dict)
-        buffers = [np.array(b, copy=True) for b in buffers]
+        # Wall-time logged like the reference's _timeit (http_transport.py:31-36).
+        with timeit("torchft::http_transport::stage_checkpoint"):
+            meta, buffers = split_state(state_dict)
+            buffers = [np.array(b, copy=True) for b in buffers]
         with self._state.lock.w_lock(timeout):
             self._state.meta = meta
             self._state.buffers = buffers
@@ -145,6 +148,12 @@ class HTTPTransport(CheckpointTransport):
             self._state.buffers = []
 
     def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
+        with timeit("torchft::http_transport::recv_checkpoint"):
+            return self._recv_checkpoint(src_rank, metadata, step, timeout)
+
+    def _recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = metadata.rstrip("/")

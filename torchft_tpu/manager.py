@@ -39,6 +39,7 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.store import StoreClient, TCPStoreServer
+from torchft_tpu.telemetry import get_metrics_logger, timeit, trace_span
 from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
@@ -255,18 +256,19 @@ class Manager:
         """Begins the (possibly async) quorum for this step (reference:
         manager.py:517-573). Call at the top of the step (e.g. from
         OptimizerWrapper.zero_grad)."""
-        self._errored = None
-        self._healing = False
-        self._quorum_future = self._executor.submit(
-            self._async_quorum,
-            allow_heal,
-            shrink_only,
-            timeout if timeout is not None else self._quorum_timeout,
-        )
-        if not self._use_async_quorum:
-            self.wait_quorum()
-            if self._healing:
-                self._apply_pending_state_dict()
+        with trace_span("torchft::manager::start_quorum"):
+            self._errored = None
+            self._healing = False
+            self._quorum_future = self._executor.submit(
+                self._async_quorum,
+                allow_heal,
+                shrink_only,
+                timeout if timeout is not None else self._quorum_timeout,
+            )
+            if not self._use_async_quorum:
+                self.wait_quorum()
+                if self._healing:
+                    self._apply_pending_state_dict()
 
     def wait_quorum(self) -> None:
         assert self._quorum_future is not None, (
@@ -275,6 +277,12 @@ class Manager:
         self._quorum_future.result()
 
     def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, timeout: float
+    ) -> None:
+        with trace_span("torchft::manager::_async_quorum"):
+            self._async_quorum_inner(allow_heal, shrink_only, timeout)
+
+    def _async_quorum_inner(
         self, allow_heal: bool, shrink_only: bool, timeout: float
     ) -> None:
         try:
@@ -358,12 +366,15 @@ class Manager:
                     self._logger.info(
                         f"sending checkpoint to {result.recover_dst_replica_ranks}"
                     )
-                    self._checkpoint_transport.send_checkpoint(
-                        dst_ranks=result.recover_dst_replica_ranks,
-                        step=result.max_step,
-                        state_dict=self._manager_state_dict(),
-                        timeout=self._timeout,
-                    )
+                    with timeit(
+                        "torchft::manager::send_checkpoint", self._logger
+                    ):
+                        self._checkpoint_transport.send_checkpoint(
+                            dst_ranks=result.recover_dst_replica_ranks,
+                            step=result.max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
                 if heal:
                     self._healing = True
                     src_client = ManagerClient(
@@ -380,12 +391,15 @@ class Manager:
                         f"{result.recover_src_replica_rank} at step "
                         f"{result.max_step}"
                     )
-                    state = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=(result.recover_src_replica_rank or 0),
-                        metadata=metadata,
-                        step=result.max_step,
-                        timeout=self._timeout,
-                    )
+                    with timeit(
+                        "torchft::manager::recv_checkpoint", self._logger
+                    ):
+                        state = self._checkpoint_transport.recv_checkpoint(
+                            src_rank=(result.recover_src_replica_rank or 0),
+                            metadata=metadata,
+                            step=result.max_step,
+                            timeout=self._timeout,
+                        )
                     # torchft state applies immediately; user state is
                     # deferred to the main thread (manager.py:716-720).
                     self.load_state_dict(state["torchft"])
@@ -399,6 +413,10 @@ class Manager:
         manager.py:731-758)."""
         if self._pending_state_dict is None:
             return
+        with trace_span("torchft::manager::_apply_pending_state_dict"):
+            self._apply_pending_inner()
+
+    def _apply_pending_inner(self) -> None:
         self.wait_quorum()
         pending, self._pending_state_dict = self._pending_state_dict, None
         for key, value in pending.items():
@@ -427,6 +445,12 @@ class Manager:
         PCIe pull and the DCN wire move int8 + per-block scales instead of
         fp32 (~4x fewer bytes); the result is dequantized on device and
         wait() returns NEW jax arrays."""
+        with trace_span("torchft::manager::allreduce"):
+            return self._allreduce_inner(tensors, should_quantize)
+
+    def _allreduce_inner(
+        self, tensors: Any, should_quantize: bool = False
+    ) -> Work:
         import jax
 
         items = list(tensors) if isinstance(tensors, (list, tuple)) else [tensors]
@@ -523,6 +547,20 @@ class Manager:
 
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Distributed commit gate (reference: manager.py:760-836)."""
+        with trace_span("torchft::manager::should_commit"):
+            answer = self._should_commit_inner(timeout)
+        metrics = get_metrics_logger()
+        if metrics is not None:
+            metrics.log(
+                self._step,
+                committed=float(answer),
+                num_participants=self.num_participants(),
+                batches_committed=self._batches_committed,
+                replica_id=self._replica_id,
+            )
+        return answer
+
+    def _should_commit_inner(self, timeout: Optional[float] = None) -> bool:
         # Join the quorum thread if nothing else has (e.g. a step with no
         # allreduce); failures are latched, not raised.
         if self._quorum_future is not None:

@@ -32,7 +32,12 @@ import numpy as np
 
 from torchft_tpu import _net
 from torchft_tpu.store import StoreClient
+from torchft_tpu.telemetry import flight_recorder
 from torchft_tpu.work import DummyWork, ErrorWork, FutureWork, Work
+
+import logging
+
+logger = logging.getLogger(__name__)
 
 
 class ReduceOp(enum.Enum):
@@ -307,6 +312,13 @@ class ProcessGroupSocket(ProcessGroup):
             if self._errored is None:
                 self._errored = RuntimeError(self.WORK_POISONED)
             self._abort_locked()
+        # In-flight op dump for post-mortem, gated exactly like the
+        # reference's NCCL flight recorder (process_group.py:89-108).
+        path = flight_recorder.maybe_dump_on_abort(
+            f"pg abort: {self._errored}"
+        )
+        if path:
+            logger.warning("flight recorder dumped to %s", path)
 
     def _abort_locked(self) -> None:
         for conn in self._peers.values():
@@ -341,20 +353,28 @@ class ProcessGroupSocket(ProcessGroup):
             self._seq += 1
             return f"c{self._seq}"
 
-    def _submit(self, fn: Callable[[], Any]) -> Work:
+    def _submit(
+        self, fn: Callable[[], Any], op: str = "op", nbytes: int = 0
+    ) -> Work:
         executor = self._executor
         if executor is None or self._errored is not None:
             return ErrorWork(
                 self._errored or RuntimeError("process group not configured")
             )
+        seq = flight_recorder.record(
+            op, nbytes=nbytes, rank=self._rank, world=self._world
+        )
 
         def guarded() -> Any:
             try:
-                return fn()
+                result = fn()
             except Exception as e:
+                flight_recorder.complete(seq, error=str(e))
                 if self._errored is None:
                     self._errored = e
                 raise
+            flight_recorder.complete(seq)
+            return result
 
         try:
             return FutureWork(executor.submit(guarded))
@@ -366,7 +386,11 @@ class ProcessGroupSocket(ProcessGroup):
     def allreduce(self, tensors: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
         arrays = _as_list(tensors)
         tag = self._next_tag()
-        return self._submit(lambda: self._allreduce(arrays, op, tag))
+        return self._submit(
+            lambda: self._allreduce(arrays, op, tag),
+            op="allreduce",
+            nbytes=sum(a.nbytes for a in arrays),
+        )
 
     def _allreduce(
         self, arrays: List[np.ndarray], op: ReduceOp, tag: str
@@ -422,7 +446,7 @@ class ProcessGroupSocket(ProcessGroup):
                 ]
             return out  # type: ignore[return-value]
 
-        return self._submit(run)
+        return self._submit(run, op="allgather")
 
     def broadcast(self, tensors: Any, root: int = 0) -> Work:
         arrays = _as_list(tensors)
@@ -440,7 +464,7 @@ class ProcessGroupSocket(ProcessGroup):
                 np.copyto(a, received.reshape(a.shape).astype(a.dtype, copy=False))
             return arrays
 
-        return self._submit(run)
+        return self._submit(run, op="broadcast")
 
     def reduce_scatter(
         self, inputs: Sequence[Any], op: ReduceOp = ReduceOp.SUM
@@ -463,7 +487,7 @@ class ProcessGroupSocket(ProcessGroup):
                 acc /= self._world
             return acc
 
-        return self._submit(run)
+        return self._submit(run, op="reduce_scatter")
 
     def alltoall(self, inputs: Sequence[Any]) -> Work:
         arrays = _as_list(inputs)
@@ -483,7 +507,7 @@ class ProcessGroupSocket(ProcessGroup):
                 out[peer] = conn.recv(tag, self._timeout)
             return out  # type: ignore[return-value]
 
-        return self._submit(run)
+        return self._submit(run, op="alltoall")
 
     def barrier(self) -> Work:
         token = np.zeros(1, dtype=np.int32)
@@ -498,7 +522,7 @@ class ProcessGroupSocket(ProcessGroup):
             for i, a in enumerate(arrays):
                 conn.send(f"p2p.{base}.{i}", a)
 
-        return self._submit(run)
+        return self._submit(run, op="send")
 
     def recv(self, src: int, tag: str = "", num_tensors: int = 1) -> Work:
         base = tag or self._next_tag()
@@ -510,7 +534,7 @@ class ProcessGroupSocket(ProcessGroup):
                 for i in range(num_tensors)
             ]
 
-        return self._submit(run)
+        return self._submit(run, op="recv")
 
 
 # ---------------------------------------------------------------------------

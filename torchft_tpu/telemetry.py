@@ -1,0 +1,333 @@
+"""Tracing, timing, metrics export, and the collective flight recorder.
+
+TPU-native translation of the reference's observability subsystem:
+
+- ``trace_span(name)``: the reference wraps every hot-path method in
+  ``torch.profiler.record_function("torchft::manager::*")`` (reference:
+  manager.py:379,430,574,586,600,650,671,705,760,786,793 and
+  local_sgd.py:277,293,375,390,411). Here the same span names feed
+  ``jax.profiler.TraceAnnotation`` so they appear in XLA/perfetto traces,
+  and wall-time is accumulated in a process-local registry that tests and
+  metrics lines can read without a trace viewer.
+- ``timeit(name)``: checkpoint-transfer wall-time logging (reference:
+  http_transport.py:31-36, pg_transport.py:80-85 ``_timeit``).
+- ``MetricsLogger``: per-step scalar export as JSONL (the reference emits
+  TensorBoard scalars incl. num_participants/current_step,
+  train_diloco.py:219-232; TensorBoard isn't a dependency here so the
+  sink is a plain JSONL file any plotter can consume).
+- ``trace_window(step)``: scheduled profiler windows for train scripts
+  (reference: train_ddp.py:169-174 runs torch.profiler.profile with a
+  schedule exporting Chrome traces). Gated by env vars so production runs
+  pay nothing.
+- ``FlightRecorder``: ring buffer of recent collective ops dumped to disk
+  on PG abort when ``TORCHFT_TRIGGER_FR_ON_ABORT=true`` (reference: the
+  NCCL flight-recorder dump via named pipe, process_group.py:89-108,
+  812-813).
+
+Everything degrades to near-zero overhead: spans are two monotonic reads
+and a dict update; the recorder is a deque append; metrics/trace windows
+are off unless their env vars are set.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "trace_span",
+    "span_stats",
+    "reset_span_stats",
+    "timeit",
+    "MetricsLogger",
+    "get_metrics_logger",
+    "trace_window",
+    "FlightRecorder",
+    "flight_recorder",
+]
+
+
+# ----------------------------------------------------------------------
+# Spans
+# ----------------------------------------------------------------------
+
+class _SpanStats:
+    """Process-local span accounting: count + total/max wall seconds."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def add(self, name: str, dt: float) -> None:
+        with self._lock:
+            s = self._stats.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            s["count"] += 1
+            s["total_s"] += dt
+            if dt > s["max_s"]:
+                s["max_s"] = dt
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+_SPAN_STATS = _SpanStats()
+
+
+def span_stats() -> Dict[str, Dict[str, float]]:
+    """Snapshot of per-span {count, total_s, max_s} accumulated so far."""
+    return _SPAN_STATS.snapshot()
+
+
+def reset_span_stats() -> None:
+    _SPAN_STATS.reset()
+
+
+def _jax_annotation(name: str) -> Any:
+    """TraceAnnotation ctx if jax's profiler is importable, else None."""
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Named hot-path span: shows up in jax profiler traces AND in
+    :func:`span_stats`. Span names mirror the reference's
+    ``torchft::manager::*`` convention so traces are comparable."""
+    ann = _jax_annotation(name)
+    t0 = time.monotonic()
+    if ann is not None:
+        try:
+            ann.__enter__()
+        except Exception:
+            ann = None
+    try:
+        yield
+    finally:
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        _SPAN_STATS.add(name, time.monotonic() - t0)
+
+
+@contextlib.contextmanager
+def timeit(name: str, logger: Optional[Any] = None) -> Iterator[None]:
+    """Logs the wall-time of a block (checkpoint transfers, heals).
+    ``logger`` needs an ``info(msg)`` method; defaults to module logging."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        dt = time.monotonic() - t0
+        _SPAN_STATS.add(name, dt)
+        msg = f"{name} took {dt:.3f}s"
+        if logger is not None:
+            try:
+                logger.info(msg)
+                return
+            except Exception:
+                pass
+        import logging
+
+        logging.getLogger("torchft_tpu").info(msg)
+
+
+# ----------------------------------------------------------------------
+# Metrics (JSONL scalar sink)
+# ----------------------------------------------------------------------
+
+class MetricsLogger:
+    """Appends one JSON line per ``log`` call: {"step": N, "ts": ..., **scalars}.
+
+    The reference exports TensorBoard scalars (num_participants,
+    current_step, loss; train_diloco.py:219-232). JSONL keeps the same
+    information with zero dependencies; `jq`/pandas/TensorBoard ingest it
+    trivially.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def log(self, step: int, **scalars: Any) -> None:
+        rec: Dict[str, Any] = {"step": int(step), "ts": time.time()}
+        for k, v in scalars.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = str(v)
+        line = json.dumps(rec)
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+
+    def close(self) -> None:  # symmetry; file handle is per-write
+        pass
+
+
+_METRICS_LOGGER: Optional[MetricsLogger] = None
+_METRICS_LOCK = threading.Lock()
+
+
+def get_metrics_logger() -> Optional[MetricsLogger]:
+    """Process-wide metrics sink, enabled by ``TORCHFT_METRICS_FILE``.
+    Returns None (and costs one env read) when unset."""
+    global _METRICS_LOGGER
+    path = os.environ.get("TORCHFT_METRICS_FILE", "")
+    if not path:
+        return None
+    with _METRICS_LOCK:
+        if _METRICS_LOGGER is None or _METRICS_LOGGER._path != path:
+            _METRICS_LOGGER = MetricsLogger(path)
+        return _METRICS_LOGGER
+
+
+# ----------------------------------------------------------------------
+# Scheduled profiler windows for train scripts
+# ----------------------------------------------------------------------
+
+_TRACE_STATE = {"active": False, "stop_at": -1}
+_TRACE_LOCK = threading.Lock()
+
+
+def trace_window(step: int) -> None:
+    """Call once per train step. When ``TORCHFT_TRACE_DIR`` is set, starts a
+    ``jax.profiler`` trace at step ``TORCHFT_TRACE_START`` (default 5) and
+    stops it ``TORCHFT_TRACE_COUNT`` (default 3) steps later, writing a
+    perfetto/XPlane trace under the dir. No-op otherwise (reference:
+    train_ddp.py:169-174 scheduled profiler windows)."""
+    trace_dir = os.environ.get("TORCHFT_TRACE_DIR", "")
+    if not trace_dir:
+        return
+    start = int(os.environ.get("TORCHFT_TRACE_START", "5"))
+    count = int(os.environ.get("TORCHFT_TRACE_COUNT", "3"))
+    with _TRACE_LOCK:
+        if not _TRACE_STATE["active"] and step == start:
+            try:
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+                _TRACE_STATE["active"] = True
+                _TRACE_STATE["stop_at"] = step + count
+            except Exception:
+                _TRACE_STATE["stop_at"] = -1
+        elif _TRACE_STATE["active"] and step >= _TRACE_STATE["stop_at"]:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _TRACE_STATE["active"] = False
+
+
+# ----------------------------------------------------------------------
+# Flight recorder
+# ----------------------------------------------------------------------
+
+class FlightRecorder:
+    """Ring buffer of recent collective operations, dumped to a JSON file
+    when the PG aborts and ``TORCHFT_TRIGGER_FR_ON_ABORT`` is truthy
+    (reference: NCCL flight recorder, process_group.py:89-108,812-813).
+
+    Each record: seq, op, tag, nbytes, rank, world, status
+    (issued/ok/error), and wall timestamps. The dump answers "what was in
+    flight when the ring wedged" without a debugger attached.
+    """
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+
+    def record(
+        self,
+        op: str,
+        tag: str = "",
+        nbytes: int = 0,
+        rank: int = -1,
+        world: int = -1,
+    ) -> int:
+        """Records an issued op; returns its seq for later completion."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._buf.append(
+                {
+                    "seq": seq,
+                    "op": op,
+                    "tag": tag,
+                    "nbytes": int(nbytes),
+                    "rank": rank,
+                    "world": world,
+                    "status": "issued",
+                    "t_issued": time.time(),
+                }
+            )
+            return seq
+
+    def complete(self, seq: int, error: Optional[str] = None) -> None:
+        with self._lock:
+            for rec in reversed(self._buf):
+                if rec["seq"] == seq:
+                    rec["status"] = "error" if error else "ok"
+                    rec["t_done"] = time.time()
+                    if error:
+                        rec["error"] = error[:500]
+                    break
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._buf]
+
+    def dump(self, reason: str, path: Optional[str] = None) -> str:
+        """Writes the buffer to ``path`` (default
+        ``$TORCHFT_FR_DIR or /tmp/torchft_tpu_fr_<pid>.json``); returns the
+        path written."""
+        if path is None:
+            d = os.environ.get("TORCHFT_FR_DIR", "/tmp")
+            path = os.path.join(d, f"torchft_tpu_fr_{os.getpid()}.json")
+        payload = {
+            "reason": reason,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "ops": self.snapshot(),
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        return path
+
+    def maybe_dump_on_abort(self, reason: str) -> Optional[str]:
+        """Dump iff TORCHFT_TRIGGER_FR_ON_ABORT is truthy (the reference's
+        exact gate, process_group.py:91)."""
+        flag = os.environ.get("TORCHFT_TRIGGER_FR_ON_ABORT", "").lower()
+        if flag not in ("1", "true", "yes", "on"):
+            return None
+        try:
+            return self.dump(reason)
+        except Exception:
+            return None
+
+
+flight_recorder = FlightRecorder()

@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from flax import linen as nn
 
+from torchft_tpu import telemetry
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import OptimizerWrapper
@@ -104,8 +105,12 @@ def main() -> int:
     # DistributedSampler semantics, torchft/data.py:24-77).
     data_key = jax.random.PRNGKey(hash(replica_group) % (2**31))
 
+    metrics = telemetry.get_metrics_logger()
     while manager.current_step() < args.steps:
         step = manager.current_step()
+        # Scheduled profiler window (TORCHFT_TRACE_DIR; reference:
+        # train_ddp.py:169-174 torch.profiler schedule).
+        telemetry.trace_window(step)
         data_key, batch_key = jax.random.split(data_key)
         x, y = synthetic_batch(batch_key, args.batch_size)
 
@@ -119,6 +124,13 @@ def main() -> int:
             f"participants={manager.num_participants()} committed={committed}",
             flush=True,
         )
+        if metrics is not None:
+            metrics.log(
+                step,
+                loss=float(loss),
+                num_participants=manager.num_participants(),
+                committed=float(committed),
+            )
 
     manager.shutdown()
     print(f"[group {replica_group}] done at step {manager.current_step()}")

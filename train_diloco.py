@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from torchft_tpu import telemetry
 from torchft_tpu.local_sgd import DiLoCo, partition_fragments
 from torchft_tpu.manager import Manager
 from torchft_tpu.models import Transformer, llama_debug
@@ -120,7 +121,9 @@ def main() -> int:
     )
 
     data_key = jax.random.PRNGKey(hash(replica_group) % (2**31))
+    metrics = telemetry.get_metrics_logger()
     for inner in range(args.steps):
+        telemetry.trace_window(inner)
         data_key, kx = jax.random.split(data_key)
         x = jax.random.randint(
             kx, (args.batch_size, args.seq_len), 0, cfg.vocab_size
@@ -139,6 +142,14 @@ def main() -> int:
                 f"participants={manager.num_participants()}",
                 flush=True,
             )
+            if metrics is not None:
+                metrics.log(
+                    manager.current_step(),
+                    loss=float(loss),
+                    num_participants=manager.num_participants(),
+                    committed=float(committed),
+                    inner_step=inner,
+                )
 
     manager.shutdown()
     print(f"[group {replica_group}] done at outer step {manager.current_step()}")

@@ -120,10 +120,14 @@ def main() -> int:
     mm = ft_init_device_mesh(manager, mesh=mesh)
     logging.info("managed mesh: %r", mm)
 
+    from torchft_tpu import telemetry
+
+    metrics = telemetry.get_metrics_logger()
     losses = []
     try:
         while manager.current_step() < args.steps:
             step = manager.current_step()
+            telemetry.trace_window(step)
             manager.start_quorum()
             # Deterministic batch per step: every group that commits step k
             # computes identical params (bitwise) — heal-invariant.
@@ -146,6 +150,13 @@ def main() -> int:
                     "[group %s] step %d loss %.4f participants %d",
                     group, step, losses[-1], mm.replica_size(),
                 )
+                if metrics is not None:
+                    metrics.log(
+                        step,
+                        loss=losses[-1],
+                        num_participants=mm.replica_size(),
+                        committed=1.0,
+                    )
         if args.result_dir:
             os.makedirs(args.result_dir, exist_ok=True)
             flat = jax.tree_util.tree_leaves(params)
