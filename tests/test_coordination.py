@@ -260,3 +260,14 @@ def test_manager_server_dies_with_parent():
             child.kill()
             child.wait(timeout=10)
         lh.shutdown()
+
+
+def test_parse_addr_accepts_reference_url_forms():
+    """TORCHFT_LIGHTHOUSE in the reference is a full URL (http://host:port,
+    manager.py:76-80); both spellings must resolve identically."""
+    from torchft_tpu._net import parse_addr
+
+    assert parse_addr("127.0.0.1:29510") == ("127.0.0.1", 29510)
+    assert parse_addr("http://127.0.0.1:29510") == ("127.0.0.1", 29510)
+    assert parse_addr("http://example.com:80/") == ("example.com", 80)
+    assert parse_addr("[::1]:9") == ("::1", 9)

@@ -57,6 +57,20 @@ def test_trace_span_threadsafe():
     assert telemetry.span_stats()["test::mt"]["count"] == 200
 
 
+def test_timeit_propagates_exceptions_with_custom_logger():
+    """Regression: a `return` in timeit's finally used to swallow the
+    in-flight exception whenever a logger was passed — a failed heal
+    looked like a successful one."""
+
+    class L:
+        def info(self, msg):
+            pass
+
+    with pytest.raises(ValueError):
+        with telemetry.timeit("test::fail", L()):
+            raise ValueError("must propagate")
+
+
 def test_timeit_logs_and_records(caplog):
     telemetry.reset_span_stats()
     import logging
@@ -156,13 +170,19 @@ def test_pg_abort_dumps_flight_record(tmp_path, monkeypatch):
         for w in works:
             w.wait(10.0)
         pgs[0].abort()
-        path = os.path.join(
-            str(tmp_path / "fr"), f"torchft_tpu_fr_{os.getpid()}.json"
+        import glob
+
+        dumps = glob.glob(
+            os.path.join(str(tmp_path / "fr"), f"torchft_tpu_fr_{os.getpid()}_*.json")
         )
-        assert os.path.exists(path)
-        ops = json.load(open(path))["ops"]
+        assert len(dumps) == 1
+        ops = json.load(open(dumps[0]))["ops"]
         assert any(o["op"] == "allreduce" and o["status"] == "ok" for o in ops)
-        pgs[1].abort()
+        # Clean shutdown must NOT dump (it is not a failure) and a second
+        # abort dump must not overwrite the first.
+        pgs[1].shutdown()
+        dumps2 = glob.glob(os.path.join(str(tmp_path / "fr"), "*.json"))
+        assert dumps2 == dumps
     finally:
         store.shutdown()
 

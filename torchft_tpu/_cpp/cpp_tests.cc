@@ -429,7 +429,26 @@ static void test_manager_e2e() {
   lh.stop();
 }
 
+static void test_split_host_port() {
+  std::string host;
+  int port = 0;
+  CHECK(split_host_port("127.0.0.1:29510", &host, &port));
+  CHECK_EQ(host, std::string("127.0.0.1"));
+  CHECK_EQ(port, 29510);
+  // Reference-style URL forms (TORCHFT_LIGHTHOUSE=http://host:port).
+  CHECK(split_host_port("http://10.0.0.5:29510", &host, &port));
+  CHECK_EQ(host, std::string("10.0.0.5"));
+  CHECK_EQ(port, 29510);
+  CHECK(split_host_port("http://localhost:80/", &host, &port));
+  CHECK_EQ(host, std::string("localhost"));
+  CHECK(split_host_port("[::1]:9", &host, &port));
+  CHECK_EQ(port, 9);
+  CHECK(!split_host_port("nocolon", &host, &port));
+  CHECK(!split_host_port("http://", &host, &port));
+}
+
 int main() {
+  test_split_host_port();
   test_json();
   test_quorum_compute_basic();
   test_quorum_compute_heartbeat_expiry();

@@ -152,7 +152,17 @@ int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms) {
   }
 }
 
-bool split_host_port(const std::string& addr, std::string* host, int* port) {
+bool split_host_port(const std::string& addr_in, std::string* host, int* port) {
+  // Accept scheme-prefixed URLs (the reference's TORCHFT_LIGHTHOUSE is
+  // e.g. http://host:29510) and trailing slashes.
+  std::string addr = addr_in;
+  size_t scheme = addr.find("://");
+  if (scheme != std::string::npos) addr = addr.substr(scheme + 3);
+  if (!addr.empty() && addr[0] != '[') {  // keep [v6] brackets intact
+    size_t slash = addr.find('/');
+    if (slash != std::string::npos) addr = addr.substr(0, slash);
+  }
+  while (!addr.empty() && addr.back() == '/') addr.pop_back();
   if (addr.empty()) return false;
   size_t colon;
   if (addr[0] == '[') {  // [v6]:port

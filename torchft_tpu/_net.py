@@ -27,7 +27,15 @@ def set_keepalive(sock: socket.socket) -> None:
 
 
 def parse_addr(addr: str) -> tuple[str, int]:
-    """Splits ``host:port`` (also ``[v6]:port``)."""
+    """Splits ``host:port`` (also ``[v6]:port``). A ``scheme://`` prefix and
+    trailing ``/`` are accepted and stripped: the reference's
+    TORCHFT_LIGHTHOUSE convention is a full URL like ``http://host:29510``
+    (torchft manager.py:76-80), so both spellings must work here."""
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    if not addr.startswith("["):  # keep [v6] brackets intact
+        addr = addr.split("/", 1)[0]
+    addr = addr.rstrip("/")
     if addr.startswith("["):
         host, _, port = addr[1:].partition("]:")
     else:

@@ -21,7 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
 from torchft_tpu.checkpointing._rwlock import RWLock
-from torchft_tpu.telemetry import timeit
+from torchft_tpu.telemetry import timed, timeit
 from torchft_tpu.checkpointing._serialization import join_state, split_state
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
@@ -147,13 +147,8 @@ class HTTPTransport(CheckpointTransport):
             self._state.meta = None
             self._state.buffers = []
 
+    @timed("torchft::http_transport::recv_checkpoint")
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
-    ) -> Any:
-        with timeit("torchft::http_transport::recv_checkpoint"):
-            return self._recv_checkpoint(src_rank, metadata, step, timeout)
-
-    def _recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = metadata.rstrip("/")

@@ -18,7 +18,7 @@ import numpy as np
 from torchft_tpu.checkpointing._serialization import join_state, split_state
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.process_group import ProcessGroup
-from torchft_tpu.telemetry import timeit
+from torchft_tpu.telemetry import timed
 
 
 class PGTransport(CheckpointTransport):
@@ -41,13 +41,8 @@ class PGTransport(CheckpointTransport):
     def metadata(self) -> str:
         return "<n/a>"  # rendezvous comes from the quorum, not a URL
 
+    @timed("torchft::pg_transport::send_checkpoint")
     def send_checkpoint(
-        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
-    ) -> None:
-        with timeit("torchft::pg_transport::send_checkpoint"):
-            self._send_checkpoint(dst_ranks, step, state_dict, timeout)
-
-    def _send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
     ) -> None:
         meta, buffers = split_state(state_dict)
@@ -60,13 +55,8 @@ class PGTransport(CheckpointTransport):
             for i, buf in enumerate(buffers):
                 self._pg.send([buf], dst, tag=f"ckpt{step}.t{i}").wait(timeout)
 
+    @timed("torchft::pg_transport::recv_checkpoint")
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
-    ) -> Any:
-        with timeit("torchft::pg_transport::recv_checkpoint"):
-            return self._recv_checkpoint(src_rank, metadata, step, timeout)
-
-    def _recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         (length,) = self._pg.recv(src_rank, tag=f"ckpt{step}.len").wait(timeout)

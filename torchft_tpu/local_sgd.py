@@ -35,7 +35,7 @@ import numpy as np
 import optax
 
 from torchft_tpu.manager import Manager
-from torchft_tpu.telemetry import trace_span
+from torchft_tpu.telemetry import traced
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
@@ -93,13 +93,10 @@ class LocalSGD:
         self._local_step = 0
         return self.sync()
 
+    @traced("torchft::local_sgd::sync")
     def sync(self) -> bool:
         """Quorum + parameter average + conditional commit (reference:
         local_sgd.py:126-155)."""
-        with trace_span("torchft::local_sgd::sync"):
-            return self._sync_inner()
-
-    def _sync_inner(self) -> bool:
         manager = self._manager
         manager.start_quorum()
         params = self._get()
@@ -170,13 +167,10 @@ class _Fragment:
         # The healed local params restart from the global state.
         self._set(self._backup)
 
+    @traced("torchft::local_sgd::prepare_sync")
     def prepare_sync(self) -> None:
         """Pseudograd = backup - local, launched as an async outer allreduce
         (reference: local_sgd.py:313-326, 390-409)."""
-        with trace_span("torchft::local_sgd::prepare_sync"):
-            self._prepare_sync_inner()
-
-    def _prepare_sync_inner(self) -> None:
         current = self._get()
         dev_leaves = [
             x
@@ -223,13 +217,10 @@ class _Fragment:
             self._pending.append((work, idx_list))
         self._pending_leaves = leaves
 
+    @traced("torchft::local_sgd::perform_sync")
     def perform_sync(self) -> bool:
         """Waits the bucket allreduces, votes, and merges (reference:
         local_sgd.py:411-464). Returns the commit decision."""
-        with trace_span("torchft::local_sgd::perform_sync"):
-            return self._perform_sync_inner()
-
-    def _perform_sync_inner(self) -> bool:
         if not self._pending:
             return self._manager.should_commit()
         # Unpack-on-wait: rebuild leaves from each bucket's reduced flat.

@@ -39,7 +39,7 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.store import StoreClient, TCPStoreServer
-from torchft_tpu.telemetry import get_metrics_logger, timeit, trace_span
+from torchft_tpu.telemetry import get_metrics_logger, timeit, trace_span, traced
 from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
@@ -247,6 +247,7 @@ class Manager:
     # Quorum
     # ------------------------------------------------------------------
 
+    @traced("torchft::manager::start_quorum")
     def start_quorum(
         self,
         allow_heal: bool = True,
@@ -256,19 +257,18 @@ class Manager:
         """Begins the (possibly async) quorum for this step (reference:
         manager.py:517-573). Call at the top of the step (e.g. from
         OptimizerWrapper.zero_grad)."""
-        with trace_span("torchft::manager::start_quorum"):
-            self._errored = None
-            self._healing = False
-            self._quorum_future = self._executor.submit(
-                self._async_quorum,
-                allow_heal,
-                shrink_only,
-                timeout if timeout is not None else self._quorum_timeout,
-            )
-            if not self._use_async_quorum:
-                self.wait_quorum()
-                if self._healing:
-                    self._apply_pending_state_dict()
+        self._errored = None
+        self._healing = False
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal,
+            shrink_only,
+            timeout if timeout is not None else self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                self._apply_pending_state_dict()
 
     def wait_quorum(self) -> None:
         assert self._quorum_future is not None, (
@@ -276,13 +276,8 @@ class Manager:
         )
         self._quorum_future.result()
 
+    @traced("torchft::manager::_async_quorum")
     def _async_quorum(
-        self, allow_heal: bool, shrink_only: bool, timeout: float
-    ) -> None:
-        with trace_span("torchft::manager::_async_quorum"):
-            self._async_quorum_inner(allow_heal, shrink_only, timeout)
-
-    def _async_quorum_inner(
         self, allow_heal: bool, shrink_only: bool, timeout: float
     ) -> None:
         try:
@@ -417,6 +412,8 @@ class Manager:
             self._apply_pending_inner()
 
     def _apply_pending_inner(self) -> None:
+        # Split from _apply_pending_state_dict so the no-pending early
+        # return above stays outside the span.
         self.wait_quorum()
         pending, self._pending_state_dict = self._pending_state_dict, None
         for key, value in pending.items():
@@ -431,6 +428,7 @@ class Manager:
     # Collectives
     # ------------------------------------------------------------------
 
+    @traced("torchft::manager::allreduce")
     def allreduce(
         self, tensors: Any, should_quantize: bool = False
     ) -> Work:
@@ -445,12 +443,6 @@ class Manager:
         PCIe pull and the DCN wire move int8 + per-block scales instead of
         fp32 (~4x fewer bytes); the result is dequantized on device and
         wait() returns NEW jax arrays."""
-        with trace_span("torchft::manager::allreduce"):
-            return self._allreduce_inner(tensors, should_quantize)
-
-    def _allreduce_inner(
-        self, tensors: Any, should_quantize: bool = False
-    ) -> Work:
         import jax
 
         items = list(tensors) if isinstance(tensors, (list, tuple)) else [tensors]
@@ -547,8 +539,7 @@ class Manager:
 
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Distributed commit gate (reference: manager.py:760-836)."""
-        with trace_span("torchft::manager::should_commit"):
-            answer = self._should_commit_inner(timeout)
+        answer = self._should_commit_inner(timeout)
         metrics = get_metrics_logger()
         if metrics is not None:
             metrics.log(
@@ -560,7 +551,8 @@ class Manager:
             )
         return answer
 
-    def _should_commit_inner(self, timeout: Optional[float] = None) -> bool:
+    @traced("torchft::manager::should_commit")
+    def _should_commit_inner(self, timeout: Optional[float]) -> bool:
         # Join the quorum thread if nothing else has (e.g. a step with no
         # allreduce); failures are latched, not raised.
         if self._quorum_future is not None:

@@ -307,18 +307,20 @@ class ProcessGroupSocket(ProcessGroup):
                 max_workers=1, thread_name_prefix="pg-exec"
             )
 
-    def abort(self) -> None:
+    def abort(self, _dump: bool = True) -> None:
         with self._configure_lock:
             if self._errored is None:
                 self._errored = RuntimeError(self.WORK_POISONED)
             self._abort_locked()
         # In-flight op dump for post-mortem, gated exactly like the
         # reference's NCCL flight recorder (process_group.py:89-108).
-        path = flight_recorder.maybe_dump_on_abort(
-            f"pg abort: {self._errored}"
-        )
-        if path:
-            logger.warning("flight recorder dumped to %s", path)
+        # Clean shutdown() passes _dump=False: teardown is not a failure.
+        if _dump:
+            path = flight_recorder.maybe_dump_on_abort(
+                f"pg abort: {self._errored}"
+            )
+            if path:
+                logger.warning("flight recorder dumped to %s", path)
 
     def _abort_locked(self) -> None:
         for conn in self._peers.values():
@@ -329,7 +331,7 @@ class ProcessGroupSocket(ProcessGroup):
             self._executor = None
 
     def shutdown(self) -> None:
-        self.abort()
+        self.abort(_dump=False)
 
     def errored(self) -> Optional[Exception]:
         return self._errored
@@ -379,6 +381,7 @@ class ProcessGroupSocket(ProcessGroup):
         try:
             return FutureWork(executor.submit(guarded))
         except RuntimeError as e:  # executor shut down concurrently
+            flight_recorder.complete(seq, error=f"never ran: {e}")
             return ErrorWork(e)
 
     # -- collectives -------------------------------------------------------

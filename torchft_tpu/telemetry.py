@@ -33,17 +33,20 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import functools
 import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 __all__ = [
     "trace_span",
+    "traced",
     "span_stats",
     "reset_span_stats",
     "timeit",
+    "timed",
     "MetricsLogger",
     "get_metrics_logger",
     "trace_window",
@@ -127,26 +130,60 @@ def trace_span(name: str) -> Iterator[None]:
         _SPAN_STATS.add(name, time.monotonic() - t0)
 
 
+def traced(name: str) -> Callable:
+    """Decorator form of :func:`trace_span` — wraps the whole function body
+    in the named span."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with trace_span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def timed(name: str) -> Callable:
+    """Decorator form of :func:`timeit` — logs the function's wall-time."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with timeit(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 @contextlib.contextmanager
 def timeit(name: str, logger: Optional[Any] = None) -> Iterator[None]:
     """Logs the wall-time of a block (checkpoint transfers, heals).
-    ``logger`` needs an ``info(msg)`` method; defaults to module logging."""
+    ``logger`` needs an ``info(msg)`` method; defaults to module logging.
+    Exceptions from the block propagate (and are still timed)."""
     t0 = time.monotonic()
     try:
         yield
     finally:
+        # No return/break in this finally: it would swallow in-flight
+        # exceptions (PEP 601) — a failed heal must stay failed.
         dt = time.monotonic() - t0
         _SPAN_STATS.add(name, dt)
         msg = f"{name} took {dt:.3f}s"
+        logged = False
         if logger is not None:
             try:
                 logger.info(msg)
-                return
+                logged = True
             except Exception:
                 pass
-        import logging
+        if not logged:
+            import logging
 
-        logging.getLogger("torchft_tpu").info(msg)
+            logging.getLogger("torchft_tpu").info(msg)
 
 
 # ----------------------------------------------------------------------
@@ -206,39 +243,59 @@ def get_metrics_logger() -> Optional[MetricsLogger]:
 # Scheduled profiler windows for train scripts
 # ----------------------------------------------------------------------
 
-_TRACE_STATE = {"active": False, "stop_at": -1}
+_TRACE_STATE = {"active": False, "done": False, "stop_at": -1}
 _TRACE_LOCK = threading.Lock()
+
+
+def _trace_stop() -> None:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _TRACE_STATE["active"] = False
+    _TRACE_STATE["done"] = True
 
 
 def trace_window(step: int) -> None:
     """Call once per train step. When ``TORCHFT_TRACE_DIR`` is set, starts a
-    ``jax.profiler`` trace at step ``TORCHFT_TRACE_START`` (default 5) and
-    stops it ``TORCHFT_TRACE_COUNT`` (default 3) steps later, writing a
-    perfetto/XPlane trace under the dir. No-op otherwise (reference:
-    train_ddp.py:169-174 scheduled profiler windows)."""
+    ``jax.profiler`` trace once the step counter reaches
+    ``TORCHFT_TRACE_START`` (default 5; ``>=`` so a heal that jumps the
+    counter past it still records) and stops it ``TORCHFT_TRACE_COUNT``
+    (default 3) steps later, writing a perfetto/XPlane trace under the dir.
+    An atexit hook closes a window still open when the run ends early.
+    No-op otherwise (reference: train_ddp.py:169-174 scheduled windows)."""
     trace_dir = os.environ.get("TORCHFT_TRACE_DIR", "")
     if not trace_dir:
         return
     start = int(os.environ.get("TORCHFT_TRACE_START", "5"))
     count = int(os.environ.get("TORCHFT_TRACE_COUNT", "3"))
     with _TRACE_LOCK:
-        if not _TRACE_STATE["active"] and step == start:
+        if (
+            not _TRACE_STATE["active"]
+            and not _TRACE_STATE["done"]
+            and step >= start
+        ):
             try:
+                import atexit
+
                 import jax
 
                 jax.profiler.start_trace(trace_dir)
                 _TRACE_STATE["active"] = True
                 _TRACE_STATE["stop_at"] = step + count
+                atexit.register(_trace_atexit)
             except Exception:
-                _TRACE_STATE["stop_at"] = -1
+                _TRACE_STATE["done"] = True
         elif _TRACE_STATE["active"] and step >= _TRACE_STATE["stop_at"]:
-            try:
-                import jax
+            _trace_stop()
 
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
-            _TRACE_STATE["active"] = False
+
+def _trace_atexit() -> None:
+    with _TRACE_LOCK:
+        if _TRACE_STATE["active"]:
+            _trace_stop()
 
 
 # ----------------------------------------------------------------------
@@ -306,7 +363,12 @@ class FlightRecorder:
         path written."""
         if path is None:
             d = os.environ.get("TORCHFT_FR_DIR", "/tmp")
-            path = os.path.join(d, f"torchft_tpu_fr_{os.getpid()}.json")
+            # Millisecond-stamped name: a later dump (e.g. a clean teardown)
+            # can never overwrite the evidence from the abort that mattered.
+            path = os.path.join(
+                d,
+                f"torchft_tpu_fr_{os.getpid()}_{int(time.time() * 1000)}.json",
+            )
         payload = {
             "reason": reason,
             "pid": os.getpid(),
