@@ -302,6 +302,10 @@ def _trace_atexit() -> None:
 # Flight recorder
 # ----------------------------------------------------------------------
 
+_DUMP_LOCK = threading.Lock()
+_DUMP_COUNT = 0
+
+
 class FlightRecorder:
     """Ring buffer of recent collective operations, dumped to a JSON file
     when the PG aborts and ``TORCHFT_TRIGGER_FR_ON_ABORT`` is truthy
@@ -363,11 +367,15 @@ class FlightRecorder:
         path written."""
         if path is None:
             d = os.environ.get("TORCHFT_FR_DIR", "/tmp")
-            # Millisecond-stamped name: a later dump (e.g. a clean teardown)
-            # can never overwrite the evidence from the abort that mattered.
+            # Unique per-process counter: a later dump (e.g. a second PG
+            # aborting) can never overwrite the evidence from the abort
+            # that mattered, even within the same millisecond.
+            with _DUMP_LOCK:
+                global _DUMP_COUNT
+                _DUMP_COUNT += 1
+                n = _DUMP_COUNT
             path = os.path.join(
-                d,
-                f"torchft_tpu_fr_{os.getpid()}_{int(time.time() * 1000)}.json",
+                d, f"torchft_tpu_fr_{os.getpid()}_{n:03d}.json"
             )
         payload = {
             "reason": reason,
