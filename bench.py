@@ -102,12 +102,17 @@ def peer_main(config_path: str) -> int:
         # main process's device (Pallas) path — and vectorized numpy is the
         # right quantizer on a CPU-only peer (interpret-mode Pallas at
         # 500MB scale is unusably slow).
+        # Overlapped (streaming) schedule, mirroring the main loop: the
+        # allreduce issued for sync k is waited just before sync k+1.
+        pending = None
         for _ in range(1 + cfg["diloco_syncs"]):  # 1 untimed warmup sync
+            if pending is not None:
+                pending.wait(timeout=float(cfg["timeout"]))
+                manager.should_commit()
             manager.start_quorum()
-            manager.allreduce(grads_np, should_quantize=True).wait(
-                timeout=float(cfg["timeout"])
-            )
-            manager.should_commit()
+            pending = manager.allreduce(grads_np, should_quantize=True)
+        pending.wait(timeout=float(cfg["timeout"]))
+        manager.should_commit()
         for _ in range(cfg["ddp_iters"]):
             manager.start_quorum()
             ddp.allreduce_grads(grads_np)
@@ -338,8 +343,13 @@ def _bench_ft(
 
         # ---- loop 2: DiLoCo flagship (runs first: reuses the raw loop's
         # live train state, keeping peak HBM down) -------------------------
-        # Warmup sync (compiles the Pallas quantize/dequantize kernels and
-        # warms the wire path); untimed, mirrored by the peer.
+        # Streaming schedule (the framework's own, local_sgd.py
+        # fragment_sync_delay): the outer allreduce issued after window k
+        # overlaps the k+1 inner window and is waited just before sync
+        # k+1's vote. Warmup sync is untimed (compiles the Pallas
+        # quantize/dequantize kernels, warms the wire path).
+        from torchft_tpu import telemetry
+
         st = state
         manager.start_quorum()
         manager.allreduce(
@@ -347,29 +357,58 @@ def _bench_ft(
         ).wait(timeout=timeout)
         manager.should_commit()
 
-        allreduce_secs = []
+        telemetry.reset_span_stats()
+        exposed_wait_secs = []
+        pending = None
         t0 = time.perf_counter()
         for _ in range(diloco_syncs):
             for _ in range(sync_every):
                 st, metrics = step(st, batch)
+            if pending is not None:
+                t_w = time.perf_counter()
+                pending.wait(timeout=timeout)
+                exposed_wait_secs.append(time.perf_counter() - t_w)
+                manager.should_commit()
             manager.start_quorum()
             # Param-sized device pytree as the pseudograd payload: device
             # Pallas int8 quantize -> socket wire -> device dequantize.
-            t_ar = time.perf_counter()
-            work = manager.allreduce(
+            pending = manager.allreduce(
                 jax.tree_util.tree_leaves(st.params), should_quantize=True
             )
-            work.wait(timeout=timeout)
-            allreduce_secs.append(time.perf_counter() - t_ar)
+        if pending is not None:  # diloco_syncs >= 1
+            t_w = time.perf_counter()
+            pending.wait(timeout=timeout)
+            exposed_wait_secs.append(time.perf_counter() - t_w)
             manager.should_commit()
-        _materialize(metrics["loss"])
+            _materialize(metrics["loss"])
         total = time.perf_counter() - t0
-        inner_steps = diloco_syncs * sync_every
+        inner_steps = max(diloco_syncs * sync_every, 1)
         out["diloco_ft_ms_per_step"] = round(total / inner_steps * 1e3, 2)
-        out["outer_allreduce_ms"] = round(
-            float(np.mean(allreduce_secs)) * 1e3, 1
-        )
+        out["outer_exposed_wait_ms"] = round(
+            float(np.mean(exposed_wait_secs)) * 1e3, 1
+        ) if exposed_wait_secs else None
+        # Phase decomposition of the quantized outer allreduce (wall time
+        # per sync, from the telemetry spans the collective emits).
+        spans = telemetry.span_stats()
+        decomp = {}
+        for phase_key, span in (
+            ("quantize_pull_ms", "torchft::collectives::quantize_pull"),
+            ("wire_ms", "torchft::collectives::wire"),
+            ("dequant_push_ms", "torchft::collectives::dequant_push"),
+        ):
+            if span in spans and spans[span]["count"]:
+                decomp[phase_key] = round(
+                    spans[span]["total_s"] / spans[span]["count"] * 1e3, 1
+                )
+        out["outer_allreduce_phases"] = decomp
         out["n_replicas"] = manager.num_participants()
+        # The dev tunnel moves device<->host bytes at ~2 orders of
+        # magnitude below PCIe; report the transfer-bound share so the
+        # ratio can be read against BASELINE's production interconnect.
+        transfer_ms = decomp.get("quantize_pull_ms", 0.0) + decomp.get(
+            "dequant_push_ms", 0.0
+        )
+        out["tunnel_transfer_ms_per_sync"] = round(transfer_ms, 1)
 
         # ---- loop 3: per-step fault-tolerant DDP -------------------------
         grad_step = make_grad_step(model, mesh, shardings)

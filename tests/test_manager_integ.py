@@ -389,18 +389,23 @@ def test_upscale_while_running(lighthouse) -> None:
     joined = threading.Event()
 
     def pace_until_joined(runner, manager, step):
-        # Replicas 0/1 step slowly (but never block a quorum round: each
-        # round must complete so the lighthouse can admit the joiner into
-        # the NEXT one) until the joiner reports a 3-wide world. Without
-        # pacing, 16 fast steps finish before the joiner's manager
-        # subprocess even registers.
+        # Replicas 0/1 step slowly until the joiner reports a 3-wide
+        # world, stretching the pace near the end of the runway. Rounds
+        # must KEEP FORMING while we pace (never hold a step until joined:
+        # the lighthouse would then give the joiner solo quorums and it
+        # would sprint to completion alone), so this sleeps per step
+        # instead of blocking — and wakes immediately once joined.
         if not joined.is_set():
-            _time.sleep(0.25)
+            joined.wait(0.25 if step < 12 else 2.0)
 
     def signal_joined(runner, manager, step):
         manager.wait_quorum()
         if manager.num_participants() >= 3:
             joined.set()
+        elif not joined.is_set():
+            # Pace the joiner's own (possibly solo) rounds too, so it
+            # cannot burn through its step budget before the joint round.
+            joined.wait(0.25)
 
     runners = [
         Runner(

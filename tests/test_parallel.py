@@ -137,3 +137,71 @@ def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_chunked_loss_matches_full_logits_loss():
+    """The chunked vocab-projection loss must match the plain full-logits
+    loss — tied and untied heads, fp32 (tied computes fp32 like
+    embed.attend; untied computes in cfg.dtype like Dense)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import Transformer
+    from torchft_tpu.models.llama import llama_debug
+    from torchft_tpu.parallel.train import _loss_fn
+
+    for tied in (False, True):
+        cfg = llama_debug(
+            max_seq_len=256, dtype=jnp.float32, tie_embeddings=tied,
+            remat=False,
+        )
+        model = Transformer(cfg)
+        B, S = 2, 256  # S % 128 == 0 -> chunked path
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.randint(rng, (B, S), 0, cfg.vocab_size)
+        y = jnp.roll(x, -1, axis=1)
+        mask = jnp.ones((B, S), jnp.int32)
+        params = model.init(rng, x)["params"]
+
+        chunked = _loss_fn(model, params, x, y, mask)
+        logits = model.apply({"params": params}, x)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        full = losses.mean()
+        np.testing.assert_allclose(
+            float(chunked), float(full), rtol=2e-5,
+            err_msg=f"tied={tied}",
+        )
+
+
+def test_chunked_loss_matches_full_logits_loss_bf16_tied():
+    """bf16 + tied embeddings: the chunked head must compute in cfg.dtype
+    exactly like flax Embed.attend (which promotes query AND embedding to
+    dtype), so both loss paths agree to bf16 tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import Transformer
+    from torchft_tpu.models.llama import llama_debug
+    from torchft_tpu.parallel.train import _loss_fn
+
+    cfg = llama_debug(
+        max_seq_len=256, dtype=jnp.bfloat16, tie_embeddings=True, remat=False
+    )
+    model = Transformer(cfg)
+    B, S = 2, 256
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (B, S), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=1)
+    mask = jnp.ones((B, S), jnp.int32)
+    params = model.init(rng, x)["params"]
+
+    chunked = float(_loss_fn(model, params, x, y, mask))
+    logits = model.apply({"params": params}, x)
+    full = float(
+        optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    )
+    np.testing.assert_allclose(chunked, full, rtol=2e-2)

@@ -24,6 +24,14 @@ class FrameError(RuntimeError):
 def set_keepalive(sock: socket.socket) -> None:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    # Multi-MB tensor frames: default 64-208KB kernel buffers force the
+    # sender into lockstep with the receiver's drain rate. 4MB windows keep
+    # the pipe full (the kernel clamps to net.core.*mem_max).
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+    except OSError:
+        pass
 
 
 def parse_addr(addr: str) -> tuple[str, int]:
@@ -68,28 +76,45 @@ def connect(addr: str, timeout: float) -> socket.socket:
             backoff = min(backoff * 1.5, 10.0)
 
 
-def send_frame(sock: socket.socket, payload: bytes, timeout: Optional[float] = None) -> None:
+def send_frame(
+    sock: socket.socket,
+    payload: "bytes | bytearray | memoryview",
+    timeout: Optional[float] = None,
+) -> None:
     if timeout is not None:
         sock.settimeout(timeout)
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    n = len(payload)
+    if n < 1 << 16:
+        # Small frame: one syscall, one small copy.
+        sock.sendall(struct.pack(">I", n) + bytes(payload))
+    else:
+        # Large tensor frame: never copy the payload to prepend 4 bytes.
+        sock.sendall(struct.pack(">I", n))
+        sock.sendall(payload)
 
 
-def _recv_exact(sock: socket.socket, n: int, deadline: Optional[float]) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
+def _recv_exact(sock: socket.socket, n: int, deadline: Optional[float]) -> bytearray:
+    # Preallocated recv_into: no per-chunk allocations, no final copy. The
+    # returned bytearray doubles as a WRITABLE numpy buffer downstream
+    # (np.frombuffer(bytearray) is mutable), so tensor receives are
+    # zero-copy end to end.
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError("timed out receiving frame")
             sock.settimeout(remaining)
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
+        r = sock.recv_into(view[got:], min(n - got, 4 << 20))
+        if not r:
             raise FrameError("connection closed mid-frame")
-        buf += chunk
-    return bytes(buf)
+        got += r
+    return buf
 
 
-def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> bytes:
+def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> bytearray:
     deadline = None if timeout is None else time.monotonic() + timeout
     header = _recv_exact(sock, 4, deadline)
     (length,) = struct.unpack(">I", header)

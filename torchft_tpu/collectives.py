@@ -134,27 +134,33 @@ def allreduce_quantized_jax(
     if ws <= 1:
         return DummyWork(rebuild(flat * scale) if scale != 1.0 else arrays)
 
+    from torchft_tpu.telemetry import trace_span
+
     # Device quantize + int8 host pull happen on the caller's thread so the
     # payload is snapshotted before the caller mutates params further.
-    q_host, s_host, n = Q.quantize_for_transfer(flat)
+    with trace_span("torchft::collectives::quantize_pull"):
+        q_host, s_host, n = Q.quantize_for_transfer(flat)
     total_scale = scale / ws if op == ReduceOp.AVG else scale
 
     def run() -> List["jax.Array"]:
-        reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
-        if isinstance(reduced, np.ndarray):
-            # Tiny payload: the local reduce already produced the full fp32
-            # sum — push it straight to device, no second lossy round trip.
-            out = jnp.asarray(reduced)
-        else:
-            q_final, s_final = reduced
-            # Device-side dequantize; the sum stayed fp32 on the wire
-            # pipeline so only one quantize->dequantize round trip of error
-            # per value.
-            out = Q.fused_dequantize_int8(q_final, s_final, n)
-        if total_scale != 1.0:
-            out = out * total_scale
-        outs = rebuild(out)
-        jax.block_until_ready(outs)
+        with trace_span("torchft::collectives::wire"):
+            reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
+        with trace_span("torchft::collectives::dequant_push"):
+            if isinstance(reduced, np.ndarray):
+                # Tiny payload: the local reduce already produced the full
+                # fp32 sum — push it straight to device, no second lossy
+                # round trip.
+                out = jnp.asarray(reduced)
+            else:
+                q_final, s_final = reduced
+                # Device-side dequantize (chunked; the sum stayed fp32 on
+                # the wire pipeline so only one quantize->dequantize round
+                # trip of error per value).
+                out = Q.dequantize_from_transfer(q_final, s_final, n)
+            if total_scale != 1.0:
+                out = out * total_scale
+            outs = rebuild(out)
+            jax.block_until_ready(outs)
         return outs
 
     return FutureWork(_spawn_collective(run))

@@ -47,8 +47,14 @@ class LlamaConfig:
     param_dtype: Dtype = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
-    # 'dense' | 'ring'; ring shards the sequence over the 'sp' mesh axis.
+    # 'dense' | 'flash' | 'ring'. flash = Pallas on-chip blocked attention
+    # (ops/flash_attention.py, dense fallback for odd seq lens); ring
+    # shards the sequence over the 'sp' mesh axis.
     attn_impl: str = "dense"
+    # Below this sequence length the 'flash' impl routes to dense (measured
+    # v5e crossover; the blocked kernel wins from ~2k and is mandatory past
+    # dense's O(S^2) memory wall).
+    flash_min_seq: int = 2048
     # Bound by parallel.train when attn_impl == 'ring'.
     attn_fn: Optional[Callable[..., jax.Array]] = None
 
@@ -175,6 +181,16 @@ class Attention(nn.Module):
         if cfg.attn_impl == "ring":
             assert cfg.attn_fn is not None, "ring attention needs cfg.attn_fn"
             out = cfg.attn_fn(q, k, v)
+        elif cfg.attn_impl == "flash":
+            from torchft_tpu.ops.flash_attention import (
+                flash_attention,
+                supports,
+            )
+
+            if q.shape[1] >= cfg.flash_min_seq and supports(q.shape[1]):
+                out = flash_attention(q, k, v)
+            else:
+                out = dense_attention(q, k, v)
         else:
             out = dense_attention(q, k, v)
         return nn.DenseGeneral(
@@ -237,8 +253,16 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jax.Array, positions: Optional[jax.Array] = None
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        return_hidden: bool = False,
     ) -> jax.Array:
+        """``return_hidden=True`` returns the post-final-norm hidden states
+        [B,S,H] in cfg.dtype instead of logits — the chunked-loss path
+        (parallel/train.py:_loss_fn) projects them onto the vocab in
+        sequence chunks so the full [B,S,V] fp32 logits are never
+        materialized."""
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -272,6 +296,8 @@ class Transformer(nn.Module):
         )(cfg, name="layers")
         x, _ = stack(x, cos, sin)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(cfg.param_dtype))
         else:

@@ -181,16 +181,70 @@ def fused_reduce_int8(
     return qo, so[:, 0]
 
 
+# Elements per quantize-and-pull chunk. Bounds peak device memory at
+# ~5 bytes/elem of extra HBM (padded fp32 copy + int8 + scales) no matter
+# how large the payload: a 500 MB pseudograd otherwise needs >1 GB of
+# transient HBM, which OOMs on a shared/tunneled chip whose HBM budget is
+# a fraction of the hardware's.
+_TRANSFER_CHUNK = 16 * 1024 * 1024  # 16M elems = 64 MB fp32 per chunk
+
+
 def quantize_for_transfer(x: jax.Array) -> Tuple[np.ndarray, np.ndarray, int]:
     """Device-quantize then pull to host: the device->host (and then DCN)
     transfer moves int8 + per-block scales instead of fp32. The returned
     (flat int8 [blocks*BLOCK], scales [blocks], n) is exactly the layout of
     ``collectives.quantize_blockwise``, so the receiving host (or device,
-    via :func:`fused_dequantize_int8`) can decode it directly."""
-    q, s, n = fused_quantize_int8(x)
-    blocks = (n + BLOCK - 1) // BLOCK
-    return (
-        np.asarray(q).reshape(-1)[: blocks * BLOCK],
-        np.asarray(s)[:blocks],
-        n,
-    )
+    via :func:`fused_dequantize_int8`) can decode it directly.
+
+    Large payloads are processed in ``_TRANSFER_CHUNK``-element slices,
+    each pulled to host before the next is quantized, so device memory
+    stays bounded. Chunks are BLOCK-aligned, so the concatenated host
+    layout is bit-identical to the single-shot path."""
+    flat = x.reshape(-1)
+    n = flat.size
+    if n <= _TRANSFER_CHUNK:
+        q, s, _ = fused_quantize_int8(flat)
+        blocks = (n + BLOCK - 1) // BLOCK
+        return (
+            np.asarray(q).reshape(-1)[: blocks * BLOCK],
+            np.asarray(s)[:blocks],
+            n,
+        )
+    q_parts = []
+    s_parts = []
+    for start in range(0, n, _TRANSFER_CHUNK):
+        piece = flat[start : start + _TRANSFER_CHUNK]
+        q, s, m = fused_quantize_int8(piece)
+        blocks = (m + BLOCK - 1) // BLOCK
+        q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
+        s_parts.append(np.asarray(s)[:blocks])
+        del q, s
+    return np.concatenate(q_parts), np.concatenate(s_parts), n
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _place_chunk(buf: jax.Array, piece: jax.Array, start) -> jax.Array:
+    """Donated in-place write of a dequantized chunk into the output
+    buffer — no second full-size copy is ever alive."""
+    return jax.lax.dynamic_update_slice(buf, piece, (start,))
+
+
+def dequantize_from_transfer(
+    q: np.ndarray, scales: np.ndarray, n: int
+) -> jax.Array:
+    """Host int8 payload -> device fp32, chunked like
+    :func:`quantize_for_transfer`: each chunk is dequantized and written
+    (buffer-donated) into a preallocated output, so peak transient HBM is
+    output + one chunk regardless of payload size."""
+    if n <= _TRANSFER_CHUNK:
+        return fused_dequantize_int8(q, scales, n)
+    blocks_per_chunk = _TRANSFER_CHUNK // BLOCK
+    out = jnp.zeros((n,), jnp.float32)
+    for start_blk in range(0, (n + BLOCK - 1) // BLOCK, blocks_per_chunk):
+        start = start_blk * BLOCK
+        q_piece = q[start : (start_blk + blocks_per_chunk) * BLOCK]
+        s_piece = scales[start_blk : start_blk + blocks_per_chunk]
+        m = min(q_piece.size, n - start)
+        piece = fused_dequantize_int8(q_piece, s_piece, m)
+        out = _place_chunk(out, piece, jnp.asarray(start))
+    return out

@@ -107,11 +107,57 @@ def init_train_state(
     return state, shardings
 
 
+# Tokens per chunked-loss slice. The [B,S,V] fp32 logits of a 32k-vocab
+# model at B=8,S=1024 are >1 GB and their log_softmax + backward dlogits
+# multiply that — the dominant HBM transient of the whole step. Chunking
+# bounds it at [B,_LOSS_CHUNK,V] (~130 MB) with jax.checkpoint recompute.
+_LOSS_CHUNK = 128
+
+
+def _lm_head_projection(model: Transformer, params):
+    """The vocab projection [H, V] straight from the param pytree — same
+    tensors as the model's own head. Both head forms compute in cfg.dtype:
+    flax's Dense casts input+kernel to ``dtype``, and Embed.attend promotes
+    query AND embedding to ``dtype`` too (so the model's
+    ``attend(x.astype(param_dtype))`` still multiplies in cfg.dtype)."""
+    cfg = model.cfg
+    if cfg.tie_embeddings:
+        return params["embed"]["embedding"].T, cfg.dtype
+    return params["lm_head"]["kernel"], cfg.dtype
+
+
 def _loss_fn(model: Transformer, params, inputs, targets, mask):
-    logits = model.apply({"params": params}, inputs)
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    mask = mask.astype(jnp.float32)
-    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    B, S = inputs.shape
+    C = min(_LOSS_CHUNK, S)
+    mask_f = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask_f.sum(), 1.0)
+    if S % C != 0:  # odd seq len: the plain full-logits path
+        logits = model.apply({"params": params}, inputs)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        )
+        return (losses * mask_f).sum() / denom
+
+    h = model.apply({"params": params}, inputs, return_hidden=True)
+    w, head_dtype = _lm_head_projection(model, params)
+    w = w.astype(head_dtype)
+    n = S // C
+    h_r = jnp.moveaxis(h.reshape(B, n, C, h.shape[-1]), 1, 0)  # [n,B,C,H]
+    t_r = jnp.moveaxis(targets.reshape(B, n, C), 1, 0)
+    m_r = jnp.moveaxis(mask_f.reshape(B, n, C), 1, 0)
+
+    def chunk(acc, xs):
+        hc, tc, mc = xs
+        logits = jnp.dot(
+            hc.astype(head_dtype), w, preferred_element_type=jnp.float32
+        )  # [B,C,V] fp32, exists only inside this chunk
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return acc + (losses * mc).sum(), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(chunk), jnp.zeros((), jnp.float32), (h_r, t_r, m_r)
+    )
+    return total / denom
 
 
 def make_train_step(

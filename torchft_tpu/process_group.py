@@ -175,7 +175,8 @@ class _PeerConn:
         if self.dead is not None:
             raise RuntimeError(f"connection to rank {self.peer} dead: {self.dead}")
         header = {"tag": tag, "dtype": str(arr.dtype), "shape": list(arr.shape)}
-        data = np.ascontiguousarray(arr).tobytes()
+        # Zero-copy: sendall consumes the array's buffer directly.
+        data = memoryview(np.ascontiguousarray(arr)).cast("B")
         with self.send_lock:
             _net.send_json(self.sock, header)
             _net.send_frame(self.sock, data)
@@ -199,9 +200,11 @@ class _PeerConn:
             q = self._queues.get(tag)
             if q is not None and q.empty():
                 del self._queues[tag]
+        # payload is a bytearray (writable buffer): frombuffer is already
+        # a mutable array over it, no copy needed.
         return np.frombuffer(payload, dtype=np.dtype(header["dtype"])).reshape(
             header["shape"]
-        ).copy()
+        )
 
     def close(self) -> None:
         try:

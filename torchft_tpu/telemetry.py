@@ -367,15 +367,18 @@ class FlightRecorder:
         path written."""
         if path is None:
             d = os.environ.get("TORCHFT_FR_DIR", "/tmp")
-            # Unique per-process counter: a later dump (e.g. a second PG
-            # aborting) can never overwrite the evidence from the abort
-            # that mattered, even within the same millisecond.
+            # Timestamp (unique across process restarts with recycled
+            # PIDs, e.g. PID 1 in a container) + per-process counter
+            # (unique within a millisecond): a later dump can never
+            # overwrite the evidence from the abort that mattered.
             with _DUMP_LOCK:
                 global _DUMP_COUNT
                 _DUMP_COUNT += 1
                 n = _DUMP_COUNT
             path = os.path.join(
-                d, f"torchft_tpu_fr_{os.getpid()}_{n:03d}.json"
+                d,
+                f"torchft_tpu_fr_{os.getpid()}_"
+                f"{int(time.time() * 1000)}_{n:03d}.json",
             )
         payload = {
             "reason": reason,
