@@ -24,9 +24,14 @@ class FrameError(RuntimeError):
 def set_keepalive(sock: socket.socket) -> None:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-    # Multi-MB tensor frames: default 64-208KB kernel buffers force the
-    # sender into lockstep with the receiver's drain rate. 4MB windows keep
-    # the pipe full (the kernel clamps to net.core.*mem_max).
+
+
+def set_buffer_sizes(sock: socket.socket) -> None:
+    """Multi-MB tensor frames: default 64-208KB kernel buffers force the
+    sender into lockstep with the receiver's drain rate. 4MB windows keep
+    the pipe full (the kernel clamps to net.core.*mem_max). MUST run before
+    connect()/listen(): the receive window scale is fixed at the SYN
+    handshake, and accepted sockets inherit the listener's sizes."""
     try:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
@@ -67,7 +72,16 @@ def connect(addr: str, timeout: float) -> socket.socket:
                 f"could not connect to {addr} within {timeout}s: {last_err}"
             )
         try:
-            sock = socket.create_connection((host, port), timeout=min(remaining, 5.0))
+            # Manual socket so buffer sizes are set BEFORE the handshake
+            # (create_connection would connect first).
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            set_buffer_sizes(sock)
+            sock.settimeout(min(remaining, 5.0))
+            try:
+                sock.connect((host, port))
+            except BaseException:
+                sock.close()
+                raise
             set_keepalive(sock)
             return sock
         except OSError as e:  # noqa: PERF203

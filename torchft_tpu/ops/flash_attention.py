@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "supports"]
+__all__ = ["flash_attention", "flash_attention_block", "supports"]
 
 _NEG_INF = -1e30
 
@@ -66,12 +66,145 @@ def supports(seq_len: int, block_q: int = 512, block_k: int = 512) -> bool:
     )
 
 
+# ---------------------------------------------------------------------------
+# Shared per-block step math. Every kernel below (causal and offset-block,
+# forward and backward) delegates here so the numerics live in exactly one
+# place; kernels differ only in their mask closure and skip predicate.
+# All matmuls run in the INPUT dtype (bf16 hits the MXU at full rate; fp32
+# would be emulated) with fp32 accumulation; softmax math stays fp32.
+# ---------------------------------------------------------------------------
+
+
+def _scores(q_ref, k_ref, scale, mask_fn):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [block_q, block_k] fp32
+    return mask_fn(s)
+
+
+def _fwd_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale, mask_fn):
+    """One online-softmax accumulation of a kv block into the scratch."""
+    s = _scores(q_ref, k_ref, scale, mask_fn)
+    m_prev = m_ref[:, :1]  # [block_q, 1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    v = v_ref[0, 0]
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _fwd_finish(o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    """Final normalization + logsumexp residual write."""
+    # All-masked rows can't happen under causal (the diagonal is always
+    # kept) but CAN in an offset block entirely in the future: denom guard
+    # makes out 0 and lse ~ -1e30, which the block merge weighs to zero.
+    denom = jnp.maximum(l_ref[:, :1], 1e-30)
+    o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+    # TPU tiles need the last two block dims (sublane, lane) aligned, so
+    # the per-row LSE is broadcast across 8 sublanes: array [B,H,8,S].
+    lse = (m_ref[:, :1] + jnp.log(denom))[:, 0]  # [block_q]
+    lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+def _bwd_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+            scale, mask_fn):
+    """Recomputes P and the softmax-jacobian term dS for a block.
+    ``dlse_ref`` is None when the caller's lse output carries no cotangent
+    (plain flash_attention returns only out); for the block variant
+    d lse_i / d s_ij = p_ij folds the lse cotangent straight into dS."""
+    s = _scores(q_ref, k_ref, scale, mask_fn)
+    lse = lse_ref[0, 0, 0][:, None]  # [block_q, 1]
+    delta = delta_ref[0, 0, 0][:, None]
+    p = jnp.exp(s - lse)  # [block_q, block_k] fp32 (normalized)
+    do = do_ref[0, 0]
+    v = v_ref[0, 0]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dsum = dp - delta
+    if dlse_ref is not None:
+        dsum = dsum + dlse_ref[0, 0, 0][:, None]
+    return p, p * dsum
+
+
+def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+                 dq_acc, scale, mask_fn):
+    _, ds = _bwd_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+        scale, mask_fn,
+    )
+    k = k_ref[0, 0]
+    dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+
+def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+                  dk_acc, dv_acc, scale, mask_fn):
+    p, ds = _bwd_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+        scale, mask_fn,
+    )
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    # dv += P^T @ dO
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # dk += dS^T @ Q * scale
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+
+def _static_mask(causal, q_start, k_start):
+    def mask_fn(s):
+        if not causal:
+            return s
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+        return jnp.where(rows >= cols, s, _NEG_INF)
+
+    return mask_fn
+
+
+def _dynamic_mask(q_start, k_start, qoff, koff):
+    def mask_fn(s):
+        return _offset_mask(s, q_start, k_start, qoff, koff)
+
+    return mask_fn
+
+
+def _offset_mask(s, q_start, k_start, qoff, koff):
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start + qoff
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start + koff
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
 def _flash_kernel(
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
     o_ref,  # [1, 1, block_q, D]
-    lse_ref,  # [1, 1, 8, block_q] f32 (logsumexp residual, sublane-broadcast)
+    lse_ref,  # [1, 1, 8, block_q] f32 (logsumexp residual)
     acc_ref,  # VMEM [block_q, D] f32
     m_ref,  # VMEM [block_q, 128] f32 (row max, lane-broadcast)
     l_ref,  # VMEM [block_q, 128] f32 (row sum, lane-broadcast)
@@ -92,67 +225,21 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
 
     # Causal: skip blocks strictly above the diagonal (no q row attends
-    # into them).
+    # into them; their DMA is elided by the clamped index maps).
     q_start = iq * block_q
     k_start = ik * block_k
     run = (not causal) or (k_start <= q_start + block_q - 1)
 
     @pl.when(run)
     def _step():
-        # Matmuls run in the INPUT dtype (bf16 hits the MXU at full rate;
-        # fp32 would be emulated) with fp32 accumulation; softmax math
-        # stays fp32.
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        s = (
-            jax.lax.dot_general(
-                q,
-                k,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # [block_q, block_k] fp32
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-
-        m_prev = m_ref[:, :1]  # [block_q, 1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
-        p = jnp.exp(s - m_new)  # [block_q, block_k]
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0]
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype),
-            v,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _fwd_step(
+            q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale,
+            _static_mask(causal, q_start, k_start),
         )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(ik == nk - 1)
     def _finish():
-        # All-masked rows can't happen under causal (the diagonal is always
-        # kept), but guard the division anyway.
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
-        # Logsumexp residual for the backward pass. TPU tiles need the
-        # last two block dims (sublane, lane) aligned, so the per-row LSE
-        # is broadcast across 8 sublanes: array [B,H,8,S], rows in lanes.
-        lse = (m_ref[:, :1] + jnp.log(denom))[:, 0]  # [block_q]
-        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
-
-
-
-# ---------------------------------------------------------------------------
-# Backward kernels (standard flash-attention backward: recompute P per block
-# from the saved logsumexp; Dao et al. 2022 Alg. 4)
-# ---------------------------------------------------------------------------
+        _fwd_finish(o_ref, lse_ref, acc_ref, m_ref, l_ref)
 
 
 def _flash_bwd_dq_kernel(
@@ -184,34 +271,10 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0, 0][:, None]  # [block_q, 1]
-        delta = delta_ref[0, 0, 0][:, None]
-
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
+        _bwd_dq_step(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+            dq_acc, scale, _static_mask(causal, q_start, k_start),
         )
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # [block_q, block_k] fp32
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta)).astype(k.dtype)
-        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
 
     @pl.when(ik == nk - 1)
     def _finish():
@@ -257,40 +320,10 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0, 0][:, None]
-        delta = delta_ref[0, 0, 0][:, None]
-
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
+        _bwd_dkv_step(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+            dk_acc, dv_acc, scale, _static_mask(causal, q_start, k_start),
         )
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # [block_q, block_k]
-        # dv += P^T @ dO
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta)
-        # dk += dS^T @ Q * scale
-        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
 
     @pl.when(inner == n_inner - 1)
     def _finish():
@@ -501,3 +534,308 @@ def flash_attention(
     vt = jnp.swapaxes(v, 1, 2)
     out = _flash(qt, kt, vt, causal, block_q, block_k, itp)
     return jnp.swapaxes(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Offset-aware block variant for ring attention (parallel/ring_attention.py):
+# full attention of a local q shard against one streamed k/v block, with the
+# causal mask evaluated at GLOBAL positions (q_offset / k_offset are dynamic
+# SMEM scalars — each ring step sees a different source block). Returns
+# (out, lse) so the caller can merge blocks with the standard online-softmax
+# combination.
+# ---------------------------------------------------------------------------
+
+
+def _flash_block_fwd_kernel(
+    qoff_ref,  # SMEM [1, 1] i32
+    koff_ref,  # SMEM [1, 1] i32
+    q_ref, k_ref, v_ref,  # [1, 1, block, D]
+    o_ref,  # [1, 1, block_q, D]
+    lse_ref,  # [1, 1, 8, block_q]
+    acc_ref, m_ref, l_ref,  # VMEM scratch
+    *, scale: float, block_q: int, block_k: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qoff = qoff_ref[0, 0]
+    koff = koff_ref[0, 0]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # Dynamic skip: this kv block is entirely in this q block's future.
+    run = (k_start + koff) <= (q_start + qoff + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        _fwd_step(
+            q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale,
+            _dynamic_mask(q_start, k_start, qoff, koff),
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        _fwd_finish(o_ref, lse_ref, acc_ref, m_ref, l_ref)
+
+
+def _flash_block_bwd_dq_kernel(
+    qoff_ref, koff_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+    dq_ref,
+    dq_acc,
+    *, scale: float, block_q: int, block_k: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qoff = qoff_ref[0, 0]
+    koff = koff_ref[0, 0]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    run = (k_start + koff) <= (q_start + qoff + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        _bwd_dq_step(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+            dq_acc, scale, _dynamic_mask(q_start, k_start, qoff, koff),
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_block_bwd_dkv_kernel(
+    qoff_ref, koff_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+    dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, block_q: int, block_k: int, nq: int, q_per_kv: int,
+):
+    ik = pl.program_id(2)
+    inner = pl.program_id(3)
+    n_inner = pl.num_programs(3)
+    iq = inner % nq
+    qoff = qoff_ref[0, 0]
+    koff = koff_ref[0, 0]
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    run = (k_start + koff) <= (q_start + qoff + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        _bwd_dkv_step(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+            dk_acc, dv_acc, scale,
+            _dynamic_mask(q_start, k_start, qoff, koff),
+        )
+
+    @pl.when(inner == n_inner - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+
+def _smem_spec():
+    return pl.BlockSpec(
+        (1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM
+    )
+
+
+def _block_forward_impl(qt, kt, vt, qoff, koff, block_q, block_k, interpret):
+    B, Hq, Sq, D = qt.shape
+    Hkv, Skv = kt.shape[1], kt.shape[2]
+    q_per_kv = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    grid = (B, Hq, Sq // block_q, Skv // block_k)
+    kv_idx = lambda b, h, iq, ik: (b, h // q_per_kv, ik, 0)  # noqa: E731
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_block_fwd_kernel,
+            scale=scale, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Sq, D), qt.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 8, Sq), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, iq, ik: (b, h, 0, iq)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, qt, kt, vt)
+    return out, lse
+
+
+def _block_backward_impl(
+    qt, kt, vt, qoff, koff, do, lse, delta, dlse, block_q, block_k, interpret
+):
+    B, Hq, Sq, D = qt.shape
+    Hkv, Skv = kt.shape[1], kt.shape[2]
+    q_per_kv = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // q_per_kv, ik, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, 8, block_q), lambda b, h, iq, ik: (b, h, 0, iq)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_block_bwd_dq_kernel,
+            scale=scale, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), qt.dtype),
+        grid=(B, Hq, Sq // block_q, Skv // block_k),
+        in_specs=[_smem_spec(), _smem_spec(),
+                  q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qoff, koff, qt, kt, vt, do, lse, delta, dlse)
+
+    nq = Sq // block_q
+    q_spec2 = pl.BlockSpec(
+        (1, 1, block_q, D),
+        lambda b, hk, ik, inner: (b, hk * q_per_kv + inner // nq, inner % nq, 0),
+    )
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, hk, ik, inner: (b, hk, ik, 0)
+    )
+    row_spec2 = pl.BlockSpec(
+        (1, 1, 8, block_q),
+        lambda b, hk, ik, inner: (b, hk * q_per_kv + inner // nq, 0, inner % nq),
+    )
+    dkv_out = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, hk, ik, inner: (b, hk, ik, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_block_bwd_dkv_kernel,
+            scale=scale, block_q=block_q, block_k=block_k,
+            nq=nq, q_per_kv=q_per_kv,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), kt.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), vt.dtype),
+        ],
+        grid=(B, Hkv, Skv // block_k, q_per_kv * nq),
+        in_specs=[_smem_spec(), _smem_spec(),
+                  q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[dkv_out, dkv_out],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, qt, kt, vt, do, lse, delta, dlse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_block(qt, kt, vt, qoff, koff, block_q, block_k, interpret):
+    return _block_forward_impl(
+        qt, kt, vt, qoff, koff, block_q, block_k, interpret
+    )
+
+
+def _flash_block_fwd(qt, kt, vt, qoff, koff, block_q, block_k, interpret):
+    out, lse = _block_forward_impl(
+        qt, kt, vt, qoff, koff, block_q, block_k, interpret
+    )
+    return (out, lse), (qt, kt, vt, qoff, koff, out, lse)
+
+
+def _flash_block_bwd(block_q, block_k, interpret, res, cts):
+    qt, kt, vt, qoff, koff, out, lse = res
+    do, dlse = cts  # BOTH outputs carry cotangents (the ring merge uses lse)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    delta = jnp.broadcast_to(
+        delta[:, :, None, :], (*delta.shape[:2], 8, delta.shape[-1])
+    )
+    # dlse is already in the raw [B,Hq,8,S] kernel layout (the sublane
+    # slice happens in the public wrapper, outside this vjp); the kernels
+    # read sublane 0, which is exactly where the slice cotangent lands.
+    dq, dk, dv = _block_backward_impl(
+        qt, kt, vt, qoff, koff, do, lse, delta,
+        dlse.astype(jnp.float32), block_q, block_k, interpret,
+    )
+    return dq, dk, dv, None, None
+
+
+_flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
+def flash_attention_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array,
+    k_offset: jax.Array,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> tuple:
+    """One causal-at-global-positions attention block: q [B,Sq,Hq,D]
+    against k/v [B,Skv,Hkv,D], where q row i has global position
+    ``q_offset + i`` and k col j has ``k_offset + j`` (both dynamic int32
+    scalars). Returns ``(out [B,Sq,Hq,D], lse [B,Hq,Sq] fp32)`` — merge
+    streamed blocks with the online-softmax combine (see
+    parallel/ring_attention.py). Differentiable (offsets get no grad)."""
+    B, Sq, Hq, D = q.shape
+    Skv = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Skv)
+    if not (supports(Sq, block_q, block_q) and supports(Skv, block_k, block_k)):
+        raise ValueError(
+            f"flash_attention_block: shapes (Sq={Sq}, Skv={Skv}) not "
+            f"block-divisible; use the dense fold"
+        )
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    itp = _interpret() if interpret is None else interpret
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, lse = _flash_block(qt, kt, vt, qoff, koff, block_q, block_k, itp)
+    # lse is sublane-broadcast [B,Hq,8,Sq]; take one sublane.
+    return jnp.swapaxes(out, 1, 2), lse[:, :, 0, :]

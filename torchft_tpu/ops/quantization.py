@@ -212,13 +212,24 @@ def quantize_for_transfer(x: jax.Array) -> Tuple[np.ndarray, np.ndarray, int]:
         )
     q_parts = []
     s_parts = []
+    # Double-buffered: chunk i+1's quantize kernel is dispatched (async)
+    # before chunk i's host pull blocks, so kernel time hides under the
+    # transfer. Peak extra HBM = 2 chunks.
+    pending = []  # [(q, s, m)]
     for start in range(0, n, _TRANSFER_CHUNK):
         piece = flat[start : start + _TRANSFER_CHUNK]
-        q, s, m = fused_quantize_int8(piece)
-        blocks = (m + BLOCK - 1) // BLOCK
-        q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
-        s_parts.append(np.asarray(s)[:blocks])
-        del q, s
+        pending.append(fused_quantize_int8(piece))
+        if len(pending) > 1:
+            q, s, m = pending.pop(0)
+            blocks = (m + BLOCK - 1) // BLOCK
+            q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
+            s_parts.append(np.asarray(s)[:blocks])
+            del q, s
+    q, s, m = pending.pop(0)
+    blocks = (m + BLOCK - 1) // BLOCK
+    q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
+    s_parts.append(np.asarray(s)[:blocks])
+    del q, s
     return np.concatenate(q_parts), np.concatenate(s_parts), n
 
 

@@ -40,6 +40,65 @@ def shard_map(f, *, mesh, in_specs, out_specs):
         )
 
 
+def _flash_fold_supported(sq: int, skv: int) -> bool:
+    from torchft_tpu.ops.flash_attention import supports
+
+    # The pallas fold needs block-divisible shard lengths; tiny shards
+    # (tests, debug models) stay on the fused-XLA dense fold.
+    return sq >= 256 and skv >= 256 and supports(sq) and supports(skv)
+
+
+def ring_attention_shard_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Pallas-accelerated per-shard ring body: each streamed k/v block is
+    folded with :func:`ops.flash_attention.flash_attention_block` (on-chip
+    blocked attention at GLOBAL positions) and merged via the online-softmax
+    combine. Same semantics as :func:`ring_attention_shard` with
+    ``causal=True``; preferred for production shard sizes (the dense fold
+    materializes [B,H,Sq,Skv] fp32 scores per step)."""
+    from torchft_tpu.ops.flash_attention import flash_attention_block
+
+    axis_size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, dh = q.shape
+    skv = k.shape[1]
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    q_off = idx * sq
+
+    out0 = jnp.zeros((b, sq, hq, dh), jnp.float32)
+    lse0 = jnp.full((b, hq, sq), -jnp.inf, jnp.float32)
+
+    def fold(i, k_blk, v_blk, out, lse):
+        src = (idx - i) % axis_size
+        o_blk, lse_blk = flash_attention_block(
+            q, k_blk, v_blk, q_off, src * skv
+        )
+        new_lse = jnp.logaddexp(lse, lse_blk)
+        safe = jnp.where(jnp.isfinite(new_lse), new_lse, 0.0)
+        w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - safe), 0.0)
+        w_new = jnp.where(jnp.isfinite(lse_blk), jnp.exp(lse_blk - safe), 0.0)
+        wt = lambda w: jnp.swapaxes(w, 1, 2)[..., None]  # noqa: E731
+        out = out * wt(w_old) + o_blk.astype(jnp.float32) * wt(w_new)
+        return out, new_lse
+
+    def body(i, carry):
+        k_blk, v_blk, out, lse = carry
+        out, lse = fold(i, k_blk, v_blk, out, lse)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, out, lse
+
+    k_blk, v_blk, out, lse = jax.lax.fori_loop(
+        0, axis_size - 1, body, (k, v, out0, lse0)
+    )
+    out, _ = fold(axis_size - 1, k_blk, v_blk, out, lse)
+    return out.astype(q.dtype)
+
+
 def ring_attention_shard(
     q: jax.Array,
     k: jax.Array,
@@ -112,10 +171,15 @@ def make_ring_attention(
     seq_axis: str = "sp",
     head_axis: Optional[str] = "tp",
     causal: bool = True,
+    use_flash: Optional[bool] = None,
 ):
     """Returns attn_fn(q, k, v) usable inside a pjit'd program: shards
     [B, S, H, Dh] with batch over ``batch_axes``, sequence over ``seq_axis``,
-    heads over ``head_axis``, and runs the ring per shard."""
+    heads over ``head_axis``, and runs the ring per shard.
+
+    ``use_flash``: fold each streamed block with the Pallas kernel
+    (ops/flash_attention.py) instead of the dense einsum. Default (None)
+    auto-selects it for causal rings with production-sized shards."""
     spec = P(batch_axes, seq_axis, head_axis, None)
 
     @partial(
@@ -125,6 +189,13 @@ def make_ring_attention(
         out_specs=spec,
     )
     def attn_fn(q, k, v):
+        sq, skv = q.shape[1], k.shape[1]
+        flash = use_flash
+        if flash is None:
+            flash = causal and _flash_fold_supported(sq, skv)
+        if flash:
+            assert causal, "flash ring fold is causal-only"
+            return ring_attention_shard_flash(q, k, v, axis_name=seq_axis)
         return ring_attention_shard(q, k, v, axis_name=seq_axis, causal=causal)
 
     return attn_fn

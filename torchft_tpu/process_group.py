@@ -273,6 +273,8 @@ class ProcessGroupSocket(ProcessGroup):
 
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            # Accepted sockets inherit these; must precede listen().
+            _net.set_buffer_sizes(listener)
             listener.bind(("0.0.0.0", 0))
             listener.listen(world_size)
             port = listener.getsockname()[1]
