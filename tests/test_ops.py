@@ -262,3 +262,26 @@ def test_chunked_transfer_layout_matches_single_shot(monkeypatch):
     np.testing.assert_array_equal(sc, s1)
     backc = np.asarray(Q.dequantize_from_transfer(qc, sc, n))
     np.testing.assert_array_equal(backc, back1)
+
+
+def test_flash_gradients_bf16_tolerance():
+    """bf16 backward: operands in bf16, accumulation fp32 (intentional —
+    matches the forward and the MXU's native mode); pin the tolerance vs
+    the bf16 dense reference so precision regressions are visible."""
+    from torchft_tpu.models.llama import dense_attention
+    from torchft_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (1, 256, 4, 64), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 256, 2, 64), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 256, 2, 64), jnp.bfloat16)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(lambda *a: loss(flash_attention, *a), (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: loss(dense_attention, *a), (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+        rel = float(jnp.max(jnp.abs(a32 - b32)) / (jnp.max(jnp.abs(b32)) + 1e-9))
+        assert rel < 5e-2, rel

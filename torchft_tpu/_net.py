@@ -72,18 +72,25 @@ def connect(addr: str, timeout: float) -> socket.socket:
                 f"could not connect to {addr} within {timeout}s: {last_err}"
             )
         try:
-            # Manual socket so buffer sizes are set BEFORE the handshake
-            # (create_connection would connect first).
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            set_buffer_sizes(sock)
-            sock.settimeout(min(remaining, 5.0))
-            try:
-                sock.connect((host, port))
-            except BaseException:
-                sock.close()
-                raise
-            set_keepalive(sock)
-            return sock
+            # Manual socket (not create_connection) so buffer sizes are
+            # set BEFORE the handshake; getaddrinfo keeps IPv6 and
+            # multi-address hostnames working.
+            last_exc: Optional[OSError] = None
+            for family, stype, proto, _, addr_tuple in socket.getaddrinfo(
+                host, port, type=socket.SOCK_STREAM
+            ):
+                sock = socket.socket(family, stype, proto)
+                set_buffer_sizes(sock)
+                sock.settimeout(min(remaining, 5.0))
+                try:
+                    sock.connect(addr_tuple)
+                except OSError as exc:
+                    sock.close()
+                    last_exc = exc
+                    continue
+                set_keepalive(sock)
+                return sock
+            raise last_exc or OSError(f"no addresses for {host}")
         except OSError as e:  # noqa: PERF203
             last_err = e
             time.sleep(min(backoff, max(deadline - time.monotonic(), 0)))

@@ -197,9 +197,10 @@ def quantize_for_transfer(x: jax.Array) -> Tuple[np.ndarray, np.ndarray, int]:
     via :func:`fused_dequantize_int8`) can decode it directly.
 
     Large payloads are processed in ``_TRANSFER_CHUNK``-element slices,
-    each pulled to host before the next is quantized, so device memory
-    stays bounded. Chunks are BLOCK-aligned, so the concatenated host
-    layout is bit-identical to the single-shot path."""
+    double-buffered (the next chunk's kernel is dispatched before the
+    current pull blocks), so peak extra device memory is TWO chunks'
+    worth of intermediates. Chunks are BLOCK-aligned, so the concatenated
+    host layout is bit-identical to the single-shot path."""
     flat = x.reshape(-1)
     n = flat.size
     if n <= _TRANSFER_CHUNK:
