@@ -11,5 +11,6 @@ from torchft_tpu.models.llama import (  # noqa: F401
     Transformer,
     llama3_8b,
     llama_debug,
+    llama_moe_debug,
     llama_small,
 )

@@ -55,6 +55,19 @@ class LlamaConfig:
     # v5e crossover; the blocked kernel wins from ~2k and is mandatory past
     # dense's O(S^2) memory wall).
     flash_min_seq: int = 2048
+    # Mixture of experts: num_experts == 0 -> dense MLP. Experts shard over
+    # the 'ep' mesh axis (parallel/sharding.py); dispatch/combine are dense
+    # one-hot einsums so XLA derives the all-to-all from the shardings.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Per-sequence expert buffer = capacity_factor * S * k / E tokens;
+    # overflow tokens pass through the residual only (standard GShard drop).
+    expert_capacity_factor: float = 1.25
+    # Switch/GShard load-balancing auxiliary loss coefficient: without it
+    # routing collapses onto a few experts and capacity-drops most tokens.
+    # MoEMLP sows the aux term under "intermediates"; the train loss adds
+    # coef * mean(aux) (parallel/train.py:_loss_fn).
+    router_aux_coef: float = 0.01
     # Bound by parallel.train when attn_impl == 'ring'.
     attn_fn: Optional[Callable[..., jax.Array]] = None
 
@@ -79,6 +92,12 @@ def llama_small(**overrides: Any) -> LlamaConfig:
         head_dim=64,
         max_seq_len=2048,
     )
+    return dataclasses.replace(cfg, **overrides)
+
+
+def llama_moe_debug(**overrides: Any) -> LlamaConfig:
+    """Tiny MoE config (4 experts, top-2) for tests and the ep dryrun."""
+    cfg = llama_debug(num_experts=4, num_experts_per_tok=2)
     return dataclasses.replace(cfg, **overrides)
 
 
@@ -221,6 +240,91 @@ class MLP(nn.Module):
         return proj(cfg.hidden_size, "down")(nn.silu(gate) * up)
 
 
+class MoEMLP(nn.Module):
+    """Mixture-of-experts MLP (top-k routing, GShard-style dense dispatch).
+
+    TPU-first formulation: routing is expressed as one-hot dispatch/combine
+    tensors and the expert FFN as batched einsums over stacked expert
+    weights [E, H, I] — everything is a large static-shape matmul the MXU
+    tiles, and sharding the E dim over the 'ep' mesh axis makes XLA insert
+    the dispatch all-to-all automatically. Tokens beyond an expert's
+    capacity are dropped (contribute only through the residual), the
+    standard GShard/Switch behavior. The reference has no MoE/EP anywhere
+    (SURVEY.md §2.3); this exceeds it the same way ring attention does.
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        E = cfg.num_experts
+        K = cfg.num_experts_per_tok
+        if K > E:
+            raise ValueError(
+                f"num_experts_per_tok ({K}) > num_experts ({E})"
+            )
+        B, S, H = x.shape
+        C = max(int(cfg.expert_capacity_factor * S * K / E), 1)
+
+        # Router in fp32 for numerically stable softmax/top-k.
+        router_logits = nn.Dense(
+            E,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            name="router",
+        )(x.astype(jnp.float32))  # [B,S,E]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [B,S,K]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # Switch-style load-balancing aux loss: E * sum_e f_e * P_e, where
+        # f_e = fraction of tokens whose TOP choice is e and P_e = mean
+        # router prob of e. Minimized (=1) at uniform routing. Sown so the
+        # train loss can add cfg.router_aux_coef * mean over layers.
+        top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+        f_e = top1.mean(axis=(0, 1))  # [E]
+        p_e = probs.mean(axis=(0, 1))
+        self.sow("intermediates", "router_aux", E * jnp.sum(f_e * p_e))
+
+        # Capacity-bounded positions: k-th choices are lower priority than
+        # all (k-1)-th choices (carried counts), tokens in sequence order.
+        counts = jnp.zeros((B, E), jnp.float32)
+        dispatch = jnp.zeros((B, S, E, C), jnp.float32)
+        combine = jnp.zeros((B, S, E, C), jnp.float32)
+        for k in range(K):  # K is tiny (2); static unroll
+            mk = jax.nn.one_hot(gate_idx[..., k], E, dtype=jnp.float32)
+            pos = counts[:, None, :] + jnp.cumsum(mk, axis=1) - mk  # [B,S,E]
+            keep = mk * (pos < C)
+            counts = counts + keep.sum(axis=1)
+            pos_tok = (pos * keep).sum(-1).astype(jnp.int32)  # [B,S]
+            slot = jax.nn.one_hot(pos_tok, C, dtype=jnp.float32)  # [B,S,C]
+            disp_k = keep[..., None] * slot[:, :, None, :]  # [B,S,E,C]
+            dispatch = dispatch + disp_k
+            combine = combine + disp_k * gate_vals[..., k][..., None, None]
+
+        xe = jnp.einsum(
+            "bsec,bsh->bech", dispatch.astype(cfg.dtype), x.astype(cfg.dtype)
+        )  # [B,E,C,H]
+
+        expert = lambda shape, name: self.param(  # noqa: E731
+            name, nn.initializers.lecun_normal(), shape, cfg.param_dtype
+        ).astype(cfg.dtype)
+        w_gate = expert((E, H, cfg.intermediate_size), "experts_gate")
+        w_up = expert((E, H, cfg.intermediate_size), "experts_up")
+        w_down = expert((E, cfg.intermediate_size, H), "experts_down")
+        hidden = nn.silu(
+            jnp.einsum("bech,ehi->beci", xe, w_gate)
+        ) * jnp.einsum("bech,ehi->beci", xe, w_up)
+        ye = jnp.einsum("beci,eih->bech", hidden, w_down)  # [B,E,C,H]
+
+        out = jnp.einsum("bsec,bech->bsh", combine.astype(cfg.dtype), ye)
+        return out.astype(x.dtype)
+
+
 class Block(nn.Module):
     cfg: LlamaConfig
 
@@ -232,7 +336,8 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x), cos, sin
         )
-        x = x + MLP(cfg, name="mlp")(
+        mlp_cls = MoEMLP if cfg.num_experts > 0 else MLP
+        x = x + mlp_cls(cfg, name="mlp")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
         )
         return x
@@ -289,7 +394,9 @@ class Transformer(nn.Module):
         # [num_layers] dim which the sharding rules treat as unsharded.
         stack = nn.scan(
             block,
-            variable_axes={"params": 0},
+            # intermediates: per-layer sown values (MoE router aux) come
+            # out stacked along the layer dim.
+            variable_axes={"params": 0, "intermediates": 0},
             split_rngs={"params": True},
             length=cfg.num_layers,
             in_axes=(nn.broadcast, nn.broadcast),

@@ -6,6 +6,8 @@ device order, so ``tp``/``sp`` land on ICI-adjacent chips):
 - ``dp``   pure data parallelism (gradients all-reduced by XLA),
 - ``fsdp`` sharded data parallelism (params/opt state sharded, all-gathered
            per layer by XLA — the HSDP inner axis of BASELINE config #4),
+- ``ep``   expert parallelism (MoE experts sharded over this axis; XLA
+           inserts the dispatch/combine collectives from the shardings),
 - ``sp``   sequence/context parallelism (ring attention over this axis),
 - ``tp``   tensor parallelism (innermost: highest-bandwidth neighbors).
 
@@ -21,7 +23,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "sp", "tp")
+MESH_AXES = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 def make_mesh(
@@ -29,14 +31,15 @@ def make_mesh(
     fsdp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    n = dp * fsdp * sp * tp
+    n = dp * fsdp * ep * sp * tp
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp, tp)
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, ep, sp, tp)
     return Mesh(arr, MESH_AXES)
 
 
@@ -53,7 +56,8 @@ def auto_mesh(
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
-    sizes = {"dp": 1, "fsdp": 1, "sp": 1, "tp": 1}
+    sizes = {"dp": 1, "fsdp": 1, "sp": 1, "tp": 1}  # ep stays 1 here:
+    # dense flagship doesn't use experts; MoE runs build make_mesh(ep=...)
     priority = ("fsdp", "tp", "sp", "dp")
 
     def prime_factors(n: int) -> list:

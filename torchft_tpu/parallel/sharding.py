@@ -29,6 +29,13 @@ _RULES: Dict[Tuple[str, str], Tuple[Any, ...]] = {
     ("up", "kernel"): ("fsdp", "tp"),
     ("down", "kernel"): ("tp", "fsdp"),
     ("lm_head", "kernel"): ("fsdp", "tp"),
+    # MoE: experts shard over 'ep'; within an expert the FFN shards like
+    # the dense MLP. The fp32 router's [H, E] kernel shards H over fsdp
+    # (gathered with the rest of the layer) and keeps E whole.
+    ("mlp", "experts_gate"): ("ep", "fsdp", "tp"),
+    ("mlp", "experts_up"): ("ep", "fsdp", "tp"),
+    ("mlp", "experts_down"): ("ep", "tp", "fsdp"),
+    ("router", "kernel"): ("fsdp", None),
 }
 
 

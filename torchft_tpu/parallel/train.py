@@ -126,19 +126,38 @@ def _lm_head_projection(model: Transformer, params):
     return params["lm_head"]["kernel"], cfg.dtype
 
 
+def _apply_with_aux(model: Transformer, params, inputs, **kw):
+    """model.apply + the MoE router load-balancing aux term (mean of the
+    per-layer Switch aux values MoEMLP sows; 0.0 for dense models)."""
+    if model.cfg.num_experts <= 0:
+        return model.apply({"params": params}, inputs, **kw), jnp.zeros(())
+    out, inter = model.apply(
+        {"params": params}, inputs, mutable=["intermediates"], **kw
+    )
+    vals = [
+        jnp.ravel(leaf)
+        for leaf in jax.tree_util.tree_leaves(inter)
+    ]
+    aux = (
+        jnp.concatenate(vals).mean() if vals else jnp.zeros(())
+    )
+    return out, aux
+
+
 def _loss_fn(model: Transformer, params, inputs, targets, mask):
     B, S = inputs.shape
     C = min(_LOSS_CHUNK, S)
     mask_f = mask.astype(jnp.float32)
     denom = jnp.maximum(mask_f.sum(), 1.0)
+    aux_coef = getattr(model.cfg, "router_aux_coef", 0.0)
     if S % C != 0:  # odd seq len: the plain full-logits path
-        logits = model.apply({"params": params}, inputs)
+        logits, aux = _apply_with_aux(model, params, inputs)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets
         )
-        return (losses * mask_f).sum() / denom
+        return (losses * mask_f).sum() / denom + aux_coef * aux
 
-    h = model.apply({"params": params}, inputs, return_hidden=True)
+    h, aux = _apply_with_aux(model, params, inputs, return_hidden=True)
     w, head_dtype = _lm_head_projection(model, params)
     w = w.astype(head_dtype)
     n = S // C
@@ -157,7 +176,7 @@ def _loss_fn(model: Transformer, params, inputs, targets, mask):
     total, _ = jax.lax.scan(
         jax.checkpoint(chunk), jnp.zeros((), jnp.float32), (h_r, t_r, m_r)
     )
-    return total / denom
+    return total / denom + aux_coef * aux
 
 
 def make_train_step(
