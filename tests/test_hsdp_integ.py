@@ -54,7 +54,7 @@ def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path):
         # Let both groups compile and commit a few steps, then kill group 1.
         # (Compile dominates the early wall time; poll for progress instead
         # of guessing.)
-        deadline = time.monotonic() + 120
+        deadline = time.monotonic() + 240
         killed = False
         while time.monotonic() < deadline and not killed:
             time.sleep(1.0)
@@ -65,7 +65,7 @@ def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path):
                     killed = True
                     break
         assert killed, "group 1 never reached step 2 within the deadline"
-        ok = runner.run_until_done(timeout=300)
+        ok = runner.run_until_done(timeout=600)
         assert ok, f"runner did not finish cleanly (restarts={runner.restarts})"
         assert runner.restarts[1] >= 1, "killed group was never relaunched"
     finally:

@@ -40,7 +40,9 @@ def _maybe_pin_cpu() -> None:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--model", choices=["debug", "small"], default="debug")
+    parser.add_argument(
+        "--model", choices=["debug", "small", "moe"], default="debug"
+    )
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=64)
     parser.add_argument("--min-replicas", type=int, default=1)
@@ -57,7 +59,7 @@ def main() -> int:
 
     from torchft_tpu.device_mesh import ft_init_device_mesh
     from torchft_tpu.manager import Manager
-    from torchft_tpu.models import llama_debug, llama_small
+    from torchft_tpu.models import llama_debug, llama_moe_debug, llama_small
     from torchft_tpu.parallel import auto_mesh
     from torchft_tpu.parallel.train import (
         build_model,
@@ -68,8 +70,22 @@ def main() -> int:
     from torchft_tpu.process_group import ProcessGroupSocket
 
     group = os.environ.get("REPLICA_GROUP_ID", "0")
-    mesh = auto_mesh(len(jax.devices()))
-    cfg = llama_debug() if args.model == "debug" else llama_small()
+    n_dev = len(jax.devices())
+    if args.model == "moe" and n_dev % 2 == 0:
+        # Give the experts a real ep extent so the run actually exercises
+        # expert-parallel dispatch (auto_mesh keeps ep=1 for dense runs).
+        from torchft_tpu.parallel import make_mesh
+
+        rest = n_dev // 2
+        fsdp = 2 if rest % 2 == 0 else 1
+        mesh = make_mesh(fsdp=fsdp, ep=2, tp=rest // fsdp)
+    else:
+        mesh = auto_mesh(n_dev)
+    cfg = {
+        "debug": llama_debug,
+        "small": llama_small,
+        "moe": llama_moe_debug,
+    }[args.model]()
     model = build_model(cfg, mesh)
     B, S = args.batch, args.seq
 
