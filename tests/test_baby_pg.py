@@ -1,0 +1,308 @@
+"""ProcessGroupBabySocket: subprocess-isolated collectives.
+
+The capability under test is the reference's ProcessGroupBaby family
+(process_group.py:1241-1798): the real collective backend runs in a child
+process so a wedged or crashed backend can be SIGKILLed and respawned
+without restarting the trainer. The resiliency shapes mirror the
+reference's process_group_test.py:631-665 (reconfigure loop) and 961-1020
+(crash a rank, survivors recover).
+"""
+
+import os
+import signal
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.baby import ProcessGroupBabySocket
+from torchft_tpu.process_group import ReduceOp
+from torchft_tpu.store import TCPStoreServer
+
+
+def _run_parallel(fns, timeout=120):
+    with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = TCPStoreServer()
+    yield server
+    server.shutdown()
+
+
+def _make_groups(store, world_size, prefix, timeout=20.0):
+    groups = [ProcessGroupBabySocket(timeout=timeout) for _ in range(world_size)]
+    _run_parallel(
+        [
+            lambda r=r: groups[r].configure(
+                f"{store.address()}/{prefix}", r, world_size
+            )
+            for r in range(world_size)
+        ]
+    )
+    return groups
+
+
+def _shutdown(groups):
+    for g in groups:
+        g.shutdown()
+
+
+def test_collective_surface(store):
+    """Every collective runs through the child and matches the in-process
+    backend's semantics; large buffers take the shared-memory path."""
+    world = 2
+    groups = _make_groups(store, world, "surface")
+    try:
+        # allreduce, large enough to ride shm (>=64 KiB).
+        def ar(rank):
+            arr = np.full(40_000, float(rank + 1), dtype=np.float32)
+            out = groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=60)
+            return arr, out[0]
+
+        for arr, out in _run_parallel([lambda r=r: ar(r) for r in range(world)]):
+            np.testing.assert_allclose(arr, 3.0)  # in-place write-back
+            np.testing.assert_allclose(out, 3.0)
+
+        # small (inline) allreduce AVG
+        def ar_small(rank):
+            arr = np.full(5, float(rank * 2), dtype=np.float32)
+            groups[rank].allreduce(arr, ReduceOp.AVG).wait(timeout=60)
+            return arr
+
+        for arr in _run_parallel([lambda r=r: ar_small(r) for r in range(world)]):
+            np.testing.assert_allclose(arr, 1.0)
+
+        # broadcast
+        def bc(rank):
+            arr = (
+                np.arange(10, dtype=np.float32)
+                if rank == 0
+                else np.zeros(10, np.float32)
+            )
+            groups[rank].broadcast(arr, root=0).wait(timeout=60)
+            return arr
+
+        for arr in _run_parallel([lambda r=r: bc(r) for r in range(world)]):
+            np.testing.assert_allclose(arr, np.arange(10, dtype=np.float32))
+
+        # allgather
+        def ag(rank):
+            return groups[rank].allgather(
+                np.full(3, float(rank), np.float32)
+            ).wait(timeout=60)
+
+        for res in _run_parallel([lambda r=r: ag(r) for r in range(world)]):
+            for peer in range(world):
+                np.testing.assert_allclose(res[peer][0], float(peer))
+
+        # reduce_scatter
+        def rs(rank):
+            inputs = [np.full(4, float(dst + 1), np.float32) for dst in range(world)]
+            return groups[rank].reduce_scatter(inputs, ReduceOp.SUM).wait(timeout=60)
+
+        for rank, res in enumerate(
+            _run_parallel([lambda r=r: rs(r) for r in range(world)])
+        ):
+            np.testing.assert_allclose(res, float(rank + 1) * world)
+
+        # alltoall
+        def a2a(rank):
+            inputs = [
+                np.full(2, float(rank * 10 + dst), np.float32)
+                for dst in range(world)
+            ]
+            return groups[rank].alltoall(inputs).wait(timeout=60)
+
+        for rank, res in enumerate(
+            _run_parallel([lambda r=r: a2a(r) for r in range(world)])
+        ):
+            for src in range(world):
+                np.testing.assert_allclose(res[src], float(src * 10 + rank))
+
+        # barrier + send/recv
+        _run_parallel([lambda r=r: groups[r].barrier().wait(timeout=60) for r in range(world)])
+
+        def p2p(rank):
+            if rank == 0:
+                return groups[0].send(
+                    np.arange(6, dtype=np.float32), dst=1, tag="t"
+                ).wait(timeout=60)
+            return groups[1].recv(src=0, tag="t").wait(timeout=60)
+
+        _, received = _run_parallel([lambda: p2p(0), lambda: p2p(1)])
+        np.testing.assert_allclose(received[0], np.arange(6, dtype=np.float32))
+    finally:
+        _shutdown(groups)
+
+
+def test_child_is_separate_process(store):
+    groups = _make_groups(store, 2, "pid")
+    try:
+        for g in groups:
+            pid = g.child_pid()
+            assert pid is not None and pid != os.getpid()
+        assert groups[0].num_active_work() == 0
+    finally:
+        _shutdown(groups)
+
+
+def test_wedged_child_killed_and_respawned(store):
+    """The Baby-PG scenario: the collective layer wedges (never errors).
+    wait() times out, abort() SIGKILLs the child — the trainer process
+    survives — and a reconfigure respawns a working group."""
+    world = 2
+    groups = _make_groups(store, world, "wedge")
+    try:
+        groups[1]._inject_stall(3600.0)  # rank 1's child hangs
+        old_pid = groups[1].child_pid()
+
+        def run(rank):
+            arr = np.full(100_000, float(rank), dtype=np.float32)
+            return groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=2)
+
+        # Rank 1 never issues in the child (stalled); rank 0's ring blocks
+        # on it. Both time out host-side.
+        with pytest.raises((TimeoutError, RuntimeError)):
+            run(1)
+        groups[1].abort()
+        assert groups[1].errored() is not None
+        # The wedged child is really gone (SIGKILL'd).
+        time.sleep(0.5)
+        with pytest.raises(OSError):
+            os.kill(old_pid, 0)
+
+        # Rank 0's op eventually fails too (peer death closes the socket).
+        with pytest.raises((TimeoutError, RuntimeError)):
+            run(0)
+        groups[0].abort()
+
+        # Respawn: reconfigure both against a fresh prefix, collective works.
+        _run_parallel(
+            [
+                lambda r=r: groups[r].configure(
+                    f"{store.address()}/wedge2", r, world
+                )
+                for r in range(world)
+            ]
+        )
+        assert groups[1].child_pid() != old_pid
+        assert groups[1].errored() is None
+
+        def run2(rank):
+            arr = np.full(8, float(rank + 1), dtype=np.float32)
+            groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=60)
+            return arr
+
+        for arr in _run_parallel([lambda r=r: run2(r) for r in range(world)]):
+            np.testing.assert_allclose(arr, 3.0)
+    finally:
+        _shutdown(groups)
+
+
+def test_child_crash_fails_pending_work(store):
+    """A crashed (not wedged) child fails in-flight work promptly via pipe
+    EOF — no timeout needed — and errored() latches."""
+    world = 2
+    groups = _make_groups(store, world, "crash")
+    try:
+        groups[1]._inject_stall(3600.0)
+        work = groups[1].allreduce(
+            np.ones(100_000, np.float32), ReduceOp.SUM
+        )
+        os.kill(groups[1].child_pid(), signal.SIGKILL)
+        with pytest.raises(RuntimeError, match="died|killed|aborted"):
+            work.wait(timeout=30)
+        assert groups[1].errored() is not None
+        # Survivor's matching op fails too (its child sees the dead peer).
+        with pytest.raises((TimeoutError, RuntimeError)):
+            groups[0].allreduce(
+                np.ones(100_000, np.float32), ReduceOp.SUM
+            ).wait(timeout=10)
+    finally:
+        _shutdown(groups)
+
+
+def test_errored_group_returns_error_work(store):
+    pg = ProcessGroupBabySocket(timeout=5.0)
+    pg.configure(f"{store.address()}/solo", 0, 1)
+    try:
+        pg.abort()
+        work = pg.allreduce(np.ones(4, np.float32))
+        with pytest.raises(RuntimeError):
+            work.wait(timeout=5)
+    finally:
+        pg.shutdown()
+
+
+def test_reconfigure_loop(store):
+    """Repeated kill-and-respawn cycles stay correct (reference:
+    process_group_test.py:631-665)."""
+    world = 2
+    groups = _make_groups(store, world, "loop0")
+    try:
+        for gen in range(3):
+            def run(rank):
+                arr = np.full(16, float(rank + gen), dtype=np.float32)
+                groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=60)
+                return arr
+
+            expected = float(0 + gen) + float(1 + gen)
+            for arr in _run_parallel([lambda r=r: run(r) for r in range(world)]):
+                np.testing.assert_allclose(arr, expected)
+            _run_parallel(
+                [
+                    lambda r=r: groups[r].configure(
+                        f"{store.address()}/loop{gen + 1}", r, world
+                    )
+                    for r in range(world)
+                ]
+            )
+    finally:
+        _shutdown(groups)
+
+
+def test_manager_with_baby_pg(store):
+    """The baby PG drops into the Manager exactly like the in-process
+    socket PG: two replica groups, quorum, managed allreduce, commit."""
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+
+    lighthouse = LighthouseServer(
+        min_replicas=2, join_timeout_ms=5000, quorum_tick_ms=50
+    )
+    managers = []
+    try:
+        def run(replica):
+            manager = Manager(
+                pg=ProcessGroupBabySocket(timeout=30.0),
+                min_replica_size=2,
+                use_async_quorum=False,
+                timeout=30.0,
+                quorum_timeout=60.0,
+                replica_id=f"baby{replica}",
+                lighthouse_addr=lighthouse.address(),
+                group_rank=0,
+                group_world_size=1,
+            )
+            managers.append(manager)
+            manager.register_state_dict_fn(
+                "w", lambda: np.zeros(1), lambda v: None
+            )
+            manager.start_quorum()
+            grad = np.full(70_000, float(replica + 1), dtype=np.float32)
+            manager.allreduce(grad).wait(timeout=60)
+            assert manager.should_commit()
+            return grad
+
+        results = _run_parallel([lambda r=r: run(r) for r in range(2)])
+        for grad in results:
+            np.testing.assert_allclose(grad, 1.5)  # (1+2)/2 participants
+    finally:
+        for m in managers:
+            m.shutdown()
+        lighthouse.shutdown()

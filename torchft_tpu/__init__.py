@@ -11,6 +11,7 @@ fault-tolerant replica axis runs host-driven over DCN.
 
 __version__ = "0.1.0"
 
+from torchft_tpu.baby import ProcessGroupBabySocket  # noqa: E402,F401
 from torchft_tpu.data import DistributedSampler  # noqa: E402,F401
 from torchft_tpu.ddp import (  # noqa: E402,F401
     DistributedDataParallel,
@@ -49,6 +50,7 @@ __all__ = [
     "MetricsLogger",
     "OptimizerWrapper",
     "ProcessGroup",
+    "ProcessGroupBabySocket",
     "ProcessGroupDummy",
     "ProcessGroupSocket",
     "PureDistributedDataParallel",
