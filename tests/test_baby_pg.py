@@ -227,6 +227,35 @@ def test_child_crash_fails_pending_work(store):
         _shutdown(groups)
 
 
+def test_clean_shutdown_latches_no_error(store):
+    """Graceful shutdown must not read as a child crash: errored() stays
+    None afterwards (the handler's pipe-EOF is superseded teardown)."""
+    pg = ProcessGroupBabySocket(timeout=10.0)
+    pg.configure(f"{store.address()}/clean", 0, 1)
+    pg.allreduce(np.ones(4, np.float32)).wait(timeout=30)
+    pg.shutdown()
+    time.sleep(0.5)  # let the handler thread observe the EOF
+    assert pg.errored() is None
+
+
+def test_set_timeout_reaches_child(store):
+    """set_timeout takes effect on the live child: a wedged peer now fails
+    in ~2s, not the configure-time 60s."""
+    groups = _make_groups(store, 2, "settimeout", timeout=60.0)
+    try:
+        for g in groups:
+            g.set_timeout(2.0)
+        groups[1]._inject_stall(3600.0)
+        t0 = time.monotonic()
+        with pytest.raises((TimeoutError, RuntimeError)):
+            groups[0].allreduce(
+                np.ones(100_000, np.float32), ReduceOp.SUM
+            ).wait(timeout=10)
+        assert time.monotonic() - t0 < 30  # child deadline, not 60s
+    finally:
+        _shutdown(groups)
+
+
 def test_errored_group_returns_error_work(store):
     pg = ProcessGroupBabySocket(timeout=5.0)
     pg.configure(f"{store.address()}/solo", 0, 1)

@@ -136,6 +136,10 @@ def _baby_worker(
             kind = msg[0]
             if kind == "exit":
                 break
+            if kind == "set_timeout":
+                timeout = float(msg[1])
+                pg.set_timeout(timeout)
+                continue
             if kind == "stall":
                 # Test-only wedge injection: simulates a hung collective
                 # layer (the scenario Baby PG exists for).
@@ -334,7 +338,13 @@ class ProcessGroupBabySocket(ProcessGroup):
                 raise RuntimeError(
                     f"baby pg rank {rank}: child did not become ready"
                 )
-            msg = parent_res.recv()
+            try:
+                msg = parent_res.recv()
+            except (EOFError, OSError) as e:
+                raise RuntimeError(
+                    f"baby pg rank {rank}: child died during boot "
+                    f"(before reporting ready): {e!r}"
+                ) from e
             if msg[0] != "ready":
                 raise RuntimeError(
                     f"baby pg rank {rank}: child failed to configure: {msg[1]}"
@@ -428,6 +438,10 @@ class ProcessGroupBabySocket(ProcessGroup):
         """Kills the child and collects pending works; the CALLER must
         complete them after releasing the lock (completion runs user
         callbacks, which may re-enter this pg)."""
+        # Supersede the future-handler generation FIRST: the pipe EOF the
+        # kill produces must read as intentional teardown, not latch a
+        # phantom "child died" error after a clean shutdown/reconfigure.
+        self._generation += 1
         if self._child is not None:
             self._child.kill()
             self._child.join(timeout=10.0)
@@ -474,6 +488,14 @@ class ProcessGroupBabySocket(ProcessGroup):
 
     def set_timeout(self, timeout: float) -> None:
         self._timeout = timeout
+        # Forward to the live child so its op waits and socket deadlines
+        # update immediately (not only after the next configure).
+        with self._lock:
+            if self._cmd_conn is not None:
+                try:
+                    self._cmd_conn.send(("set_timeout", float(timeout)))
+                except (OSError, BrokenPipeError, ValueError):
+                    pass  # dead child: next configure applies it anyway
 
     def size(self) -> int:
         return self._world
