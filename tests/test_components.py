@@ -89,7 +89,7 @@ def test_managed_mesh_dynamic_replica_size():
     mesh = make_mesh(dp=1, fsdp=2, sp=2, tp=2)
     fm = _FakeManager()
     mm = ManagedMesh(fm, mesh)
-    assert mm.axis_names == ("replica", "dp", "fsdp", "ep", "sp", "tp")
+    assert mm.axis_names == ("replica", "dp", "pp", "fsdp", "ep", "sp", "tp")
     assert mm.size("replica") == 3
     assert mm.size("fsdp") == 2
     assert mm.size() == 3 * 8

@@ -1,0 +1,88 @@
+"""DurableCheckpointer: periodic on-disk snapshots + sharded restore.
+
+Covers the full-job-restart half of recovery (live heal covers the
+in-job half); the reference leaves this to user code
+(train_ddp.py:201-208)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing.durable import DurableCheckpointer
+from torchft_tpu.models import llama_debug
+from torchft_tpu.parallel import make_mesh
+from torchft_tpu.parallel.train import (
+    build_model,
+    init_train_state,
+    make_train_step,
+)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    ckpt = DurableCheckpointer(str(tmp_path), every=10, keep=2)
+    state = {"w": jnp.arange(8, dtype=jnp.float32), "step": 40}
+    assert not ckpt.maybe_save(41, state)  # off-cadence
+    assert ckpt.maybe_save(40, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 40
+
+    restored = ckpt.restore()
+    np.testing.assert_allclose(restored["w"], np.arange(8, dtype=np.float32))
+    assert int(restored["step"]) == 40
+    ckpt.close()
+
+
+def test_retention_keeps_latest(tmp_path):
+    ckpt = DurableCheckpointer(str(tmp_path), every=1, keep=2)
+    for step in (1, 2, 3):
+        ckpt.save(step, {"v": jnp.full(4, float(step))})
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+    restored = ckpt.restore(step=3)
+    np.testing.assert_allclose(restored["v"], 3.0)
+    # Oldest snapshot garbage-collected.
+    with pytest.raises(Exception):
+        ckpt.restore(step=1)
+    ckpt.close()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_train_state_roundtrip(tmp_path):
+    """A sharded TrainState survives save -> restore INTO the same
+    shardings, and training continues from the restored state."""
+    cfg = llama_debug()
+    mesh = make_mesh(fsdp=2, sp=2, tp=2)
+    B, S = 4, 16
+    model = build_model(cfg, mesh)
+    state, shardings = init_train_state(
+        model, mesh, jax.random.PRNGKey(0), (B, S)
+    )
+    step_fn = make_train_step(model, mesh, shardings, donate=False)
+    batch = {
+        "inputs": jnp.zeros((B, S), jnp.int32),
+        "targets": jnp.ones((B, S), jnp.int32),
+        "mask": jnp.ones((B, S), jnp.int32),
+    }
+    state, _ = step_fn(state, batch)
+
+    ckpt = DurableCheckpointer(str(tmp_path), every=1)
+    ckpt.save(int(state.step), state)
+    ckpt.wait()
+
+    abstract = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        state,
+        shardings,
+    )
+    restored = ckpt.restore(abstract_state=abstract)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state)),
+        jax.tree_util.tree_leaves(jax.device_get(restored)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Restored state trains.
+    restored2, metrics = step_fn(restored, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(restored2.step) == int(state.step) + 1
+    ckpt.close()

@@ -304,6 +304,20 @@ def test_state_dict_roundtrip():
         m.shutdown()
 
 
+def test_set_state_dict_fns_single_registry():
+    """Reference-parity alias: one load/save pair for the whole user state
+    (reference: manager.py set_state_dict_fns)."""
+    m = make_manager()
+    loaded = []
+    try:
+        m.set_state_dict_fns(loaded.append, lambda: {"w": 7})
+        assert m._manager_state_dict()["user"]["default"] == {"w": 7}
+        m._load_state_dicts["default"]({"w": 9})
+        assert loaded == [{"w": 9}]
+    finally:
+        m.shutdown()
+
+
 def test_state_dict_lock_blocks_checkpoint_read():
     m = make_manager()
     try:

@@ -1,2 +1,3 @@
+from torchft_tpu.checkpointing.durable import DurableCheckpointer  # noqa: F401
 from torchft_tpu.checkpointing.http_transport import HTTPTransport  # noqa: F401
 from torchft_tpu.checkpointing.transport import CheckpointTransport  # noqa: F401

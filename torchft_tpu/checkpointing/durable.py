@@ -1,0 +1,107 @@
+"""Durable (on-disk) periodic checkpoints, orbax-backed.
+
+Two recovery regimes compose in this framework:
+
+- **live heal** (the Manager + CheckpointTransport): a recovering replica
+  streams state from a healthy peer while the job is running — covers
+  single-group failures with zero disk I/O;
+- **durable checkpoints** (this module): periodic snapshots to disk/GCS so
+  a FULL-job failure (every replica gone, or a planned restart) resumes
+  from the last committed step.
+
+The reference leaves the durable half to user code (train_ddp.py:201-208
+"checkpoint to disk here" comments); here it is packaged, TPU-native:
+orbax writes sharded jax arrays directly from device (OCDBT), restores
+*into* the requested shardings (no host-side full copy at 8B scale), and
+save is asynchronous so the train loop isn't blocked on serialization.
+
+Typical wiring (one designated saver, since committed state is identical
+across replica groups — assert with tests/test_manager_integ-style
+bitwise checks):
+
+    ckpt = DurableCheckpointer(dir, every=100)
+    ...
+    if manager.should_commit():
+        state = apply_updates(...)
+        ckpt.maybe_save(manager.current_step(), {
+            "train": state, "manager": manager.state_dict(),
+        })
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class DurableCheckpointer:
+    """Periodic async checkpoints with retention.
+
+    ``every``: save cadence in committed steps (``maybe_save``).
+    ``keep``: snapshots retained (oldest garbage-collected by orbax).
+    """
+
+    def __init__(
+        self, directory: str, every: int = 100, keep: int = 3
+    ) -> None:
+        import orbax.checkpoint as ocp
+        from etils import epath
+
+        self._every = max(int(every), 1)
+        self._dir = epath.Path(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Saves iff ``step`` is on the cadence. Returns whether it saved."""
+        if step % self._every != 0:
+            return False
+        self.save(step, state)
+        return True
+
+    def save(self, step: int, state: Any) -> None:
+        """Asynchronous sharded save of an arbitrary pytree of jax arrays
+        (+ ints/floats). Returns immediately; ``wait()`` to block."""
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, abstract_state: Any = None, step: Optional[int] = None
+    ) -> Any:
+        """Restores the given (or latest) step.
+
+        ``abstract_state``: a pytree of ``jax.ShapeDtypeStruct`` (with
+        shardings) or a concrete example pytree — restored arrays come
+        back IN those shardings, written straight to the right devices.
+        With ``None``, arrays restore as host numpy.
+        """
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        if abstract_state is None:
+            return self._mgr.restore(step)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def wait(self) -> None:
+        """Blocks until any in-flight async save has committed."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -221,6 +221,16 @@ class Manager:
         self._user_state_dicts[key] = state_dict_fn
         self._load_state_dicts[key] = load_state_dict_fn
 
+    def set_state_dict_fns(
+        self,
+        load_state_dict: Callable[[Any], None],
+        state_dict: Callable[[], Any],
+    ) -> None:
+        """Single-registry variant of :meth:`register_state_dict_fn`
+        (reference API parity: manager.py set_state_dict_fns) — the whole
+        user checkpoint as one opaque value under the "default" key."""
+        self.register_state_dict_fn("default", state_dict, load_state_dict)
+
     def _manager_state_dict(self) -> Dict[str, Any]:
         with self._state_dict_lock.r_lock(self._timeout):
             return {

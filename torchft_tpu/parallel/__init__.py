@@ -15,6 +15,12 @@ from torchft_tpu.parallel.sharding import (  # noqa: F401
     param_shardings,
     param_specs,
 )
+from torchft_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe_loop,
+    init_pipeline_state,
+    make_pipeline_loss,
+    make_pipeline_train_step,
+)
 from torchft_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention,
     ring_attention_shard,

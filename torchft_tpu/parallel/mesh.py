@@ -4,6 +4,8 @@ Axes, in physical-locality order (outermost = slowest-varying over the
 device order, so ``tp``/``sp`` land on ICI-adjacent chips):
 
 - ``dp``   pure data parallelism (gradients all-reduced by XLA),
+- ``pp``   pipeline parallelism (the stacked layer dim sharded stage-wise;
+           activations ppermute stage-to-stage — parallel/pipeline.py),
 - ``fsdp`` sharded data parallelism (params/opt state sharded, all-gathered
            per layer by XLA — the HSDP inner axis of BASELINE config #4),
 - ``ep``   expert parallelism (MoE experts sharded over this axis; XLA
@@ -23,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "ep", "sp", "tp")
+MESH_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 def make_mesh(
@@ -32,14 +34,15 @@ def make_mesh(
     sp: int = 1,
     tp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    n = dp * fsdp * ep * sp * tp
+    n = dp * pp * fsdp * ep * sp * tp
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, fsdp, ep, sp, tp)
+    arr = np.asarray(devices[:n]).reshape(dp, pp, fsdp, ep, sp, tp)
     return Mesh(arr, MESH_AXES)
 
 

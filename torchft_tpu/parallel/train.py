@@ -165,6 +165,10 @@ def _loss_fn(model: Transformer, params, inputs, targets, mask):
     t_r = jnp.moveaxis(targets.reshape(B, n, C), 1, 0)
     m_r = jnp.moveaxis(mask_f.reshape(B, n, C), 1, 0)
 
+    # A hand-written VJP for this scan (saved-lse + bf16 dlogits) is 2x
+    # faster in isolation but 8% slower composed into the full step (XLA
+    # overlaps this checkpointed scan's backward with the trunk backward;
+    # a custom_vjp boundary defeats that) — measured on v5e, B=8 S=1024.
     def chunk(acc, xs):
         hc, tc, mc = xs
         logits = jnp.dot(
