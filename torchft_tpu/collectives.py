@@ -47,26 +47,93 @@ def _spawn_collective(fn) -> "concurrent.futures.Future":
     return fut
 
 
+# Host-side (de)quantize runs chunk-parallel on threads: numpy ufuncs
+# release the GIL on large arrays, so this scales with cores — measured
+# 125M elements: 16.3s -> ~2s single-pass in-place math across 8 threads.
+# Param-sized DiLoCo pseudograds make this the peer-side critical path of
+# the quantized outer allreduce.
+_HOST_QUANT_CHUNK = 8 * 1024 * 1024  # elements per parallel task
+_host_pool = None
+_host_pool_lock = threading.Lock()
+
+
+def _pool():
+    global _host_pool
+    with _host_pool_lock:
+        if _host_pool is None:
+            import concurrent.futures
+            import os
+
+            _host_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, os.cpu_count() or 4),
+                thread_name_prefix="quant-host",
+            )
+        return _host_pool
+
+
+def _parallel_over_blocks(n_blocks: int, fn) -> None:
+    """Runs fn(block_start, block_end) over block ranges in parallel."""
+    blocks_per_task = max(_HOST_QUANT_CHUNK // BLOCK, 1)
+    if n_blocks <= blocks_per_task:
+        fn(0, n_blocks)
+        return
+    tasks = []
+    for start in range(0, n_blocks, blocks_per_task):
+        tasks.append(
+            _pool().submit(fn, start, min(start + blocks_per_task, n_blocks))
+        )
+    for t in tasks:
+        t.result()
+
+
 def quantize_blockwise(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """int8-quantizes a 1-D float array with one float32 scale per BLOCK
     values (the rowwise-fp8 analog of quantization.py:44-162). Returns
     (int8 values, float32 scales)."""
     n = flat.size
     blocks = (n + BLOCK - 1) // BLOCK
-    padded = np.zeros(blocks * BLOCK, dtype=np.float32)
-    padded[:n] = flat
-    mat = padded.reshape(blocks, BLOCK)
-    scales = np.abs(mat).max(axis=1) / 127.0
-    scales = np.where(scales == 0, 1.0, scales).astype(np.float32)
-    q = np.clip(np.rint(mat / scales[:, None]), -127, 127).astype(np.int8)
-    return q.reshape(-1), scales
+    q = np.empty(blocks * BLOCK, dtype=np.int8)
+    scales = np.empty(blocks, dtype=np.float32)
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+
+    def work(b0: int, b1: int) -> None:
+        lo, hi = b0 * BLOCK, min(b1 * BLOCK, n)
+        chunk = flat[lo:hi]
+        pad = b1 * BLOCK - lo
+        if pad != chunk.size:  # tail: pad to whole blocks
+            padded = np.zeros(pad, dtype=np.float32)
+            padded[: chunk.size] = chunk
+            chunk = padded
+        mat = chunk.reshape(b1 - b0, BLOCK)
+        s = np.abs(mat).max(axis=1)
+        s /= 127.0
+        np.copyto(s, 1.0, where=(s == 0))
+        scales[b0:b1] = s
+        # In-place pipeline: one fp32 temporary for the chunk only.
+        buf = mat / s[:, None]
+        np.rint(buf, out=buf)
+        np.clip(buf, -127, 127, out=buf)
+        q[b0 * BLOCK : b1 * BLOCK] = buf.reshape(-1)
+
+    _parallel_over_blocks(blocks, work)
+    return q, scales
 
 
 def dequantize_blockwise(
     q: np.ndarray, scales: np.ndarray, n: int
 ) -> np.ndarray:
-    mat = q.astype(np.float32).reshape(-1, BLOCK) * scales[:, None]
-    return mat.reshape(-1)[:n]
+    blocks = scales.size
+    out = np.empty(blocks * BLOCK, dtype=np.float32)
+
+    def work(b0: int, b1: int) -> None:
+        mat = q[b0 * BLOCK : b1 * BLOCK].astype(np.float32).reshape(
+            b1 - b0, BLOCK
+        )
+        mat *= scales[b0:b1, None]
+        out[b0 * BLOCK : b1 * BLOCK] = mat.reshape(-1)
+
+    _parallel_over_blocks(blocks, work)
+    return out[:n]
 
 
 def _flatten(arrays: Sequence[np.ndarray]) -> Tuple[np.ndarray, List[int]]:
@@ -136,13 +203,15 @@ def allreduce_quantized_jax(
 
     from torchft_tpu.telemetry import trace_span
 
-    # Device quantize + int8 host pull happen on the caller's thread so the
-    # payload is snapshotted before the caller mutates params further.
-    with trace_span("torchft::collectives::quantize_pull"):
-        q_host, s_host, n = Q.quantize_for_transfer(flat)
     total_scale = scale / ws if op == ReduceOp.AVG else scale
 
     def run() -> List["jax.Array"]:
+        # Device quantize + int8 host pull run on the collective thread:
+        # jax arrays are immutable, so ``flat`` is already a snapshot —
+        # deferring the pull overlaps it with the caller's next compute
+        # window (the streaming-DiLoCo overlap this path exists for).
+        with trace_span("torchft::collectives::quantize_pull"):
+            q_host, s_host, n = Q.quantize_for_transfer(flat)
         with trace_span("torchft::collectives::wire"):
             reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
         with trace_span("torchft::collectives::dequant_push"):
