@@ -144,15 +144,27 @@ class Runner:
                 # every replica that commits the same steps computes the same
                 # params (bitwise).
                 step = manager.current_step()
+                if step >= self.total_steps:
+                    # Sync-mode heal inside start_quorum landed on the
+                    # peer's FINAL state: applying another grad would
+                    # diverge from a peer that already exited.
+                    break
                 grads = [
                     np.full((4, 3), 1.0 + step, dtype=np.float32),
                     np.full(3, 0.5 * (step + 1), dtype=np.float32),
                 ]
                 works = [manager.allreduce(g) for g in grads]
-                reduced = [w.wait(timeout=15)[0] for w in works]
-                if manager.should_commit():
-                    _sgd_step(params, reduced, lr=0.1)
-                    self.participants_log.append(manager.num_participants())
+                reduced = [w.wait(timeout=30)[0] for w in works]
+                # Commit + apply under the state-dict WRITE lock: a
+                # concurrent checkpoint send must snapshot (params, step)
+                # consistently — never the bumped step with pre-apply
+                # params (that heals a peer one gradient behind).
+                with manager.fenced_state_dict():
+                    if manager.should_commit():
+                        _sgd_step(params, reduced, lr=0.1)
+                        self.participants_log.append(
+                            manager.num_participants()
+                        )
             return {k: v.copy() for k, v in params.items()}
         finally:
             manager.shutdown()
@@ -267,11 +279,13 @@ def test_manager_quantized_jax_allreduce(lighthouse) -> None:
 
     def run(replica: int):
         manager = Manager(
-            pg=ProcessGroupSocket(timeout=5.0),
+            pg=ProcessGroupSocket(timeout=10.0),
             min_replica_size=2,
             use_async_quorum=False,
-            timeout=10.0,
-            quorum_timeout=20.0,
+            timeout=20.0,
+            # Generous: on a loaded 1-core CI box, forming the 2-member
+            # quorum can take several heartbeat windows.
+            quorum_timeout=60.0,
             replica_id=f"qjax{replica}",
             lighthouse_addr=lighthouse.address(),
             group_rank=0,
@@ -309,6 +323,12 @@ def test_wedged_collective_aborted_and_recovered(lighthouse) -> None:
     n_steps = 3
     stall_at_step = 1
     results = {}
+    # min_replica_size=2 exit race: if commit outcomes diverge on the last
+    # round, the behind replica needs MORE quorums to heal/catch up — but
+    # its peer has exited and a 2-replica quorum can never form again. A
+    # finished replica therefore keeps participating (commit-only settling
+    # rounds that don't touch its params) until BOTH are done.
+    done_flags = [threading.Event(), threading.Event()]
 
     def run(replica: int):
         params = {"w": np.zeros(4, np.float32)}
@@ -337,8 +357,13 @@ def test_wedged_collective_aborted_and_recovered(lighthouse) -> None:
         commits = []
         try:
             while manager.current_step() < n_steps:
-                step = manager.current_step()
                 manager.start_quorum()
+                # start_quorum may have HEALED this replica past the end
+                # (sync heal from a peer already settling) — re-check so we
+                # don't mutate the freshly-healed params with another step.
+                step = manager.current_step()
+                if step >= n_steps:
+                    break
                 if replica == 1 and step == stall_at_step and not any(
                     c is False for c in commits
                 ):
@@ -350,16 +375,29 @@ def test_wedged_collective_aborted_and_recovered(lighthouse) -> None:
                 work = manager.allreduce(grad)
                 work.wait(timeout=None)  # manager timeout (3s) governs
                 elapsed = _time.monotonic() - t0
-                committed = manager.should_commit()
-                commits.append(committed)
-                if committed:
-                    params["w"] -= 0.1 * grad
+                with manager.fenced_state_dict():
+                    committed = manager.should_commit()
+                    commits.append(committed)
+                    if committed:
+                        params["w"] -= 0.1 * grad
                 if not committed and replica == 0:
                     # The healthy replica must have failed FAST via the
                     # abort (3s deadline + slack), not the 60s socket bound.
                     assert elapsed < 30.0, f"wait took {elapsed:.1f}s"
-            return {"params": params["w"].copy(), "commits": commits}
+            done_flags[replica].set()
+            snapshot = params["w"].copy()
+            # Settle: stay in the quorum (zero-payload rounds, no param
+            # mutation) until the other replica also reaches n_steps.
+            deadline = _time.monotonic() + 60.0
+            while not done_flags[1 - replica].is_set():
+                if _time.monotonic() > deadline:
+                    break
+                manager.start_quorum()
+                manager.allreduce(np.zeros(4, np.float32)).wait(timeout=15)
+                manager.should_commit()
+            return {"params": snapshot, "commits": commits}
         finally:
+            done_flags[replica].set()
             manager.shutdown()
 
     pool = ThreadPoolExecutor(max_workers=2)

@@ -109,6 +109,11 @@ class StatefulDataIterator:
     def __init__(self, sampler: DistributedSampler, batch_size: int) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if batch_size > len(sampler):
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the per-rank shard "
+                f"({len(sampler)} examples): every epoch would be empty"
+            )
         self._sampler = sampler
         self._batch = batch_size
         self._pos = 0  # batches consumed within the current epoch

@@ -27,6 +27,7 @@ import os
 import socket
 import threading
 import uuid
+from contextlib import contextmanager
 from datetime import timedelta
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, TypeVar
@@ -247,11 +248,27 @@ class Manager:
 
     def disallow_state_dict_read(self) -> None:
         """Write-locks the state dict while the optimizer mutates parameters
-        (reference: local_sgd.py:109-113 pre-hook)."""
-        self._state_dict_lock.acquire_write(self._timeout)
+        (reference: local_sgd.py:109-113 pre-hook). Raises TimeoutError
+        rather than proceeding unfenced — a silent failure here would let a
+        concurrent checkpoint send snapshot a torn (params, step) pair."""
+        if not self._state_dict_lock.acquire_write(self._timeout):
+            raise TimeoutError(
+                f"could not write-lock the state dict within "
+                f"{self._timeout}s (checkpoint read in progress?)"
+            )
 
     def allow_state_dict_read(self) -> None:
         self._state_dict_lock.release_write()
+
+    @contextmanager
+    def fenced_state_dict(self):
+        """Context manager form of disallow/allow_state_dict_read: wrap
+        {should_commit + optimizer apply} so heal snapshots are consistent."""
+        self.disallow_state_dict_read()
+        try:
+            yield
+        finally:
+            self.allow_state_dict_read()
 
     # ------------------------------------------------------------------
     # Quorum

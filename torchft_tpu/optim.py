@@ -46,16 +46,24 @@ class OptimizerWrapper:
 
     def step(self, grads: Any) -> bool:
         """Applies ``grads`` iff the commit gate passes (optim.py:52-55).
-        Returns whether the step was committed."""
+        Returns whether the step was committed.
+
+        The commit decision (which bumps the manager step) and the param
+        update run under the state-dict WRITE lock: a concurrent checkpoint
+        send (async-quorum heal of a peer) must never snapshot the bumped
+        step with pre-update params, or the healed peer ends one gradient
+        behind forever (the reference fences the same way via the
+        LocalSGD/optimizer hooks, local_sgd.py:109-121)."""
         import optax
 
-        if not self.manager.should_commit():
-            return False
-        updates, self.opt_state = self.tx.update(
-            grads, self.opt_state, self.params
-        )
-        self.params = optax.apply_updates(self.params, updates)
-        return True
+        with self.manager.fenced_state_dict():
+            if not self.manager.should_commit():
+                return False
+            updates, self.opt_state = self.tx.update(
+                grads, self.opt_state, self.params
+            )
+            self.params = optax.apply_updates(self.params, updates)
+            return True
 
     # -- checkpointing -----------------------------------------------------
 
