@@ -6,6 +6,7 @@ test runs two replica-group threads against a real lighthouse + managers
 and asserts bitwise-equal global state (reference: local_sgd_integ_test.py).
 """
 
+from contextlib import contextmanager
 from typing import Any, List
 
 import numpy as np
@@ -30,6 +31,10 @@ class FakeManager:
 
     def register_state_dict_fn(self, key, state_fn, load_fn):
         self.registered[key] = (state_fn, load_fn)
+
+    @contextmanager
+    def fenced_state_dict(self):
+        yield
 
     def start_quorum(self, **kw):
         self.quorums += 1

@@ -318,6 +318,52 @@ def test_set_state_dict_fns_single_registry():
         m.shutdown()
 
 
+def test_fenced_state_dict_excludes_snapshot_reads():
+    """While the fence is held, _manager_state_dict (the checkpoint-send
+    snapshot) must block — and time out rather than read a torn
+    (params, step) pair."""
+    import threading
+
+    m = make_manager()
+    try:
+        m.register_state_dict_fn("w", lambda: {"x": 1}, lambda s: None)
+        m._timeout = 0.5  # short lock timeout for the reader
+        results = {}
+
+        with m.fenced_state_dict():
+            def reader():
+                try:
+                    results["snap"] = m._manager_state_dict()
+                except Exception as e:  # noqa: BLE001
+                    results["err"] = type(e).__name__
+
+            t = threading.Thread(target=reader)
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive()
+        # Reader could not snapshot inside the fence.
+        assert "snap" not in results
+        # After release, snapshots work again.
+        assert m._manager_state_dict()["user"]["w"] == {"x": 1}
+    finally:
+        m.shutdown()
+
+
+def test_disallow_state_dict_read_raises_on_timeout():
+    """A failed write-lock acquisition must raise, never proceed unfenced."""
+    m = make_manager()
+    try:
+        m._timeout = 0.3
+        assert m._state_dict_lock.acquire_read(1.0)  # a stuck reader
+        try:
+            with pytest.raises(TimeoutError):
+                m.disallow_state_dict_read()
+        finally:
+            m._state_dict_lock.release_read()
+    finally:
+        m.shutdown()
+
+
 def test_state_dict_lock_blocks_checkpoint_read():
     m = make_manager()
     try:

@@ -305,7 +305,8 @@ def test_manager_quantized_jax_allreduce(lighthouse) -> None:
     pool = ThreadPoolExecutor(max_workers=ws)
     try:
         futs = [pool.submit(run, r) for r in range(ws)]
-        results = [f.result(timeout=60) for f in futs]
+        # Must exceed the workers' internal budget (quorum 60s + wait 30s).
+        results = [f.result(timeout=150) for f in futs]
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
     for r in results:

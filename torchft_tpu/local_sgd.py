@@ -104,9 +104,14 @@ class LocalSGD:
         flat, treedef = jax.tree_util.tree_flatten(host)
         work = manager.allreduce(list(flat))
         averaged = work.wait()
-        if manager.should_commit():
-            self._set(jax.tree_util.tree_unflatten(treedef, list(averaged)))
-            return True
+        # Fenced: LocalSGD allows async quorum, so a concurrent checkpoint
+        # send must not snapshot the bumped step with pre-merge params.
+        with manager.fenced_state_dict():
+            if manager.should_commit():
+                self._set(
+                    jax.tree_util.tree_unflatten(treedef, list(averaged))
+                )
+                return True
         return False
 
 
@@ -239,31 +244,37 @@ class _Fragment:
             self._pending_treedef, out
         )
 
-        if self._manager.should_commit():
-            updates, self._opt_state = self._opt.update(
-                pseudograd, self._opt_state, self._backup
-            )
-            new_global = optax.apply_updates(self._backup, updates)
-            self._backup = jax.tree_util.tree_map(np.asarray, new_global)
-            if self._alpha <= 0.0:
-                merged = self._backup
-            else:
-                # alpha = weight of the LOCAL params (reference lerp
-                # convention, local_sgd.py:355-373):
-                # local' = (1-alpha) * global + alpha * local
-                local = _to_host(self._get())
-                merged = jax.tree_util.tree_map(
-                    lambda g, l: (1.0 - self._alpha) * np.asarray(g, np.float32)
-                    + self._alpha * np.asarray(l, np.float32),
-                    self._backup,
-                    local,
+        # Fenced: the commit decision (step bump) and the backup/param
+        # merge must be one critical section vs checkpoint-send reads
+        # (the backup IS the checkpointed fragment state).
+        with self._manager.fenced_state_dict():
+            if self._manager.should_commit():
+                updates, self._opt_state = self._opt.update(
+                    pseudograd, self._opt_state, self._backup
                 )
-            self._set(merged)
-            return True
-        # Failed sync: reset to the last global state so all committed
-        # replicas stay bitwise-identical (reference: local_sgd.py:444-451).
-        self._set(self._backup)
-        return False
+                new_global = optax.apply_updates(self._backup, updates)
+                self._backup = jax.tree_util.tree_map(np.asarray, new_global)
+                if self._alpha <= 0.0:
+                    merged = self._backup
+                else:
+                    # alpha = weight of the LOCAL params (reference lerp
+                    # convention, local_sgd.py:355-373):
+                    # local' = (1-alpha) * global + alpha * local
+                    local = _to_host(self._get())
+                    merged = jax.tree_util.tree_map(
+                        lambda g, l: (1.0 - self._alpha)
+                        * np.asarray(g, np.float32)
+                        + self._alpha * np.asarray(l, np.float32),
+                        self._backup,
+                        local,
+                    )
+                self._set(merged)
+                return True
+            # Failed sync: reset to the last global state so all committed
+            # replicas stay bitwise-identical (reference:
+            # local_sgd.py:444-451).
+            self._set(self._backup)
+            return False
 
 
 class DiLoCo:

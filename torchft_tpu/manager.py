@@ -263,7 +263,16 @@ class Manager:
     @contextmanager
     def fenced_state_dict(self):
         """Context manager form of disallow/allow_state_dict_read: wrap
-        {should_commit + optimizer apply} so heal snapshots are consistent."""
+        {should_commit + optimizer apply} so heal snapshots are consistent.
+
+        Joins the async quorum BEFORE taking the write lock: the quorum
+        thread's checkpoint-send path reads the state dict under the READ
+        lock, so fencing while it still runs would stall it to the lock
+        timeout and fail a peer's heal needlessly."""
+        try:
+            self.wait_quorum()
+        except Exception:  # noqa: BLE001 - latched; should_commit sees it
+            pass
         self.disallow_state_dict_read()
         try:
             yield

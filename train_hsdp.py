@@ -41,7 +41,8 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument(
-        "--model", choices=["debug", "small", "moe"], default="debug"
+        "--model", choices=["debug", "small", "moe", "pipeline"],
+        default="debug",
     )
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=64)
@@ -71,7 +72,13 @@ def main() -> int:
 
     group = os.environ.get("REPLICA_GROUP_ID", "0")
     n_dev = len(jax.devices())
-    if args.model == "moe" and n_dev % 2 == 0:
+    if args.model == "pipeline" and n_dev % 2 == 0:
+        # GPipe trunk over 'pp' + data parallel over 'dp', composed with
+        # the same FT replica axis (parallel/pipeline.py).
+        from torchft_tpu.parallel import make_mesh
+
+        mesh = make_mesh(pp=2, dp=n_dev // 2)
+    elif args.model == "moe" and n_dev % 2 == 0:
         # Give the experts a real ep extent so the run actually exercises
         # expert-parallel dispatch (auto_mesh keeps ep=1 for dense runs).
         from torchft_tpu.parallel import make_mesh
@@ -81,20 +88,42 @@ def main() -> int:
         mesh = make_mesh(fsdp=fsdp, ep=2, tp=rest // fsdp)
     else:
         mesh = auto_mesh(n_dev)
-    cfg = {
-        "debug": llama_debug,
-        "small": llama_small,
-        "moe": llama_moe_debug,
-    }[args.model]()
-    model = build_model(cfg, mesh)
     B, S = args.batch, args.seq
-
     optimizer = default_optimizer()
-    state, shardings = init_train_state(
-        model, mesh, jax.random.PRNGKey(0), (B, S)
-    )
+    if args.model == "pipeline":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torchft_tpu.parallel.pipeline import (
+            init_pipeline_state,
+            make_pipeline_loss,
+        )
+
+        cfg = llama_debug(num_layers=4)
+        state, shardings = init_pipeline_state(
+            cfg, mesh, jax.random.PRNGKey(0), (B, S)
+        )
+        loss_fn = make_pipeline_loss(cfg, mesh, n_micro=2)
+        bsh = NamedSharding(mesh, P("dp", None))
+        grad_step = jax.jit(
+            jax.value_and_grad(loss_fn),
+            in_shardings=(
+                shardings.params,
+                {"inputs": bsh, "targets": bsh, "mask": bsh},
+            ),
+            out_shardings=(None, shardings.params),
+        )
+    else:
+        cfg = {
+            "debug": llama_debug,
+            "small": llama_small,
+            "moe": llama_moe_debug,
+        }[args.model]()
+        model = build_model(cfg, mesh)
+        state, shardings = init_train_state(
+            model, mesh, jax.random.PRNGKey(0), (B, S)
+        )
+        grad_step = make_grad_step(model, mesh, shardings)
     params, opt_state = state.params, state.opt_state
-    grad_step = make_grad_step(model, mesh, shardings)
 
     def apply_fn(params, opt_state, grads):
         import optax
@@ -159,8 +188,14 @@ def main() -> int:
             grads = mm.allreduce_grads(
                 grads, should_quantize=args.quantize
             )  # outer: FT replica axis over DCN
-            if manager.should_commit():
-                params, opt_state = apply_step(params, opt_state, grads)
+            # Fenced: the commit decision + param/opt update must be one
+            # critical section vs concurrent checkpoint sends (async
+            # quorum), or a healed peer snapshots a torn (params, step).
+            with manager.fenced_state_dict():
+                committed = manager.should_commit()
+                if committed:
+                    params, opt_state = apply_step(params, opt_state, grads)
+            if committed:
                 losses.append(float(loss))
                 logging.info(
                     "[group %s] step %d loss %.4f participants %d",
