@@ -318,6 +318,42 @@ def test_set_state_dict_fns_single_registry():
         m.shutdown()
 
 
+def test_wrap_future_swallow_and_timeout():
+    """wrap_future (reference parity): failures and timeouts latch an error
+    and resolve to the default instead of raising."""
+    import concurrent.futures
+
+    m = make_manager()
+    try:
+        # Success passes through.
+        ok = concurrent.futures.Future()
+        ok.set_result(7)
+        assert m.wrap_future(ok, default=-1).result(timeout=5) == 7
+        assert m.errored() is None
+
+        # Failure: swallowed to default, error latched.
+        bad = concurrent.futures.Future()
+        bad.set_exception(RuntimeError("collective died"))
+        assert m.wrap_future(bad, default=-1).result(timeout=5) == -1
+        assert m.errored() is not None
+
+        # Timeout: same contract.
+        m2 = make_manager()
+        try:
+            never = concurrent.futures.Future()
+            assert (
+                m2.wrap_future(never, default=-2, timeout=0.2).result(
+                    timeout=5
+                )
+                == -2
+            )
+            assert isinstance(m2.errored(), TimeoutError)
+        finally:
+            m2.shutdown()
+    finally:
+        m.shutdown()
+
+
 def test_fenced_state_dict_excludes_snapshot_reads():
     """While the fence is held, _manager_state_dict (the checkpoint-send
     snapshot) must block — and time out rather than read a torn

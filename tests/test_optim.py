@@ -101,10 +101,14 @@ def test_array_timeout_fires_only_for_unready_arrays(monkeypatch):
     import threading
     import time
 
-    # Ready arrays: callback must NOT fire.
+    # Ready arrays: callback must NOT fire. Generous deadline so slow
+    # daemon-thread startup on a loaded box can't fire it spuriously;
+    # poll instead of a long fixed sleep.
     not_fired = threading.Event()
-    futures.array_timeout([jnp.ones(3)], not_fired.set, 0.3)
-    time.sleep(0.8)
+    futures.array_timeout([jnp.ones(3)], not_fired.set, 2.0)
+    deadline = time.monotonic() + 2.5
+    while time.monotonic() < deadline and not not_fired.is_set():
+        time.sleep(0.1)
     assert not not_fired.is_set()
 
     # Unready arrays (readiness wait outlives the deadline): MUST fire.

@@ -260,6 +260,40 @@ class Manager:
     def allow_state_dict_read(self) -> None:
         self._state_dict_lock.release_write()
 
+    def wrap_future(
+        self,
+        fut: "concurrent.futures.Future",
+        default: Any,
+        timeout: Optional[float] = None,
+    ) -> "concurrent.futures.Future":
+        """Attaches the FT protections to any future (reference API parity:
+        manager.py:473-515 ``wrap_future``): a deadline (``timeout`` or the
+        manager default), and error swallowing — a failure or timeout is
+        REPORTED (latching the error so ``should_commit`` votes no) and the
+        returned future resolves to ``default`` instead of raising, letting
+        the training step finish with discardable values."""
+        timed = ft_futures.future_timeout(
+            fut, timeout if timeout is not None else self._timeout
+        )
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        def on_done(f: "concurrent.futures.Future") -> None:
+            exc = f.exception()
+            if exc is not None:
+                # Not _logger.exception: this callback has no active
+                # exception context (exc came from the future), so log
+                # the instance itself to keep the real failure visible.
+                self._logger.warn(f"wrapped future failed: {exc!r}")
+                self.report_error(
+                    exc if isinstance(exc, Exception) else RuntimeError(str(exc))
+                )
+                out.set_result(default)
+            else:
+                out.set_result(f.result())
+
+        timed.add_done_callback(on_done)
+        return out
+
     @contextmanager
     def fenced_state_dict(self):
         """Context manager form of disallow/allow_state_dict_read: wrap
