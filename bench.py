@@ -638,16 +638,9 @@ def _backend_alive(timeout_s: float) -> bool:
     """Probes jax backend init in a SUBPROCESS: a dead axon relay makes
     jax.devices() hang forever (not error), which would otherwise hang the
     whole benchmark."""
-    code = "import jax; print(len(jax.devices()))"
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    from torchft_tpu._backend_probe import probe_device_count
+
+    return probe_device_count(timeout_s) is not None
 
 
 def main() -> int:

@@ -1,0 +1,30 @@
+"""Subprocess probe for jax backend liveness.
+
+A dead accelerator tunnel (e.g. the axon relay this dev box reaches its
+TPU through) makes ``jax.devices()`` HANG forever rather than error, so
+any entry point that must not wedge (bench.py, __graft_entry__) probes
+backend init in a subprocess with a deadline first.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Optional
+
+
+def probe_device_count(timeout_s: float = 180.0) -> Optional[int]:
+    """Returns the visible jax device count, or ``None`` when backend init
+    fails or hangs past ``timeout_s`` (caller should fall back to CPU)."""
+    code = "import jax; print(len(jax.devices()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            return None
+        return int(proc.stdout.split()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return None
