@@ -122,6 +122,44 @@ def test_allgather_broadcast_reduce_scatter_alltoall_barrier(store):
         g.shutdown()
 
 
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32, np.float16])
+def test_allreduce_dtype_sweep(store, dtype):
+    """The wire carries any numpy dtype faithfully (reference: collectives
+    view/split sweeps, _test_utils.py:26-111)."""
+    groups = _make_group(store, 2, prefix=f"dt{np.dtype(dtype).name}")
+
+    def run(rank):
+        arr = np.full(37, rank + 1, dtype=dtype)  # odd size: uneven chunks
+        groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+        return arr
+
+    a, b = _run_parallel([lambda: run(0), lambda: run(1)])
+    np.testing.assert_array_equal(a, np.full(37, 3, dtype=dtype))
+    np.testing.assert_array_equal(b, a)
+    assert a.dtype == np.dtype(dtype)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_noncontiguous_input(store):
+    """A transposed (non-contiguous) array reduces correctly in place —
+    the ring's reshape-copied path must write back through."""
+    groups = _make_group(store, 2, prefix="noncontig")
+
+    def run(rank):
+        base = np.full((6, 4), float(rank + 1), dtype=np.float32)
+        view = base.T  # non-contiguous
+        assert not view.flags.c_contiguous
+        groups[rank].allreduce(view, ReduceOp.SUM).wait(timeout=30)
+        return view
+
+    a, b = _run_parallel([lambda: run(0), lambda: run(1)])
+    np.testing.assert_allclose(a, 3.0)
+    np.testing.assert_allclose(b, 3.0)
+    for g in groups:
+        g.shutdown()
+
+
 def test_send_recv(store):
     groups = _make_group(store, 2, prefix="p2p")
 
