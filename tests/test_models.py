@@ -231,3 +231,40 @@ class TestMoE:
         x = jax.numpy.zeros((1, 8, cfg.hidden_size))
         with _pytest.raises(ValueError, match="num_experts_per_tok"):
             MoEMLP(cfg).init(jax.random.PRNGKey(0), x)
+
+
+def test_resnet50_param_count_and_variants():
+    """BASELINE config #3's model: ResNet-50 v1.5 at the canonical 25.56M
+    params; the CIFAR variant trains with mutable batch stats."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu.models import resnet_tiny, resnet50
+
+    m = resnet50()
+    shapes = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+    )
+    n = sum(
+        int(np.prod(p.shape))
+        for p in jax.tree_util.tree_leaves(shapes["params"])
+    )
+    assert 25.4e6 < n < 25.7e6, n
+
+    small = resnet_tiny()
+    v = small.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)))
+    logits, upd = small.apply(
+        v, jnp.ones((2, 32, 32, 3)), mutable=["batch_stats"]
+    )
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # Running stats actually moved off their init.
+    moved = any(
+        float(jnp.abs(a - b).max()) > 0
+        for a, b in zip(
+            jax.tree_util.tree_leaves(upd["batch_stats"]),
+            jax.tree_util.tree_leaves(v["batch_stats"]),
+        )
+    )
+    assert moved

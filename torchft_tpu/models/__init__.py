@@ -6,6 +6,12 @@ external models (torchtitan Llama, CIFAR CNN in train_ddp.py:116-146); here
 the models are in-repo so the framework is standalone.
 """
 
+from torchft_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet_tiny,
+    resnet50,
+    resnet101,
+)
 from torchft_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     Transformer,

@@ -1,0 +1,121 @@
+"""ResNet v1.5 family (flax) — the fault-tolerant-DDP vision model of
+BASELINE config #3 ("FT DDP ResNet-50 on v5e-8, 1 injected failure").
+
+The reference trains a toy CNN on CIFAR (train_ddp.py:116-146) and leaves
+real vision models to the consuming trainer; this makes the named
+BASELINE workload first-class. TPU-first choices: NHWC layout (TPU conv
+native), bf16 compute with fp32 params/batch-stats, and the v1.5 variant
+(stride on the 3x3, not the 1x1 — the standard accuracy-preserving
+form). BatchNorm runs in inference-free "train" mode with mutable
+batch_stats; for the FT outer axis the stats ride the state-dict registry
+like params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        # v1.5: the stride lives on the 3x3.
+        y = conv(
+            self.features, (3, 3), strides=(self.stride, self.stride),
+            name="conv2",
+        )(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1),
+                strides=(self.stride, self.stride), name="proj",
+            )(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """stage_sizes=[3,4,6,3] -> ResNet-50; [3,4,23,3] -> 101; [3,8,36,3] -> 152."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="conv_init",
+        )(x.astype(self.dtype))
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="bn_init",
+            )(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                x = BottleneckBlock(
+                    features=64 * 2**stage,
+                    stride=2 if stage > 0 and block == 0 else 1,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    name=f"stage{stage + 1}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet101(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+def resnet_tiny(**kw: Any) -> ResNet:
+    """Depth-1 bottleneck stages (~a bottleneck ResNet-14) for CPU tests /
+    CIFAR-shaped inputs. Deliberately NOT named resnet18: the canonical
+    ResNet-18 is a basic-block [2,2,2,2] net, which this is not."""
+    kw.setdefault("num_classes", 10)
+    return ResNet(stage_sizes=(1, 1, 1, 1), **kw)

@@ -44,7 +44,9 @@ class OptimizerWrapper:
         """Starts the quorum for this step (reference: optim.py:48-50)."""
         self.manager.start_quorum()
 
-    def step(self, grads: Any) -> bool:
+    def step(
+        self, grads: Any, on_commit: Optional[Any] = None
+    ) -> bool:
         """Applies ``grads`` iff the commit gate passes (optim.py:52-55).
         Returns whether the step was committed.
 
@@ -53,7 +55,11 @@ class OptimizerWrapper:
         send (async-quorum heal of a peer) must never snapshot the bumped
         step with pre-update params, or the healed peer ends one gradient
         behind forever (the reference fences the same way via the
-        LocalSGD/optimizer hooks, local_sgd.py:109-121)."""
+        LocalSGD/optimizer hooks, local_sgd.py:109-121).
+
+        ``on_commit``: optional callable run INSIDE the fence after the
+        update — for auxiliary committed state (e.g. BatchNorm running
+        stats) that must advance atomically with the params."""
         import optax
 
         with self.manager.fenced_state_dict():
@@ -63,6 +69,8 @@ class OptimizerWrapper:
                 grads, self.opt_state, self.params
             )
             self.params = optax.apply_updates(self.params, updates)
+            if on_commit is not None:
+                on_commit()
             return True
 
     # -- checkpointing -----------------------------------------------------

@@ -53,11 +53,14 @@ class Net(nn.Module):
         return nn.Dense(10)(x)
 
 
-def synthetic_batch(key, batch_size: int):
+def synthetic_batch(key, batch_size: int, image_size: int = 32,
+                    num_classes: int = 10):
     """Deterministic synthetic data stream (no dataset download in image)."""
     kx, ky = jax.random.split(key)
-    x = jax.random.normal(kx, (batch_size, 32, 32, 3), dtype=jnp.float32)
-    y = jax.random.randint(ky, (batch_size,), 0, 10)
+    x = jax.random.normal(
+        kx, (batch_size, image_size, image_size, 3), dtype=jnp.float32
+    )
+    y = jax.random.randint(ky, (batch_size,), 0, num_classes)
     return x, y
 
 
@@ -67,29 +70,75 @@ def main() -> int:
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument(
+        "--model", choices=["cnn", "resnet-tiny", "resnet50"], default="cnn",
+        help="cnn = the reference-shaped toy CNN; resnet50 = BASELINE "
+             "config #3's model (pass --image-size 224 --num-classes 1000 "
+             "for the ImageNet-shaped workload); resnet-tiny for CPU runs",
+    )
+    parser.add_argument(
+        "--image-size", type=int, default=32,
+        help="synthetic image side; BASELINE #3 at full scale uses 224",
+    )
+    parser.add_argument("--num-classes", type=int, default=10)
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     replica_group = os.environ.get("REPLICA_GROUP_ID", "0")
 
-    model = Net()
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    if args.model == "cnn":
+        if args.image_size != 32 or args.num_classes != 10:
+            raise SystemExit("--model cnn is fixed at 32x32 / 10 classes")
+        model = Net()
+    else:
+        from torchft_tpu.models import resnet_tiny, resnet50
+
+        model = (
+            resnet50(num_classes=args.num_classes)
+            if args.model == "resnet50"
+            else resnet_tiny(num_classes=args.num_classes)
+        )
+    S_img, n_cls = args.image_size, args.num_classes
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, S_img, S_img, 3))
+    )
+    params = {"params": variables["params"]}
+    # BatchNorm running stats (ResNet): per-group mutable state, carried
+    # outside the gradient path and registered for heal below.
+    batch_stats = [variables.get("batch_stats")]
 
     @jax.jit
-    def loss_and_grads(params, x, y):
+    def loss_and_grads(params, batch_stats, x, y):
         def loss_fn(p):
-            logits = model.apply(p, x)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, y
-            ).mean()
+            if batch_stats is None:
+                return (
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        model.apply(p, x), y
+                    ).mean(),
+                    None,
+                )
+            logits, upd = model.apply(
+                {**p, "batch_stats": batch_stats},
+                x,
+                mutable=["batch_stats"],
+            )
+            return (
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean(),
+                upd["batch_stats"],
+            )
 
-        return jax.value_and_grad(loss_fn)(params)
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        return loss, new_stats, grads
 
     # Compile before joining the quorum: a replica stalled in XLA compilation
     # would otherwise hold up the whole group's first step (and on TPU the
     # first compile can take tens of seconds).
-    wx, wy = synthetic_batch(jax.random.PRNGKey(1), args.batch_size)
-    jax.block_until_ready(loss_and_grads(params, wx, wy))
+    wx, wy = synthetic_batch(jax.random.PRNGKey(1), args.batch_size, S_img, n_cls)
+    jax.block_until_ready(loss_and_grads(params, batch_stats[0], wx, wy))
 
     manager = Manager(
         pg=ProcessGroupSocket(timeout=30.0),
@@ -100,6 +149,14 @@ def main() -> int:
     )
     opt = OptimizerWrapper(manager, optax.adam(args.lr), params)
     ddp = DistributedDataParallel(manager)
+    if batch_stats[0] is not None:
+        # BatchNorm stats heal with the params so a recovered replica's
+        # normalization matches its checkpoint source.
+        manager.register_state_dict_fn(
+            "batch_stats",
+            lambda: jax.tree_util.tree_map(np.asarray, batch_stats[0]),
+            lambda s: batch_stats.__setitem__(0, s),
+        )
 
     # Different replica groups draw different data shards (reference:
     # DistributedSampler semantics, torchft/data.py:24-77).
@@ -112,12 +169,23 @@ def main() -> int:
         # train_ddp.py:169-174 torch.profiler schedule).
         telemetry.trace_window(step)
         data_key, batch_key = jax.random.split(data_key)
-        x, y = synthetic_batch(batch_key, args.batch_size)
+        x, y = synthetic_batch(batch_key, args.batch_size, S_img, n_cls)
 
         opt.zero_grad()  # quorum (async; overlaps with forward/backward)
-        loss, grads = loss_and_grads(opt.params, x, y)
+        loss, new_stats, grads = loss_and_grads(
+            opt.params, batch_stats[0], x, y
+        )
         grads = ddp.allreduce_grads(grads)  # outer replica axis, over DCN
-        committed = opt.step(grads)
+        # Stats advance inside the commit fence: a heal snapshot must
+        # never pair step-N params with step-(N-1) BatchNorm stats.
+        committed = opt.step(
+            grads,
+            on_commit=(
+                (lambda: batch_stats.__setitem__(0, new_stats))
+                if new_stats is not None
+                else None
+            ),
+        )
 
         print(
             f"[group {replica_group}] step={step} loss={float(loss):.4f} "
