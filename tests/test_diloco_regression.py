@@ -62,6 +62,7 @@ def _run_replica(
     fail_before_step: Optional[int],
     barrier: threading.Barrier,
     pg_timeout: float,
+    quantize: bool = False,
 ) -> List[Dict[str, List[float]]]:
     params = _initial_params()
 
@@ -105,6 +106,7 @@ def _run_replica(
         outer_optimizer=optax.sgd(OUTER_LR),
         fragment_sync_delay=delay,
         fragment_update_alpha=alpha,
+        should_quantize=quantize,
     )
     history: List[Dict[str, List[float]]] = []
     try:
@@ -123,7 +125,16 @@ def _run_replica(
                         RuntimeError("injected regression failure")
                     )
             for k in params:
-                params[k] = params[k] - np.float32(DRIFT)
+                if quantize:
+                    # Per-element drift: constant pseudograds would
+                    # quantize EXACTLY (x/scale = 127 for every element),
+                    # making the int8 golden indistinguishable from fp32.
+                    ramp = np.float32(1.0) + np.arange(
+                        params[k].size, dtype=np.float32
+                    ) / np.float32(4.0)
+                    params[k] = params[k] - np.float32(DRIFT) * ramp
+                else:
+                    params[k] = params[k] - np.float32(DRIFT)
             diloco.step()
             history.append(_snapshot(params))
         return history
@@ -137,6 +148,7 @@ def _run_case(
     alpha: float,
     fail_before_step: Optional[int] = None,
     pg_timeout: float = 10.0,
+    quantize: bool = False,
 ) -> List[Dict[str, List[float]]]:
     lighthouse = LighthouseServer(
         bind="127.0.0.1:0",
@@ -159,6 +171,7 @@ def _run_case(
                     fail_before_step,
                     barrier,
                     pg_timeout,
+                    quantize,
                 )
                 for r in (0, 1)
             ]
@@ -203,6 +216,19 @@ def test_diloco_golden(n_fragments: int, delay: int, alpha: float) -> None:
     }
     assert history[-1] != drift_only, "no outer sync ever applied"
     _check_golden(f"diloco_f{n_fragments}_d{delay}_a{alpha}", history)
+
+
+def test_diloco_golden_quantized() -> None:
+    """The int8 outer-allreduce wire (blockwise quantize -> fp32 reduce ->
+    requantize) is DETERMINISTIC, so its lossy-but-reproducible numerics
+    can be pinned too: silent changes to BLOCK size, scale math, or the
+    requantize path fail this golden."""
+    history = _run_case(2, 1, 0.5, quantize=True)
+    # Quantized and exact histories must genuinely differ (the golden is
+    # pinning int8 numerics, not silently taking the fp32 path).
+    exact = _run_case(2, 1, 0.5, quantize=False)
+    assert history != exact, "quantized path produced exact-fp32 history"
+    _check_golden("diloco_f2_d1_a0.5_int8", history)
 
 
 def test_diloco_golden_failure_recovery() -> None:
