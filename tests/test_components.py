@@ -93,6 +93,7 @@ class _FakeManager:
         self.participants = 3
         self.rank = 1
         self.allreduced = []
+        self.quantize_flags = []
 
     def num_participants(self):
         return self.participants
@@ -107,6 +108,7 @@ class _FakeManager:
             tensors if isinstance(tensors, list) else [tensors]
         )]
         self.allreduced.append(arrays)
+        self.quantize_flags.append(should_quantize)
         return DummyWork(arrays)
 
 
@@ -132,6 +134,18 @@ def test_managed_mesh_outer_allreduce_roundtrip():
     assert set(out) == {"a", "b"}
     assert out["a"].shape == (8, 8)
     assert fm.allreduced  # went through the manager
+
+
+def test_managed_mesh_quantize_flag_propagates():
+    """--quantize on the HSDP path must reach manager.allreduce's
+    should_quantize (train_hsdp.py wiring)."""
+    mesh = make_mesh(dp=1, fsdp=2, sp=2, tp=2)
+    fm = _FakeManager()
+    mm = ManagedMesh(fm, mesh)
+    mm.allreduce_grads({"a": np.ones(4, np.float32)}, should_quantize=True)
+    assert fm.quantize_flags[-1] is True
+    mm.allreduce_grads({"a": np.ones(4, np.float32)})
+    assert fm.quantize_flags[-1] is False
 
 
 def test_ft_init_device_mesh():
