@@ -1,0 +1,62 @@
+"""GKE/Kubernetes manifest rendering (orchestration/k8s.py) — the
+scheduler-facing torchx analog (reference: torchft/torchx.py:11-83)."""
+
+import pytest
+
+from torchft_tpu.orchestration.k8s import (
+    render_lighthouse,
+    render_replica_groups,
+    render_yaml,
+)
+
+yaml = pytest.importorskip("yaml")
+
+
+def test_replica_group_jobs_topology():
+    jobs = render_replica_groups(
+        ["python", "train_hsdp.py", "--model", "small"],
+        num_replica_groups=3,
+        lighthouse_addr="torchft-lighthouse:29510",
+        tpu_topology="4x4",
+        tpu_chips=16,
+        env={"TORCHFT_QUORUM_TIMEOUT_SEC": "900"},
+    )
+    assert len(jobs) == 3
+    for group, job in enumerate(jobs):
+        assert job["kind"] == "Job"
+        assert job["metadata"]["name"] == f"torchft-trainer-group{group}"
+        pod = job["spec"]["template"]["spec"]
+        env = {
+            e["name"]: e["value"] for e in pod["containers"][0]["env"]
+        }
+        assert env["REPLICA_GROUP_ID"] == str(group)
+        assert env["NUM_REPLICA_GROUPS"] == "3"
+        assert env["TORCHFT_LIGHTHOUSE"] == "torchft-lighthouse:29510"
+        assert env["TORCHFT_QUORUM_TIMEOUT_SEC"] == "900"
+        assert pod["containers"][0]["resources"]["limits"][
+            "google.com/tpu"
+        ] == "16"
+        assert pod["nodeSelector"][
+            "cloud.google.com/gke-tpu-topology"
+        ] == "4x4"
+        assert job["spec"]["backoffLimit"] == 100  # keep-alive restarts
+
+
+def test_lighthouse_deployment_and_service():
+    manifests = render_lighthouse(min_replicas=2, port=29999)
+    kinds = [m["kind"] for m in manifests]
+    assert kinds == ["Deployment", "Service"]
+    cmd = manifests[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--min-replicas" in cmd and "2" in cmd
+    assert manifests[1]["spec"]["ports"][0]["port"] == 29999
+
+
+def test_yaml_roundtrips_through_real_parser():
+    manifests = render_lighthouse() + render_replica_groups(
+        ["python", "train_ddp.py"],
+        num_replica_groups=2,
+        lighthouse_addr="lh:29510",
+    )
+    text = render_yaml(manifests)
+    parsed = list(yaml.safe_load_all(text))
+    assert parsed == manifests

@@ -2,6 +2,11 @@
 and chaos injection (reference: torchft/torchx.py, examples/slurm/runner.py,
 examples/slurm/punisher.py)."""
 
+from torchft_tpu.orchestration.k8s import (
+    render_lighthouse,
+    render_replica_groups,
+    render_yaml,
+)
 from torchft_tpu.orchestration.launcher import ProcessSpec, render_topology
 from torchft_tpu.orchestration.punisher import Punisher, kill_via_lighthouse
 from torchft_tpu.orchestration.runner import ReplicaGroupRunner

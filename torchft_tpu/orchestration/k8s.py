@@ -1,0 +1,262 @@
+"""Kubernetes/GKE manifest rendering for replica-group jobs.
+
+The scheduler-facing half of the torchx analog (reference:
+torchft/torchx.py:11-83 renders roles for a scheduler; the slurm example
+runner keeps N sbatch jobs alive, examples/slurm/runner.py). Here the
+same topology the local launcher renders (launcher.py) is emitted as
+Kubernetes manifests — one Job per replica group plus a lighthouse
+Deployment+Service — so the cluster's own controller provides the
+keep-alive restarts (`backoffLimit`) that runner.py provides locally.
+
+Pure text generation (no kubernetes client): render, `kubectl apply -f -`.
+TPU specifics: a `google.com/tpu` resource request and a
+`cloud.google.com/gke-tpu-topology` node selector per group, so each
+replica group lands on its own slice; the FT replica axis rides the
+cluster network (DCN) exactly as the socket PG expects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+
+def _env_list(env: Dict[str, str]) -> List[Dict[str, str]]:
+    return [{"name": k, "value": str(v)} for k, v in sorted(env.items())]
+
+
+def render_lighthouse(
+    name: str = "torchft-lighthouse",
+    image: str = "torchft-tpu:latest",
+    min_replicas: int = 1,
+    port: int = 29510,
+    join_timeout_ms: int = 60000,
+    namespace: str = "default",
+) -> List[dict]:
+    """Deployment + stable Service for the lighthouse (the quorum leader
+    needs a stable DNS name; replicas point TORCHFT_LIGHTHOUSE at it)."""
+    labels = {"app": name}
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "lighthouse",
+                            "image": image,
+                            "command": [
+                                "torchft_tpu_lighthouse",
+                                "--min-replicas", str(min_replicas),
+                                "--port", str(port),
+                                "--join-timeout-ms", str(join_timeout_ms),
+                            ],
+                            "ports": [{"containerPort": port}],
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "selector": labels,
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+    return [deployment, service]
+
+
+def render_replica_groups(
+    cmd: Sequence[str],
+    num_replica_groups: int,
+    lighthouse_addr: str,
+    image: str = "torchft-tpu:latest",
+    name: str = "torchft-trainer",
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    tpu_topology: Optional[str] = None,
+    tpu_chips: int = 0,
+    max_restarts: int = 100,
+    timeout_sec: Optional[float] = None,
+    quorum_timeout_sec: Optional[float] = None,
+) -> List[dict]:
+    """One Kubernetes Job per replica group (the reference's torchx role
+    per group, torchx.py:41-76). The cluster restarts failed pods up to
+    ``max_restarts`` (the runner.py keep-alive loop, scheduler-side);
+    a restarted pod rejoins the quorum and live-heals.
+
+    The FT env contract is OWNED by launcher.render_topology — this
+    renderer just re-emits its ProcessSpecs as Jobs, so the two launch
+    paths can never drift.
+    """
+    from torchft_tpu.orchestration.launcher import render_topology
+
+    specs = render_topology(
+        cmd,
+        num_replica_groups=num_replica_groups,
+        lighthouse_addr=lighthouse_addr,
+        workers_per_replica=1,  # one pod per group; in-pod ranks are the
+        # inner XLA mesh, not separate processes
+        env=env,
+        timeout_sec=timeout_sec,
+        quorum_timeout_sec=quorum_timeout_sec,
+    )
+    jobs: List[dict] = []
+    for spec in specs:
+        group = spec.replica_group
+        container: dict = {
+            "name": "trainer",
+            "image": image,
+            "command": list(spec.cmd),
+            "env": _env_list(spec.env),
+        }
+        pod_spec: dict = {
+            "restartPolicy": "Never",  # the Job controller restarts
+            "containers": [container],
+        }
+        if tpu_chips > 0:
+            container["resources"] = {
+                "limits": {"google.com/tpu": str(tpu_chips)}
+            }
+        if tpu_topology:
+            pod_spec["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-topology": tpu_topology
+            }
+        jobs.append(
+            {
+                "apiVersion": "batch/v1",
+                "kind": "Job",
+                "metadata": {
+                    "name": f"{name}-group{group}",
+                    "namespace": namespace,
+                    "labels": {"app": name, "replica-group": str(group)},
+                },
+                "spec": {
+                    "backoffLimit": max_restarts,
+                    "template": {
+                        "metadata": {
+                            "labels": {
+                                "app": name,
+                                "replica-group": str(group),
+                            }
+                        },
+                        "spec": pod_spec,
+                    },
+                },
+            }
+        )
+    return jobs
+
+
+def render_yaml(manifests: List[dict]) -> str:
+    """Multi-document YAML without external deps (the manifest trees use
+    only dicts/lists/strs/ints, which this emitter covers)."""
+
+    def emit(obj, indent: int = 0) -> List[str]:
+        pad = "  " * indent
+        lines: List[str] = []
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if isinstance(v, (dict, list)) and v:
+                    lines.append(f"{pad}{k}:")
+                    lines.extend(emit(v, indent + 1))
+                else:
+                    lines.append(f"{pad}{k}: {_scalar(v)}")
+        elif isinstance(obj, list):
+            for item in obj:
+                if isinstance(item, (dict, list)) and item:
+                    sub = emit(item, indent + 1)
+                    first = sub[0].lstrip()
+                    lines.append(f"{pad}- {first}")
+                    lines.extend(sub[1:])
+                else:
+                    lines.append(f"{pad}- {_scalar(item)}")
+        return lines
+
+    import re
+
+    # Unquoted only for strings that can't be misread as any other YAML
+    # type: plain identifier-ish tokens that aren't numeric (incl. YAML 1.1
+    # hex/binary/octal lexemes) or boolean-ish words. Everything else goes
+    # double-quoted with control characters escaped.
+    _plain = re.compile(r"^[A-Za-z][A-Za-z0-9._/-]*$")
+    _booly = {"true", "false", "null", "yes", "no", "on", "off", "y", "n"}
+
+    def _scalar(v) -> str:
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if v is None:
+            return "null"
+        if v == {} and isinstance(v, dict):
+            return "{}"
+        if v == [] and isinstance(v, list):
+            return "[]"
+        s = str(v)
+        if isinstance(v, str):
+            if not _plain.match(s) or s.lower() in _booly:
+                s = (
+                    s.replace("\\", "\\\\")
+                    .replace('"', '\\"')
+                    .replace("\n", "\\n")
+                    .replace("\r", "\\r")
+                    .replace("\t", "\\t")
+                )
+                return f'"{s}"'
+        return s
+
+    docs = ["\n".join(emit(m)) for m in manifests]
+    return "---\n" + "\n---\n".join(docs) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: render the full job (lighthouse + N replica-group Jobs) as
+    multi-document YAML on stdout, ready for `kubectl apply -f -`."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Render GKE/Kubernetes manifests for a fault-tolerant "
+        "replica-group training job."
+    )
+    p.add_argument("--replicas", type=int, required=True)
+    p.add_argument("--image", default="torchft-tpu:latest")
+    p.add_argument("--lighthouse-port", type=int, default=29510)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--tpu-topology", default=None)
+    p.add_argument("--tpu-chips", type=int, default=0)
+    p.add_argument("--namespace", default="default")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="trainer command (after --)")
+    args = p.parse_args(argv)
+    cmd = list(args.cmd)
+    if "--" in cmd:
+        cmd.remove("--")  # drop only the argparse separator, not the
+        # trainer's own "--" tokens
+    cmd = cmd or ["python", "train_hsdp.py", "--model", "small"]
+    manifests = render_lighthouse(
+        image=args.image,
+        min_replicas=args.min_replicas,
+        port=args.lighthouse_port,
+        namespace=args.namespace,
+    ) + render_replica_groups(
+        cmd,
+        num_replica_groups=args.replicas,
+        lighthouse_addr=f"torchft-lighthouse:{args.lighthouse_port}",
+        image=args.image,
+        namespace=args.namespace,
+        tpu_topology=args.tpu_topology,
+        tpu_chips=args.tpu_chips,
+    )
+    print(render_yaml(manifests), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
