@@ -441,3 +441,67 @@ def test_hot_paths_emit_spans_and_metrics(tmp_path, monkeypatch):
         assert stats[name]["count"] >= 1, name
     rec = json.loads(open(path).readline())
     assert rec["committed"] == 1.0 and rec["num_participants"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# _ManagedWork (reference: managed_work_test.py — callback/normalization
+# semantics of the managed allreduce handle)
+# ---------------------------------------------------------------------------
+
+
+def test_managed_work_divides_on_wait_only():
+    """The divide-by-N is DEFERRED to wait() (reference lazy chain,
+    manager.py:973-1251): until then the arrays hold raw sums."""
+    from torchft_tpu.manager import _ManagedWork
+    from torchft_tpu.work import DummyWork
+
+    m = make_manager()
+    try:
+        arrays = [np.full(4, 6.0, np.float32)]
+        work = _ManagedWork(m, DummyWork(arrays), arrays, scale=1.0 / 3)
+        np.testing.assert_allclose(arrays[0], 6.0)  # not yet normalized
+        out = work.wait(timeout=5)
+        np.testing.assert_allclose(out[0], 2.0)
+        # Idempotent: a second wait must not divide again.
+        out = work.wait(timeout=5)
+        np.testing.assert_allclose(out[0], 2.0)
+    finally:
+        m.shutdown()
+
+
+def test_managed_work_failure_latches_and_returns_inputs():
+    """A failed collective returns the (unreduced) inputs and latches the
+    error on the manager — never raises into the train loop."""
+    from torchft_tpu.manager import _ManagedWork
+    from torchft_tpu.work import ErrorWork
+
+    m = make_manager()
+    try:
+        arrays = [np.full(4, 5.0, np.float32)]
+        work = _ManagedWork(
+            m, ErrorWork(RuntimeError("ring died")), arrays, scale=0.5
+        )
+        out = work.wait(timeout=5)
+        np.testing.assert_allclose(out[0], 5.0)  # unscaled originals
+        assert m.errored() is not None
+    finally:
+        m.shutdown()
+
+
+def test_managed_work_replace_mode():
+    """in_place=False (jax path): wait() returns the work's RESULT arrays,
+    not the inputs."""
+    from torchft_tpu.manager import _ManagedWork
+    from torchft_tpu.work import DummyWork
+
+    m = make_manager()
+    try:
+        inputs = [np.zeros(3, np.float32)]
+        result = [np.full(3, 9.0, np.float32)]
+        work = _ManagedWork(
+            m, DummyWork(result), inputs, scale=1.0, in_place=False
+        )
+        out = work.wait(timeout=5)
+        np.testing.assert_allclose(out[0], 9.0)
+    finally:
+        m.shutdown()
