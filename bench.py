@@ -190,13 +190,8 @@ def _bench() -> dict:
     device_kind = jax.devices()[0].device_kind
     mesh = auto_mesh(n_dev)
     backend = jax.default_backend()
-    if os.environ.get("BENCH_TINY") or (
-        backend != "tpu" and not os.environ.get("BENCH_FORCE_FULL")
-    ):
-        # Off-TPU (tests, CPU fallback): the flagship model at full size
-        # takes ~10 s/step on a 1-core CPU — bench the tiny config with a
-        # proportionally small DEFAULT schedule. Explicitly set BENCH_*
-        # env vars are honored as given.
+    if os.environ.get("BENCH_TINY"):
+        # Quick smoke (tests): tiny everything, finish in seconds.
         if "BENCH_STEPS" not in os.environ:
             n_steps = min(n_steps, 10)
         if "BENCH_DDP_STEPS" not in os.environ:
@@ -207,6 +202,27 @@ def _bench() -> dict:
             diloco_syncs = min(diloco_syncs, 3)
         cfg = llama_debug()
         B, S = 4, 64
+    elif backend != "tpu" and not os.environ.get("BENCH_FORCE_FULL"):
+        # CPU fallback (dead accelerator tunnel): the flagship model at
+        # full size takes ~10 s/step on a 1-core CPU, so the model shrinks
+        # — but the measured REGIME must survive the shrink.  DiLoCo's H
+        # is in the hundreds: an inner window is tens of seconds of
+        # compute against a sub-second outer sync.  r02 clamped
+        # sync_every to 8, which made a ~40 ms window absorb a ~230 ms
+        # sync — a degenerate operating point no deployment runs, and the
+        # recorded 0.17 "ratio" measured the clamp, not the framework.
+        # Keep sync_every high enough that window compute dominates the
+        # outer sync the way it does on hardware (window >= ~1 s).
+        if "BENCH_STEPS" not in os.environ:
+            n_steps = min(n_steps, 10)
+        if "BENCH_DDP_STEPS" not in os.environ:
+            ddp_steps = min(ddp_steps, 2)
+        if "BENCH_SYNC_EVERY" not in os.environ:
+            sync_every = min(sync_every, 64)
+        if "BENCH_DILOCO_SYNCS" not in os.environ:
+            diloco_syncs = min(diloco_syncs, 2)
+        cfg = llama_debug()
+        B, S = 8, 256
     else:
         # Pallas flash attention: in the FULL train step it wins from
         # S=1024 on v5e (85.5 vs 133 ms/step at B=8 — the backward's S^2
@@ -634,13 +650,14 @@ def _bench_ft(
     return out
 
 
-def _backend_alive(timeout_s: float) -> bool:
+def _backend_alive() -> bool:
     """Probes jax backend init in a SUBPROCESS: a dead axon relay makes
     jax.devices() hang forever (not error), which would otherwise hang the
-    whole benchmark."""
+    whole benchmark.  30s deadline, verdict cached per-boot and shared
+    with __graft_entry__.dryrun_multichip (probe once per driver round)."""
     from torchft_tpu._backend_probe import probe_device_count
 
-    return probe_device_count(timeout_s) is not None
+    return probe_device_count() is not None
 
 
 def main() -> int:
@@ -655,7 +672,7 @@ def main() -> int:
     if (
         hazard
         and os.environ.get("_BENCH_CPU_FALLBACK") != "1"
-        and not _backend_alive(float(os.environ.get("BENCH_TIMEOUT", 300.0)))
+        and not _backend_alive()
     ):
         # Accelerator unreachable (e.g. dead dev tunnel): re-exec on the
         # CPU platform so the round still records a benchmark line.

@@ -19,3 +19,70 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# Per-test timeouts (reference discipline: its pyproject enforces a global
+# 60s via pytest-timeout).  pytest-timeout isn't in this image, so we
+# implement the same "signal" method inline: SIGALRM in the main thread
+# raises through whatever the test is blocked on.  On this 1-core box one
+# hung test otherwise wedges the whole 12-minute suite — and hang-wedges
+# are exactly this framework's failure domain.
+#
+# Defaults: 120s per test, 600s for @pytest.mark.slow; override per-test
+# with @pytest.mark.timeout(N).
+# ---------------------------------------------------------------------------
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+_DEFAULT_TIMEOUT_S = 120
+_SLOW_TIMEOUT_S = 600
+
+
+class _TestTimeout(Exception):
+    pass
+
+
+def _item_timeout(item) -> float:
+    marker = item.get_closest_marker("timeout")
+    if marker is not None and marker.args:
+        return float(marker.args[0])
+    if item.get_closest_marker("slow") is not None:
+        return _SLOW_TIMEOUT_S
+    return _DEFAULT_TIMEOUT_S
+
+
+def _alarmed(item, phase):
+    """Hookwrapper body shared by setup/call/teardown: hangs in fixture
+    setup or teardown wedge the suite just as surely as hangs in the test
+    body (pytest-timeout's signal method arms all three phases too)."""
+    seconds = _item_timeout(item)
+
+    def _on_alarm(signum, frame):
+        raise _TestTimeout(
+            f"{item.nodeid} exceeded its {seconds:.0f}s timeout ({phase})"
+        )
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    yield from _alarmed(item, "setup")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    yield from _alarmed(item, "call")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    yield from _alarmed(item, "teardown")

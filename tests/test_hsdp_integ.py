@@ -20,7 +20,11 @@ from torchft_tpu.orchestration import ReplicaGroupRunner, render_topology
 pytestmark = pytest.mark.slow
 
 
-def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path):
+@pytest.mark.parametrize("ckpt_transport", ["http", "pg-sharded"])
+def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path, ckpt_transport):
+    """pg-sharded runs the same kill/heal with the addressable-shard PG
+    transport: the healed state never exists as a gathered host pytree
+    (checkpointing/sharded.py) — the 8B-scale heal path."""
     steps = 8
     lighthouse = LighthouseServer(
         bind="127.0.0.1:0",
@@ -38,6 +42,7 @@ def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path):
                 "--model", "debug",
                 "--steps", str(steps),
                 "--min-replicas", "2",
+                "--ckpt-transport", ckpt_transport,
                 "--result-dir", result_dir,
             ],
             num_replica_groups=2,

@@ -354,6 +354,26 @@ def test_wrap_future_swallow_and_timeout():
         m.shutdown()
 
 
+def test_wrap_future_completes_even_if_report_error_raises():
+    """If report_error (or the logger) raises on the callback thread, the
+    wrapped future must still resolve to the default — otherwise the
+    caller's wait() hangs to its own timeout (advisor finding r2,
+    manager.py wrap_future)."""
+    import concurrent.futures
+
+    m = make_manager()
+    try:
+        def boom(exc):
+            raise ValueError("report_error itself blew up")
+
+        m.report_error = boom
+        bad = concurrent.futures.Future()
+        bad.set_exception(RuntimeError("collective died"))
+        assert m.wrap_future(bad, default=-3).result(timeout=5) == -3
+    finally:
+        m.shutdown()
+
+
 def test_fenced_state_dict_excludes_snapshot_reads():
     """While the fence is held, _manager_state_dict (the checkpoint-send
     snapshot) must block — and time out rather than read a torn

@@ -408,6 +408,41 @@ def test_allreduce_quantized_jax_device_path(store):
         g.shutdown()
 
 
+def test_allreduce_quantized_jax_survives_donated_input(store):
+    """The single-array fast path must snapshot the input: a donating
+    jitted train step run during the overlapped window deletes the
+    caller's buffer, and the deferred quantize+pull on the collective
+    thread would then raise 'Array has been deleted' — latched as a
+    spurious FT error (advisor finding r2, collectives.py)."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import allreduce_quantized_jax
+
+    ws = 2
+    n = 4096
+    groups = _make_group(store, ws, prefix="qjaxdon")
+    rng = np.random.default_rng(7)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = sum(d.copy() for d in data)
+
+    def run(rank):
+        # Already 1-D float32: ravel/astype short-circuit, the exact
+        # aliasing case.
+        arr = jnp.asarray(data[rank])
+        work = allreduce_quantized_jax(groups[rank], [arr])
+        arr.delete()  # what donate_argnums does to the buffer
+        outs = work.wait(timeout=60)
+        return np.asarray(outs[0])
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    for r in results:
+        np.testing.assert_allclose(
+            r, expected, atol=np.abs(expected).max() * 0.05
+        )
+    for g in groups:
+        g.shutdown()
+
+
 def test_allreduce_quantized_jax_scale_and_multi_array(store):
     """scale (divide-by-N) fuses into the device dequantize; multiple arrays
     of different shapes round-trip through one flat buffer."""

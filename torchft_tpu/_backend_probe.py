@@ -1,22 +1,109 @@
-"""Subprocess probe for jax backend liveness.
+"""Subprocess probe for jax backend liveness, with a cross-process cache.
 
 A dead accelerator tunnel (e.g. the axon relay this dev box reaches its
 TPU through) makes ``jax.devices()`` HANG forever rather than error, so
 any entry point that must not wedge (bench.py, __graft_entry__) probes
 backend init in a subprocess with a deadline first.
+
+The probe result is cached in a temp file so that consecutive entry
+points in one driver run (bench.py, then ``dryrun_multichip``) pay the
+probe deadline at most once per boot rather than once per process.
+Mirrors the reference's CI discipline of bounding every external wait
+(reference pyproject.toml ``[tool.pytest.ini_options]`` 60s timeout).
 """
 
 from __future__ import annotations
 
+import json
+import os
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Optional
 
+# Verdict trust windows.  A CONFIRMED verdict (backend init returned —
+# alive, or errored outright — dead) is trusted long enough that
+# bench.py + dryrun_multichip in one driver round share a single probe.
+# A TIMEOUT verdict is weaker evidence (a loaded 1-core box can push
+# `import jax` past the deadline with a healthy tunnel), so it is only
+# trusted briefly before re-probing.
+_CACHE_TTL_S = 900.0
+_TIMEOUT_TTL_S = 120.0
 
-def probe_device_count(timeout_s: float = 180.0) -> Optional[int]:
+_DEFAULT_TIMEOUT_S = 30.0
+
+
+def _cache_path() -> str:
+    # Keyed by boot (stale verdicts can't leak across restarts) and uid
+    # (no cross-user clobbering of a predictable world-shared /tmp name).
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip().replace("-", "")[:12]
+    except OSError:
+        boot = "noboot"
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(
+        tempfile.gettempdir(), f"torchft_tpu_probe_{uid}_{boot}.json"
+    )
+
+
+def _read_cache() -> Optional[dict]:
+    try:
+        with open(_cache_path()) as f:
+            data = json.load(f)
+        ttl = _TIMEOUT_TTL_S if data.get("timed_out") else _CACHE_TTL_S
+        elapsed = time.time() - float(data["ts"])
+        # Reject future timestamps too (clock step / crafted file), or a
+        # bogus verdict would never expire.
+        if 0.0 <= elapsed <= ttl:
+            return data
+    except (OSError, ValueError, KeyError):
+        pass
+    return None
+
+
+def _write_cache(count: Optional[int], timed_out: bool) -> None:
+    path = _cache_path()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(
+                {"count": count, "ts": time.time(), "timed_out": timed_out},
+                f,
+            )
+        os.replace(tmp, path)  # atomic vs concurrent probers
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def probe_device_count(
+    timeout_s: float = _DEFAULT_TIMEOUT_S, use_cache: bool = True
+) -> Optional[int]:
     """Returns the visible jax device count, or ``None`` when backend init
-    fails or hangs past ``timeout_s`` (caller should fall back to CPU)."""
+    fails or hangs past ``timeout_s`` (caller should fall back to CPU).
+
+    ``TORCHFT_PROBE_TIMEOUT`` overrides the deadline;
+    ``TORCHFT_PROBE_NO_CACHE=1`` forces a fresh probe.
+    """
+    env_timeout = os.environ.get("TORCHFT_PROBE_TIMEOUT")
+    if env_timeout:
+        timeout_s = float(env_timeout)
+    if os.environ.get("TORCHFT_PROBE_NO_CACHE") == "1":
+        use_cache = False
+
+    if use_cache:
+        cached = _read_cache()
+        if cached is not None:
+            count = cached["count"]
+            return int(count) if count is not None else None
+
     code = "import jax; print(len(jax.devices()))"
+    count: Optional[int]
+    timed_out = False
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
@@ -24,7 +111,15 @@ def probe_device_count(timeout_s: float = 180.0) -> Optional[int]:
             capture_output=True,
         )
         if proc.returncode != 0:
-            return None
-        return int(proc.stdout.split()[-1])
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
-        return None
+            count = None
+        else:
+            count = int(proc.stdout.split()[-1])
+    except subprocess.TimeoutExpired:
+        count = None
+        timed_out = True
+    except (ValueError, IndexError):
+        count = None
+
+    if use_cache:
+        _write_cache(count, timed_out)
+    return count

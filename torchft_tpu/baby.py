@@ -471,7 +471,9 @@ class ProcessGroupBabySocket(ProcessGroup):
             work._complete(exc=err)
 
     def shutdown(self) -> None:
-        with self._lock:
+        # _send_lock first (same order as _issue): the exit message must
+        # not interleave with an in-flight func send on the cmd pipe.
+        with self._send_lock, self._lock:
             if self._cmd_conn is not None:
                 try:
                     self._cmd_conn.send(("exit",))
@@ -490,7 +492,12 @@ class ProcessGroupBabySocket(ProcessGroup):
         self._timeout = timeout
         # Forward to the live child so its op waits and socket deadlines
         # update immediately (not only after the next configure).
-        with self._lock:
+        # _send_lock serializes against _issue's func sends: Connection is
+        # not thread-safe, and a near-64KiB inline payload is written in
+        # multiple syscalls, so an unserialized send here could interleave
+        # and corrupt the child's command stream.  Lock order matches
+        # _issue: _send_lock, then _lock.
+        with self._send_lock, self._lock:
             if self._cmd_conn is not None:
                 try:
                     self._cmd_conn.send(("set_timeout", float(timeout)))
@@ -516,7 +523,8 @@ class ProcessGroupBabySocket(ProcessGroup):
     def _inject_stall(self, seconds: float = 3600.0) -> None:
         """Makes the child sleep before its next op — a deterministic wedge
         for resiliency tests (the scenario this class exists to survive)."""
-        with self._lock:
+        # Same cmd-pipe serialization + lock order as set_timeout.
+        with self._send_lock, self._lock:
             if self._cmd_conn is None:
                 raise RuntimeError("not configured")
             self._cmd_conn.send(("stall", seconds))

@@ -1,0 +1,117 @@
+"""Heal-bandwidth benchmark for the HTTP checkpoint transport.
+
+Role of the reference's ``torchft/checkpointing/http_transport_bench.py``
+(12 GB default, chunked fetch, send/fetch wall-time): measures staging
+time on the serving side and fetch time on the healing side, with the
+chunk-parallel fetch path the transport uses for large states.
+
+Run (CPU box / CI):
+    python -m torchft_tpu.checkpointing.http_transport_bench \
+        --size-gb 1.0 --chunks 4
+
+Prints one JSON line: stage/fetch wall seconds, payload GB, GB/s, and a
+correctness checksum verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from typing import Any, List
+
+import numpy as np
+
+
+def _build_state(size_gb: float, n_leaves: int, fill: float) -> Any:
+    total_elems = int(size_gb * (1 << 30) / 4)
+    per_leaf = max(total_elems // n_leaves, 1 << 10)
+    cols = 1024
+    rows = max(per_leaf // cols, 1)
+    return {
+        f"layer{i}": np.full((rows, cols), fill + i, np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def _payload_bytes(state: Any) -> int:
+    return sum(int(np.prod(v.shape)) * v.dtype.itemsize
+               for v in state.values())
+
+
+def _checksum(state: Any) -> float:
+    return sum(float(np.asarray(v[0]).mean()) for v in state.values())
+
+
+def _run_receiver(args: argparse.Namespace) -> int:
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    receiver = HTTPTransport(timeout=args.timeout)
+    t0 = time.perf_counter()
+    got = receiver.recv_checkpoint(
+        src_rank=0, metadata=args.url, step=7, timeout=args.timeout
+    )
+    fetch_s = time.perf_counter() - t0
+    print(json.dumps({"fetch_s": fetch_s, "checksum": _checksum(got)}),
+          flush=True)
+    receiver.shutdown()
+    return 0
+
+
+def main(argv: List[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size-gb", type=float, default=1.0,
+                   help="payload size (reference bench default: 12)")
+    p.add_argument("--leaves", type=int, default=32)
+    p.add_argument("--chunks", type=int, default=4,
+                   help="parallel fetch chunks (0 = single full stream)")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--url", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--role", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.role == "recv":
+        return _run_receiver(args)
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    sender = HTTPTransport(timeout=args.timeout, num_chunks=args.chunks)
+    state = _build_state(args.size_gb, args.leaves, fill=1.0)
+    payload = _payload_bytes(state)
+    t0 = time.perf_counter()
+    sender.send_checkpoint([1], step=7, state_dict=state,
+                           timeout=args.timeout)
+    stage_s = time.perf_counter() - t0
+
+    child = subprocess.Popen(
+        [sys.executable, "-m", __spec__.name, "--role", "recv",
+         "--url", sender.metadata(), "--timeout", str(args.timeout)],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, _ = child.communicate(timeout=args.timeout)
+        peer = json.loads(out.strip().splitlines()[-1])
+        expect = _checksum(state)
+        ok = abs(peer["checksum"] - expect) < 1e-3 * max(abs(expect), 1.0)
+        result = {
+            "bench": "http_transport",
+            "chunks": args.chunks,
+            "payload_gb": round(payload / (1 << 30), 3),
+            "stage_s": round(stage_s, 3),
+            "fetch_s": round(peer["fetch_s"], 3),
+            "gb_per_s": round(payload / (1 << 30) / peer["fetch_s"], 3),
+            "checksum_ok": ok,
+        }
+        print(json.dumps(result), flush=True)
+        return 0 if ok else 1
+    finally:
+        if child.poll() is None:
+            child.kill()
+        sender.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,12 @@ class PGTransport(CheckpointTransport):
     pg: the process group to send over (ranks = replica ranks).
     state_dict_fn: optional provider of a preallocated state dict to
         receive into (in-place heal; reference: pg_transport.py:230-298).
+    sharded: when True, jax leaves move as their ADDRESSABLE SHARDS
+        (deduped by shard index) rather than gathered global arrays, and
+        the receiver rebuilds each leaf directly onto the devices of the
+        structurally-matching leaf from ``state_dict_fn()`` — the
+        DTensor-local-shard path of the reference (pg_transport.py:27-141)
+        re-designed for jax.sharding.  Requires ``state_dict_fn``.
     """
 
     def __init__(
@@ -33,10 +39,19 @@ class PGTransport(CheckpointTransport):
         pg: ProcessGroup,
         timeout: float = 60.0,
         state_dict_fn: Optional[Callable[[], Any]] = None,
+        sharded: bool = False,
+        delete_stale_leaves: bool = False,
     ) -> None:
         self._pg = pg
         self._timeout = timeout
         self._state_dict_fn = state_dict_fn
+        self._sharded = sharded
+        # Free each stale target leaf as its replacement lands (peak HBM =
+        # old state + one leaf).  Only safe when the target buffers are
+        # quiescent during the receive — a dedicated heal buffer qualifies;
+        # a live trainer's params (still referenced by the main thread
+        # until the pending state applies) do NOT.
+        self._delete_stale = delete_stale_leaves
 
     def metadata(self) -> str:
         return "<n/a>"  # rendezvous comes from the quorum, not a URL
@@ -45,7 +60,12 @@ class PGTransport(CheckpointTransport):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
     ) -> None:
-        meta, buffers = split_state(state_dict)
+        if self._sharded:
+            from torchft_tpu.checkpointing.sharded import split_state_sharded
+
+            meta, buffers = split_state_sharded(state_dict)
+        else:
+            meta, buffers = split_state(state_dict)
         blob = np.frombuffer(pickle.dumps(meta), dtype=np.uint8)
         for dst in dst_ranks:
             # Length-then-meta-then-buffers; tags keep steps distinct.
@@ -59,14 +79,47 @@ class PGTransport(CheckpointTransport):
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
+        if self._sharded and self._state_dict_fn is None:
+            # Fail BEFORE any traffic: discovering this after a multi-GB
+            # transfer would waste the whole heal window.
+            raise ValueError(
+                "sharded PGTransport receive needs state_dict_fn to "
+                "supply the destination shardings"
+            )
         (length,) = self._pg.recv(src_rank, tag=f"ckpt{step}.len").wait(timeout)
         (blob,) = self._pg.recv(src_rank, tag=f"ckpt{step}.meta").wait(timeout)
         meta = pickle.loads(blob.tobytes()[: int(length[0])])
 
+        if self._sharded:
+            from torchft_tpu.checkpointing.sharded import (
+                collect_sharded_refs,
+                join_state_sharded,
+                ref_buffer_meta,
+            )
+
+            wire = [
+                bm
+                for ref in collect_sharded_refs(meta)
+                for bm in ref_buffer_meta(ref)
+            ]
+            buffers: List[Optional[np.ndarray]] = [None] * len(wire)
+            for idx, _dtype, _shape in wire:
+                (buf,) = self._pg.recv(
+                    src_rank, tag=f"ckpt{step}.t{idx}"
+                ).wait(timeout)
+                buffers[idx] = buf.reshape(-1)
+            target = self._state_dict_fn()
+            return join_state_sharded(
+                meta,
+                buffers,
+                target=target,
+                delete_target_leaves=self._delete_stale,
+            )
+
         from torchft_tpu.checkpointing._serialization import collect_refs
 
         refs = collect_refs(meta)
-        buffers: List[Optional[np.ndarray]] = [None] * len(refs)
+        buffers = [None] * len(refs)
         for ref in refs:
             (buf,) = self._pg.recv(src_rank, tag=f"ckpt{step}.t{ref.index}").wait(
                 timeout

@@ -1,0 +1,265 @@
+"""Shard-aware checkpoint (de)serialization for device-sharded pytrees.
+
+Role of the reference's DTensor-aware PG transport
+(``torchft/checkpointing/pg_transport.py:230-298``, which sends local
+shards and receives **in place** into existing tensors): here the unit of
+transfer is the **addressable shard** of a ``jax.Array``.  A healing
+replica group never materializes the full logical state on any single
+host — each rank ships only the shards its devices own, deduplicated by
+shard index (a fully-replicated leaf moves ONE copy, not
+``n_devices``), and the receiver rebuilds each leaf with
+``jax.make_array_from_single_device_arrays`` directly onto its own
+devices, deleting the stale leaf as it goes so peak HBM is
+old-state + one leaf.
+
+This is the difference between an 8B heal moving ~state/n_ranks bytes
+per rank and one moving the full ~32 GB through every host — the input
+the <5% FT budget depends on (reference pg_transport_bench.py measures
+exactly this path at 12 GB).
+
+Assumption (documented contract, same as the reference's "both sides
+share the device mesh layout" requirement): sender and receiver leaves
+have IDENTICAL logical shardings over identically-ordered device lists,
+so shard slots correspond when sorted by device id.  That is the torchft
+topology — rank *i* of the healing group mirrors rank *i* of the source
+group on an identically-configured slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu.checkpointing._serialization import (
+    _TensorRef,
+    _is_array,
+)
+
+
+def _is_sharded_jax(x: Any) -> bool:
+    t = type(x)
+    mod = getattr(t, "__module__", "")
+    return (
+        mod.startswith("jax")
+        and hasattr(x, "sharding")
+        and hasattr(x, "addressable_shards")
+    )
+
+
+@dataclass
+class _ShardedRef:
+    """Placeholder for a device-sharded array leaf.  ``keys[k]`` is the
+    normalized slice-index of unique shard buffer k (what the receiver
+    matches against its own sharding's ``devices_indices_map``, so
+    correctness never depends on device enumeration order agreeing
+    between sender and receiver).  ``slot_map[k]`` additionally names the
+    buffer for the k-th addressable device sorted by id (diagnostics /
+    wire accounting)."""
+
+    first: int  # global buffer index of this leaf's first unique shard
+    shapes: List[Tuple[int, ...]]  # per unique shard buffer
+    slot_map: List[int]  # per device slot -> offset into shapes
+    dtype: str
+    global_shape: Tuple[int, ...]
+    keys: Optional[List[Tuple]] = None  # slice key per unique buffer
+
+
+def _index_key(index: Tuple) -> Tuple:
+    """Hashable form of a Shard.index (tuple of slices)."""
+    out = []
+    for s in index:
+        if isinstance(s, slice):
+            out.append(("s", s.start, s.stop, s.step))
+        else:
+            out.append(("i", s))
+    return tuple(out)
+
+
+def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
+    """Like ``_serialization.split_state`` but jax leaves contribute one
+    buffer per UNIQUE addressable shard — no gather of the global array,
+    no duplicate bytes for replicated dims."""
+    buffers: List[np.ndarray] = []
+
+    def walk(x: Any) -> Any:
+        if _is_sharded_jax(x):
+            shards = sorted(
+                x.addressable_shards, key=lambda s: s.device.id
+            )
+            first = len(buffers)
+            shapes: List[Tuple[int, ...]] = []
+            slot_map: List[int] = []
+            keys: List[Tuple] = []
+            uniq: dict = {}
+            for s in shards:
+                key = _index_key(s.index)
+                if key not in uniq:
+                    uniq[key] = len(shapes)
+                    data = np.asarray(s.data)
+                    shapes.append(tuple(data.shape))
+                    keys.append(key)
+                    buffers.append(np.ascontiguousarray(data))
+                slot_map.append(uniq[key])
+            return _ShardedRef(
+                first, shapes, slot_map, str(x.dtype), tuple(x.shape),
+                keys,
+            )
+        if _is_array(x) and not np.isscalar(x):
+            arr = np.asarray(x)
+            ref = _TensorRef(len(buffers), str(arr.dtype), tuple(arr.shape))
+            buffers.append(np.ascontiguousarray(arr))
+            return ref
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            mapped = [walk(v) for v in x]
+            if hasattr(x, "_fields"):  # NamedTuple (e.g. optax states)
+                return type(x)(*mapped)
+            return tuple(mapped)
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        return x
+
+    return walk(obj), buffers
+
+
+def collect_sharded_refs(meta: Any) -> List[Any]:
+    """All refs (_TensorRef and _ShardedRef) in buffer-index order; a
+    _ShardedRef occupies ``len(ref.shapes)`` consecutive indices."""
+    refs: List[Any] = []
+
+    def collect(x: Any) -> None:
+        if isinstance(x, (_TensorRef, _ShardedRef)):
+            refs.append(x)
+        elif isinstance(x, dict):
+            for v in x.values():
+                collect(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                collect(v)
+
+    collect(meta)
+    refs.sort(key=lambda r: r.index if isinstance(r, _TensorRef) else r.first)
+    return refs
+
+
+def ref_buffer_meta(ref: Any) -> List[Tuple[int, str, Tuple[int, ...]]]:
+    """(buffer_index, dtype, shape) for each wire buffer a ref owns."""
+    if isinstance(ref, _TensorRef):
+        return [(ref.index, ref.dtype, ref.shape)]
+    return [
+        (ref.first + k, ref.dtype, shape)
+        for k, shape in enumerate(ref.shapes)
+    ]
+
+
+def join_state_sharded(
+    meta: Any,
+    buffers: List[Optional[np.ndarray]],
+    target: Optional[Any] = None,
+    delete_target_leaves: bool = False,
+) -> Any:
+    """Rebuilds the pytree; each ``_ShardedRef`` leaf is assembled with
+    ``jax.make_array_from_single_device_arrays`` onto the sharding of the
+    structurally-corresponding leaf in ``target`` (required when any leaf
+    is sharded).  With ``delete_target_leaves=True``, stale ``target``
+    leaves are deleted as each new leaf is built, so peak device memory
+    is old-state + one leaf — ONLY safe when no other thread can still
+    compute on the target arrays (a healing trainer's main thread may;
+    a dedicated receive buffer can't).
+
+    Plain (host) leaves follow the ``join_state`` in-place contract:
+    written into ``target``'s buffer when writable, else fresh.
+    """
+    import jax
+
+    def walk(m: Any, t: Any) -> Any:
+        if isinstance(m, _ShardedRef):
+            if t is None or not hasattr(t, "sharding"):
+                raise ValueError(
+                    "sharded leaf needs a target jax array with the "
+                    "destination sharding"
+                )
+            sharding = t.sharding
+            if tuple(t.shape) != tuple(m.global_shape):
+                raise ValueError(
+                    f"target shape {tuple(t.shape)} != checkpoint "
+                    f"shape {tuple(m.global_shape)}"
+                )
+            devs = sorted(
+                sharding.addressable_devices, key=lambda d: d.id
+            )
+            if len(devs) != len(m.slot_map):
+                raise ValueError(
+                    f"target has {len(devs)} addressable devices, "
+                    f"checkpoint leaf has {len(m.slot_map)} slots"
+                )
+            dtype = np.dtype(m.dtype)
+            # Match each device to its buffer by SLICE INDEX (from the
+            # receiver's own sharding), not device enumeration order —
+            # robust to sender/receiver id-order skew.
+            key_to_buf = (
+                {k: i for i, k in enumerate(m.keys)} if m.keys else None
+            )
+            idx_map = (
+                sharding.addressable_devices_indices_map(
+                    tuple(m.global_shape)
+                )
+                if key_to_buf is not None
+                else None
+            )
+            singles = []
+            for slot, dev in enumerate(devs):
+                if key_to_buf is not None:
+                    key = _index_key(idx_map[dev])
+                    if key not in key_to_buf:
+                        raise ValueError(
+                            f"target sharding needs slice {key} which the "
+                            "checkpoint does not contain (sender/receiver "
+                            "shardings differ)"
+                        )
+                    k = key_to_buf[key]
+                else:  # legacy meta without keys: device-id order
+                    k = m.slot_map[slot]
+                buf = buffers[m.first + k]
+                assert buf is not None, f"missing buffer {m.first + k}"
+                host = buf.reshape(m.shapes[k]).astype(dtype, copy=False)
+                singles.append(jax.device_put(host, dev))
+            arr = jax.make_array_from_single_device_arrays(
+                tuple(m.global_shape), sharding, singles
+            )
+            if delete_target_leaves:
+                t.delete()  # free the stale leaf's HBM before the next
+            return arr
+        if isinstance(m, _TensorRef):
+            buf = buffers[m.index]
+            assert buf is not None, f"missing buffer {m.index}"
+            arr = buf.reshape(m.shape)
+            if t is not None and isinstance(t, np.ndarray):
+                if t.shape == arr.shape and t.flags.writeable:
+                    np.copyto(t, arr.astype(t.dtype, copy=False))
+                    return t
+            return arr
+        if isinstance(m, dict):
+            return {
+                k: walk(v, t.get(k) if isinstance(t, dict) else None)
+                for k, v in m.items()
+            }
+        if isinstance(m, tuple):
+            tt = t if isinstance(t, tuple) and len(t) == len(m) else (
+                (None,) * len(m)
+            )
+            mapped = [walk(v, tv) for v, tv in zip(m, tt)]
+            if hasattr(m, "_fields"):
+                return type(m)(*mapped)
+            return tuple(mapped)
+        if isinstance(m, list):
+            tl = t if isinstance(t, list) and len(t) == len(m) else (
+                [None] * len(m)
+            )
+            return [walk(v, tv) for v, tv in zip(m, tl)]
+        return m
+
+    return walk(meta, target)

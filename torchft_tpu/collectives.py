@@ -192,26 +192,50 @@ def allreduce_quantized_jax(
             offset += size
         return outs
 
-    flat = (
-        jnp.concatenate([jnp.ravel(a).astype(jnp.float32) for a in arrays])
-        if len(arrays) > 1
-        else jnp.ravel(arrays[0]).astype(jnp.float32)
-    )
+    if len(arrays) > 1:
+        flat = jnp.concatenate(
+            [jnp.ravel(a).astype(jnp.float32) for a in arrays]
+        )
+    else:
+        flat = jnp.ravel(arrays[0]).astype(jnp.float32)
     ws = pg.size()
     if ws <= 1:
         return DummyWork(rebuild(flat * scale) if scale != 1.0 else arrays)
+    a0 = arrays[0]
+    if len(arrays) == 1 and a0.ndim == 1 and a0.dtype == jnp.float32:
+        # ravel/astype both short-circuited, so ``flat`` aliases the
+        # caller's buffer.  The quantize+pull below runs later on the
+        # collective thread, overlapped with the caller's next train
+        # step — which may DONATE this buffer (make_train_step and
+        # bench.py both donate), deleting it mid-pull.  Materialize an
+        # independent device snapshot before returning to the caller.
+        # (Below the ws<=1 return: the single-replica path never defers.)
+        flat = jnp.copy(flat)
 
     from torchft_tpu.telemetry import trace_span
 
     total_scale = scale / ws if op == ReduceOp.AVG else scale
 
+    # On TPU the Pallas kernels quantize/dequantize ON DEVICE (int8 over
+    # PCIe, ~4x fewer bytes).  Off-TPU those same kernels would run
+    # through the Pallas INTERPRETER — a test shim, seconds per MB — so
+    # the compiled-CPU deployment path is the vectorized host quantizer
+    # (same wire format bit-for-bit; the bench peer already uses it for
+    # exactly this reason).
+    host_quant = jax.default_backend() != "tpu"
+
     def run() -> List["jax.Array"]:
         # Device quantize + int8 host pull run on the collective thread:
-        # jax arrays are immutable, so ``flat`` is already a snapshot —
-        # deferring the pull overlaps it with the caller's next compute
-        # window (the streaming-DiLoCo overlap this path exists for).
+        # ``flat`` is an independent snapshot (see above) — deferring the
+        # pull overlaps it with the caller's next compute window (the
+        # streaming-DiLoCo overlap this path exists for).
         with trace_span("torchft::collectives::quantize_pull"):
-            q_host, s_host, n = Q.quantize_for_transfer(flat)
+            if host_quant:
+                flat_host = np.asarray(flat, dtype=np.float32)
+                n = flat_host.size
+                q_host, s_host = quantize_blockwise(flat_host)
+            else:
+                q_host, s_host, n = Q.quantize_for_transfer(flat)
         with trace_span("torchft::collectives::wire"):
             reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
         with trace_span("torchft::collectives::dequant_push"):
@@ -222,10 +246,15 @@ def allreduce_quantized_jax(
                 out = jnp.asarray(reduced)
             else:
                 q_final, s_final = reduced
-                # Device-side dequantize (chunked; the sum stayed fp32 on
-                # the wire pipeline so only one quantize->dequantize round
-                # trip of error per value).
-                out = Q.dequantize_from_transfer(q_final, s_final, n)
+                if host_quant:
+                    out = jnp.asarray(
+                        dequantize_blockwise(q_final, s_final, n)
+                    )
+                else:
+                    # Device-side dequantize (chunked; the sum stayed fp32
+                    # on the wire pipeline so only one quantize->dequantize
+                    # round trip of error per value).
+                    out = Q.dequantize_from_transfer(q_final, s_final, n)
             if total_scale != 1.0:
                 out = out * total_scale
             outs = rebuild(out)

@@ -278,18 +278,32 @@ class Manager:
         out: concurrent.futures.Future = concurrent.futures.Future()
 
         def on_done(f: "concurrent.futures.Future") -> None:
-            exc = f.exception()
-            if exc is not None:
-                # Not _logger.exception: this callback has no active
-                # exception context (exc came from the future), so log
-                # the instance itself to keep the real failure visible.
-                self._logger.warn(f"wrapped future failed: {exc!r}")
-                self.report_error(
-                    exc if isinstance(exc, Exception) else RuntimeError(str(exc))
-                )
-                out.set_result(default)
-            else:
-                out.set_result(f.result())
+            # Runs on the timeout-engine/callback thread: `out` MUST be
+            # completed no matter what report_error/logging do, or the
+            # caller's wait() hangs to its own deadline instead of getting
+            # the swallowed default.
+            completed = False
+            try:
+                exc = f.exception()
+                if exc is None:
+                    out.set_result(f.result())
+                    completed = True
+                else:
+                    # Not _logger.exception: this callback has no active
+                    # exception context (exc came from the future), so log
+                    # the instance itself to keep the real failure visible.
+                    self._logger.warn(f"wrapped future failed: {exc!r}")
+                    self.report_error(
+                        exc
+                        if isinstance(exc, Exception)
+                        else RuntimeError(str(exc))
+                    )
+            finally:
+                if not completed:
+                    try:
+                        out.set_result(default)
+                    except concurrent.futures.InvalidStateError:
+                        pass
 
         timed.add_done_callback(on_done)
         return out

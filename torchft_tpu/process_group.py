@@ -176,7 +176,14 @@ class _PeerConn:
             raise RuntimeError(f"connection to rank {self.peer} dead: {self.dead}")
         header = {"tag": tag, "dtype": str(arr.dtype), "shape": list(arr.shape)}
         # Zero-copy: sendall consumes the array's buffer directly.
-        data = memoryview(np.ascontiguousarray(arr)).cast("B")
+        arr_c = np.ascontiguousarray(arr)
+        try:
+            data = memoryview(arr_c).cast("B")
+        except ValueError:
+            # ml_dtypes (bfloat16, fp8) are outside the buffer protocol;
+            # reinterpret as raw bytes — recv's frombuffer restores the
+            # dtype from the header.
+            data = memoryview(arr_c.view(np.uint8)).cast("B")
         with self.send_lock:
             _net.send_json(self.sock, header)
             _net.send_frame(self.sock, data)

@@ -49,6 +49,13 @@ def main() -> int:
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--quantize", action="store_true",
                         help="int8-quantize the outer gradient allreduce")
+    parser.add_argument(
+        "--ckpt-transport", choices=["http", "pg-sharded"], default="http",
+        help="heal transport: http = full-state fetch; pg-sharded = "
+        "addressable shards over the replica PG, rebuilt straight onto "
+        "this group's device shardings (no host gather — the 8B-scale "
+        "path; checkpointing/sharded.py)",
+    )
     parser.add_argument("--result-dir", type=str, default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
@@ -137,10 +144,16 @@ def main() -> int:
         out_shardings=(shardings.params, shardings.opt_state),
     )
 
-    # Heal contract: a recovering group receives params + optimizer state as
-    # host numpy pytrees and re-shards them onto its own mesh (in production
-    # the PG transport receives in place; HTTP is the default here).
+    # Heal contract. http: the recovering group receives params + optimizer
+    # state as host numpy pytrees and re-shards them onto its own mesh.
+    # pg-sharded: leaves stay jax arrays end to end — the sender ships only
+    # addressable shards and the receiver rebuilds each leaf directly onto
+    # its shardings (reference pg_transport.py:230-298 in-place receive).
+    sharded_heal = args.ckpt_transport == "pg-sharded"
+
     def hsdp_state_dict():
+        if sharded_heal:
+            return {"params": params, "opt_state": opt_state}
         return {
             "params": jax.tree_util.tree_map(np.asarray, params),
             "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
@@ -151,8 +164,27 @@ def main() -> int:
         params = jax.device_put(state_dict["params"], shardings.params)
         opt_state = jax.device_put(state_dict["opt_state"], shardings.opt_state)
 
+    pg = ProcessGroupSocket(timeout=30.0)
+    checkpoint_transport = None
+    if sharded_heal:
+        from torchft_tpu.checkpointing.pg_transport import PGTransport
+
+        def ckpt_target():
+            # Structure mirrors Manager._manager_state_dict(); the
+            # "torchft" scalars need no device target.
+            return {
+                "user": {
+                    "default": {"params": params, "opt_state": opt_state}
+                }
+            }
+
+        checkpoint_transport = PGTransport(
+            pg, timeout=60.0, state_dict_fn=ckpt_target, sharded=True
+        )
+
     manager = Manager(
-        pg=ProcessGroupSocket(timeout=30.0),
+        pg=pg,
+        checkpoint_transport=checkpoint_transport,
         state_dict=hsdp_state_dict,
         load_state_dict=hsdp_load_state,
         min_replica_size=args.min_replicas,
