@@ -227,9 +227,17 @@ def _bench() -> dict:
         # Pallas flash attention: in the FULL train step it wins from
         # S=1024 on v5e (85.5 vs 133 ms/step at B=8 — the backward's S^2
         # score storage, not attention FLOPs, was the bottleneck).
+        # BENCH_FLASH_BQ/BK and BENCH_REMAT are on-chip tuning knobs
+        # (flash tile grid, remat policy) for the MFU push.
         attn = "flash" if n_dev == 1 else "dense"
         cfg = (
-            llama_small(remat=False, attn_impl=attn, flash_min_seq=1024)
+            llama_small(
+                remat=bool(int(os.environ.get("BENCH_REMAT", "0"))),
+                attn_impl=attn,
+                flash_min_seq=1024,
+                flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", 512)),
+                flash_block_k=int(os.environ.get("BENCH_FLASH_BK", 512)),
+            )
             if n_dev == 1
             else llama_small()
         )

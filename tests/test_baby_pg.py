@@ -238,6 +238,42 @@ def test_clean_shutdown_latches_no_error(store):
     assert pg.errored() is None
 
 
+def test_shutdown_completes_while_cmd_pipe_wedged(store):
+    """shutdown() must reach the child kill even when a wedged child has
+    stopped draining the cmd pipe and another thread is blocked mid-send
+    holding the send lock — the polite exit message is skipped after a
+    bounded wait instead of deadlocking (the hang-wedge domain this
+    class exists to survive)."""
+    import threading
+
+    pg = ProcessGroupBabySocket(timeout=10.0)
+    pg.configure(f"{store.address()}/wedgeshut", 0, 1)
+    pg.allreduce(np.ones(4, np.float32)).wait(timeout=30)
+    pg._inject_stall(3600.0)  # child sleeps; cmd pipe no longer drained
+
+    stop_spam = threading.Event()
+
+    def spam():
+        # Fill the pipe until a send blocks while holding _send_lock.
+        # kwargs pad each cmd message so the 64KiB pipe buffer fills in
+        # few iterations.
+        pad = "x" * 8192
+        while not stop_spam.is_set():
+            pg._issue(
+                "allreduce", [np.ones(4, np.float32)],
+                op=ReduceOp.SUM.value, _pad=pad,
+            )
+
+    t = threading.Thread(target=spam, daemon=True)
+    t.start()
+    time.sleep(1.0)  # let the spammer wedge in conn.send
+    t0 = time.monotonic()
+    pg.shutdown()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 15, f"shutdown took {elapsed:.1f}s under a wedged pipe"
+    stop_spam.set()
+
+
 def test_set_timeout_reaches_child(store):
     """set_timeout takes effect on the live child: a wedged peer now fails
     in ~2s, not the configure-time 60s."""

@@ -471,15 +471,27 @@ class ProcessGroupBabySocket(ProcessGroup):
             work._complete(exc=err)
 
     def shutdown(self) -> None:
-        # _send_lock first (same order as _issue): the exit message must
-        # not interleave with an in-flight func send on the cmd pipe.
-        with self._send_lock, self._lock:
-            if self._cmd_conn is not None:
-                try:
-                    self._cmd_conn.send(("exit",))
-                except (OSError, BrokenPipeError):
-                    pass
-            if self._child is not None:
+        # Politely ask the child to exit, serialized against in-flight
+        # func sends (_send_lock, same order as _issue) — but with a
+        # BOUNDED wait: a wedged child can leave _issue blocked mid-send
+        # holding _send_lock forever, and shutdown must still reach the
+        # kill below (the hang-wedge domain this class exists for).  If
+        # the lock can't be had, skip the polite exit; the kill makes the
+        # interleaving question moot.
+        polite = self._send_lock.acquire(timeout=1.0)
+        try:
+            if polite:
+                with self._lock:
+                    if self._cmd_conn is not None:
+                        try:
+                            self._cmd_conn.send(("exit",))
+                        except (OSError, BrokenPipeError):
+                            pass
+        finally:
+            if polite:
+                self._send_lock.release()
+        with self._lock:
+            if polite and self._child is not None:
                 self._child.join(timeout=5.0)
             failed = self._kill_child_locked()
         for work, err in failed:

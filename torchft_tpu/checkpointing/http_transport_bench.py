@@ -22,27 +22,18 @@ import sys
 import time
 from typing import Any, List
 
-import numpy as np
+
+
+from torchft_tpu.checkpointing._bench_common import (
+    build_state as _build_state_common,
+    checksum as _checksum,
+    checksum_ok as _checksum_ok,
+    payload_bytes as _payload_bytes,
+)
 
 
 def _build_state(size_gb: float, n_leaves: int, fill: float) -> Any:
-    total_elems = int(size_gb * (1 << 30) / 4)
-    per_leaf = max(total_elems // n_leaves, 1 << 10)
-    cols = 1024
-    rows = max(per_leaf // cols, 1)
-    return {
-        f"layer{i}": np.full((rows, cols), fill + i, np.float32)
-        for i in range(n_leaves)
-    }
-
-
-def _payload_bytes(state: Any) -> int:
-    return sum(int(np.prod(v.shape)) * v.dtype.itemsize
-               for v in state.values())
-
-
-def _checksum(state: Any) -> float:
-    return sum(float(np.asarray(v[0]).mean()) for v in state.values())
+    return _build_state_common(size_gb, n_leaves, fill)
 
 
 def _run_receiver(args: argparse.Namespace) -> int:
@@ -94,8 +85,7 @@ def main(argv: List[str] | None = None) -> int:
     try:
         out, _ = child.communicate(timeout=args.timeout)
         peer = json.loads(out.strip().splitlines()[-1])
-        expect = _checksum(state)
-        ok = abs(peer["checksum"] - expect) < 1e-3 * max(abs(expect), 1.0)
+        ok = _checksum_ok(peer["checksum"], _checksum(state))
         result = {
             "bench": "http_transport",
             "chunks": args.chunks,

@@ -71,68 +71,20 @@ def _ensure_cpu_mesh(n_devices: int) -> None:
               + sys.argv[1:], env)
 
 
+from torchft_tpu.checkpointing._bench_common import (
+    build_state as _build_state_common,
+    checksum as _checksum,
+    checksum_ok as _checksum_ok,
+    payload_bytes as _payload_bytes,
+)
+
+
 def _build_state(
     size_gb: float, n_leaves: int, sharded: bool, n_devices: int, fill: float
 ) -> Any:
-    """A train-state-shaped pytree: n_leaves 2D fp32 arrays of equal size
-    (params + an optimizer-moment mirror), plus scalar step metadata."""
-    import numpy as np
-
-    total_elems = int(size_gb * (1 << 30) / 4)
-    per_leaf = max(total_elems // n_leaves, 1 << 10)
-    cols = 1024
-    rows = max(per_leaf // cols, 1)
-    if sharded:
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        devs = jax.devices()[:n_devices]
-        mesh = Mesh(np.array(devs), ("fsdp",))
-        # Row-sharded (fsdp-style); rows padded to the mesh size.
-        rows = ((rows + n_devices - 1) // n_devices) * n_devices
-        sharding = NamedSharding(mesh, P("fsdp", None))
-
-        def leaf(i: int):
-            return jax.device_put(
-                jnp.full((rows, cols), fill + i, jnp.float32), sharding
-            )
-
-        leaves = [leaf(i) for i in range(n_leaves)]
-    else:
-        leaves = [
-            np.full((rows, cols), fill + i, np.float32)
-            for i in range(n_leaves)
-        ]
-    return {
-        "params": {f"layer{i}": leaves[i] for i in range(n_leaves // 2)},
-        "opt": {
-            f"mu{i}": leaves[i]
-            for i in range(n_leaves // 2, n_leaves)
-        },
-        "step": 7,
-    }
-
-
-def _payload_bytes(state: Any) -> int:
-    import numpy as np
-
-    total = 0
-    for tree in (state["params"], state["opt"]):
-        for v in tree.values():
-            total += int(np.prod(v.shape)) * v.dtype.itemsize
-    return total
-
-
-def _checksum(state: Any) -> float:
-    """Cheap content fingerprint: sum of each leaf's first-row mean."""
-    import numpy as np
-
-    acc = 0.0
-    for tree in (state["params"], state["opt"]):
-        for v in tree.values():
-            acc += float(np.asarray(v[0]).mean())
-    return acc
+    return _build_state_common(
+        size_gb, n_leaves, fill, sharded=sharded, n_devices=n_devices
+    )
 
 
 def _run_receiver(args: argparse.Namespace) -> int:
@@ -223,8 +175,7 @@ def main(argv: List[str] | None = None) -> int:
         send_s = time.perf_counter() - t0
         out, _ = child.communicate(timeout=args.timeout)
         peer = json.loads(out.strip().splitlines()[-1])
-        expect = _checksum(state)
-        ok = abs(peer["checksum"] - expect) < 1e-3 * max(abs(expect), 1.0)
+        ok = _checksum_ok(peer["checksum"], _checksum(state))
         result = {
             "bench": "pg_transport",
             "mode": "sharded" if args.sharded else "dense",

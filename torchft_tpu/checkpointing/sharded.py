@@ -63,7 +63,7 @@ class _ShardedRef:
     slot_map: List[int]  # per device slot -> offset into shapes
     dtype: str
     global_shape: Tuple[int, ...]
-    keys: Optional[List[Tuple]] = None  # slice key per unique buffer
+    keys: List[Tuple]  # slice key per unique buffer
 
 
 def _index_key(index: Tuple) -> Tuple:
@@ -200,29 +200,22 @@ def join_state_sharded(
             # Match each device to its buffer by SLICE INDEX (from the
             # receiver's own sharding), not device enumeration order —
             # robust to sender/receiver id-order skew.
-            key_to_buf = (
-                {k: i for i, k in enumerate(m.keys)} if m.keys else None
-            )
-            idx_map = (
-                sharding.addressable_devices_indices_map(
-                    tuple(m.global_shape)
-                )
-                if key_to_buf is not None
-                else None
+            key_to_buf = {
+                tuple(k): i for i, k in enumerate(m.keys)
+            }
+            idx_map = sharding.addressable_devices_indices_map(
+                tuple(m.global_shape)
             )
             singles = []
             for slot, dev in enumerate(devs):
-                if key_to_buf is not None:
-                    key = _index_key(idx_map[dev])
-                    if key not in key_to_buf:
-                        raise ValueError(
-                            f"target sharding needs slice {key} which the "
-                            "checkpoint does not contain (sender/receiver "
-                            "shardings differ)"
-                        )
-                    k = key_to_buf[key]
-                else:  # legacy meta without keys: device-id order
-                    k = m.slot_map[slot]
+                key = _index_key(idx_map[dev])
+                if key not in key_to_buf:
+                    raise ValueError(
+                        f"target sharding needs slice {key} which the "
+                        "checkpoint does not contain (sender/receiver "
+                        "shardings differ)"
+                    )
+                k = key_to_buf[key]
                 buf = buffers[m.first + k]
                 assert buf is not None, f"missing buffer {m.first + k}"
                 host = buf.reshape(m.shapes[k]).astype(dtype, copy=False)

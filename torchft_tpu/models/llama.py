@@ -55,6 +55,11 @@ class LlamaConfig:
     # v5e crossover; the blocked kernel wins from ~2k and is mandatory past
     # dense's O(S^2) memory wall).
     flash_min_seq: int = 2048
+    # Flash kernel tile sizes (q rows / kv cols per VMEM block). 512x512
+    # is the v5e default; exposed for on-chip grid tuning (smaller block_q
+    # raises grid parallelism, larger block_k amortizes the kv sweep).
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     # Mixture of experts: num_experts == 0 -> dense MLP. Experts shard over
     # the 'ep' mesh axis (parallel/sharding.py); dispatch/combine are dense
     # one-hot einsums so XLA derives the all-to-all from the shardings.
@@ -206,8 +211,14 @@ class Attention(nn.Module):
                 supports,
             )
 
-            if q.shape[1] >= cfg.flash_min_seq and supports(q.shape[1]):
-                out = flash_attention(q, k, v)
+            if q.shape[1] >= cfg.flash_min_seq and supports(
+                q.shape[1], cfg.flash_block_q, cfg.flash_block_k
+            ):
+                out = flash_attention(
+                    q, k, v,
+                    block_q=cfg.flash_block_q,
+                    block_k=cfg.flash_block_k,
+                )
             else:
                 out = dense_attention(q, k, v)
         else:
