@@ -264,6 +264,44 @@ def test_pg_transport_sharded_inplace_device_receive():
     store.shutdown()
 
 
+def test_pg_transport_sharded_multi_dst():
+    """A heal with TWO recovering replicas: each shard is pulled once and
+    sent to both destinations; both receivers rebuild bitwise-equal
+    states."""
+    store = TCPStoreServer()
+    pgs = [ProcessGroupSocket(timeout=10.0) for _ in range(3)]
+
+    def configure(rank):
+        pgs[rank].configure(f"{store.address()}/multidst", rank, 3)
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        list(pool.map(configure, range(3)))
+
+    src = _sharded_state(fill=4.0)
+    targets = [_sharded_state(fill=0.0) for _ in range(2)]
+    sender = PGTransport(pgs[0], timeout=10.0, sharded=True)
+    receivers = [
+        PGTransport(pgs[r + 1], timeout=10.0, sharded=True,
+                    state_dict_fn=lambda r=r: targets[r])
+        for r in range(2)
+    ]
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        fs = pool.submit(sender.send_checkpoint, [1, 2], 5, src, 30)
+        frs = [
+            pool.submit(receivers[r].recv_checkpoint, 0, "<n/a>", 5, 30)
+            for r in range(2)
+        ]
+        fs.result(timeout=30)
+        got = [f.result(timeout=30) for f in frs]
+    for g in got:
+        np.testing.assert_array_equal(np.asarray(g["w"]), np.asarray(src["w"]))
+        assert g["step"] == 11
+    for pg in pgs:
+        pg.shutdown()
+    store.shutdown()
+
+
 @pytest.mark.slow
 def test_pg_transport_bench_harness_smoke():
     """The CLI bench harness runs end-to-end (two OS processes, tiny

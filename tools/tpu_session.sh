@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# One-shot on-chip artifact collection for when the TPU tunnel is alive.
+# Produces, in order (each step is independent; later steps still run if
+# an earlier one fails):
+#   1. BENCH_TPU_r03.json   — full bench.py run on the real chip
+#   2. KERNELS_TPU.json     — compiled-mode Pallas kernel parity + latency
+#   3. profiles/tpu_r03/    — jax.profiler trace of the raw train step
+#   4. MFU_SWEEP_r03.jsonl  — flash-tile / remat sweep (tools/mfu_sweep.py)
+# Run from the repo root:  bash tools/tpu_session.sh
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 0. clear probe cache + confirm chip =="
+rm -f "${TMPDIR:-/tmp}"/torchft_tpu_probe_*.json
+if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); assert d[0].platform != 'cpu'"; then
+    echo "TPU not reachable — aborting (nothing written)"; exit 1
+fi
+
+echo "== 1. bench.py -> BENCH_TPU_r03.json =="
+timeout 2400 python bench.py > BENCH_TPU_r03.json.tmp 2> bench_tpu_r03.stderr \
+    && tail -1 BENCH_TPU_r03.json.tmp > BENCH_TPU_r03.json \
+    && rm -f BENCH_TPU_r03.json.tmp \
+    && echo "bench OK: $(cat BENCH_TPU_r03.json)" \
+    || echo "bench FAILED (see bench_tpu_r03.stderr)"
+
+echo "== 2. kernel parity -> KERNELS_TPU.json =="
+timeout 900 python -m torchft_tpu.ops.bench_kernels > KERNELS_TPU.json.tmp \
+    && tail -1 KERNELS_TPU.json.tmp > KERNELS_TPU.json \
+    && rm -f KERNELS_TPU.json.tmp \
+    && echo "kernels OK: $(cat KERNELS_TPU.json)" \
+    || echo "kernels FAILED"
+
+echo "== 3. profiler trace -> profiles/tpu_r03/ =="
+mkdir -p profiles/tpu_r03
+timeout 900 python - <<'PYEOF' || echo "trace FAILED"
+import time
+import jax, jax.numpy as jnp, numpy as np
+from torchft_tpu.models import llama_small
+from torchft_tpu.parallel import auto_mesh
+from torchft_tpu.parallel.train import build_model, init_train_state, make_train_step
+
+cfg = llama_small(remat=False, attn_impl="flash", flash_min_seq=1024)
+mesh = auto_mesh(1)
+model = build_model(cfg, mesh)
+B, S = 8, 1024
+state, sh = init_train_state(model, mesh, jax.random.PRNGKey(0), (B, S))
+step = make_train_step(model, mesh, sh)
+rng = np.random.default_rng(0)
+batch = {
+    "inputs": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+    "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+    "mask": jnp.ones((B, S), jnp.int32),
+}
+for _ in range(3):
+    state, m = step(state, batch)
+jax.block_until_ready(m["loss"])
+with jax.profiler.trace("profiles/tpu_r03"):
+    for _ in range(5):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+print("trace OK: profiles/tpu_r03")
+PYEOF
+
+echo "== 4. MFU sweep -> MFU_SWEEP_r03.jsonl =="
+timeout 2400 python tools/mfu_sweep.py > MFU_SWEEP_r03.jsonl \
+    && echo "sweep OK:" && cat MFU_SWEEP_r03.jsonl \
+    || echo "sweep FAILED (partial results kept)"
+
+echo "== done — review artifacts, then git add + commit them =="

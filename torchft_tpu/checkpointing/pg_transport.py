@@ -61,19 +61,60 @@ class PGTransport(CheckpointTransport):
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
     ) -> None:
         if self._sharded:
-            from torchft_tpu.checkpointing.sharded import split_state_sharded
-
-            meta, buffers = split_state_sharded(state_dict)
-        else:
-            meta, buffers = split_state(state_dict)
+            self._send_sharded_streaming(dst_ranks, step, state_dict, timeout)
+            return
+        meta, buffers = split_state(state_dict)
         blob = np.frombuffer(pickle.dumps(meta), dtype=np.uint8)
         for dst in dst_ranks:
             # Length-then-meta-then-buffers; tags keep steps distinct.
-            self._pg.send([np.array([len(blob)], dtype=np.int64)],
-                          dst, tag=f"ckpt{step}.len").wait(timeout)
-            self._pg.send([blob], dst, tag=f"ckpt{step}.meta").wait(timeout)
+            self._send_preamble(dst, step, blob, timeout)
             for i, buf in enumerate(buffers):
                 self._pg.send([buf], dst, tag=f"ckpt{step}.t{i}").wait(timeout)
+
+    def _send_preamble(
+        self, dst: int, step: int, blob: np.ndarray, timeout: float
+    ) -> None:
+        """The wire preamble both send paths share: meta length, then the
+        pickled meta skeleton."""
+        self._pg.send([np.array([len(blob)], dtype=np.int64)],
+                      dst, tag=f"ckpt{step}.len").wait(timeout)
+        self._pg.send([blob], dst, tag=f"ckpt{step}.meta").wait(timeout)
+
+    def _send_sharded_streaming(
+        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+    ) -> None:
+        """Streams shard buffers: each device->host pull happens just
+        before its wire send, with a 1-deep prefetch so the next pull
+        overlaps the current send.  Peak host memory is O(two shards)
+        instead of the whole state — a 32 GB heal must not need 32 GB of
+        sender host RAM (the eager reference path stages a full CPU copy;
+        this is the part the TPU re-design can do strictly better)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from torchft_tpu.checkpointing.sharded import (
+            split_state_sharded_lazy,
+        )
+
+        meta, thunks = split_state_sharded_lazy(state_dict)
+        blob = np.frombuffer(pickle.dumps(meta), dtype=np.uint8)
+        for dst in dst_ranks:
+            self._send_preamble(dst, step, blob, timeout)
+        # Each shard is pulled device->host ONCE and sent to every dst
+        # before its host copy is released (a multi-dst heal must not
+        # re-pull the whole state per destination).
+        with ThreadPoolExecutor(max_workers=1) as prefetch:
+            pending = None
+            for i, thunk in enumerate(thunks):
+                buf = pending.result() if pending is not None else thunk()
+                if i + 1 < len(thunks):
+                    pending = prefetch.submit(thunks[i + 1])
+                else:
+                    pending = None
+                for dst in dst_ranks:
+                    self._pg.send(
+                        [buf], dst, tag=f"ckpt{step}.t{i}"
+                    ).wait(timeout)
+                del buf  # release the host copy before the next pull
 
     @timed("torchft::pg_transport::recv_checkpoint")
     def recv_checkpoint(
@@ -92,29 +133,41 @@ class PGTransport(CheckpointTransport):
 
         if self._sharded:
             from torchft_tpu.checkpointing.sharded import (
-                collect_sharded_refs,
-                join_state_sharded,
-                ref_buffer_meta,
+                _ShardedRef,
+                build_sharded_leaf,
+                collect_ref_target_pairs,
+                place_plain_leaf,
+                substitute_built_leaves,
             )
 
-            wire = [
-                bm
-                for ref in collect_sharded_refs(meta)
-                for bm in ref_buffer_meta(ref)
-            ]
-            buffers: List[Optional[np.ndarray]] = [None] * len(wire)
-            for idx, _dtype, _shape in wire:
-                (buf,) = self._pg.recv(
-                    src_rank, tag=f"ckpt{step}.t{idx}"
-                ).wait(timeout)
-                buffers[idx] = buf.reshape(-1)
+            # STREAMING receive: build each leaf the moment its shard
+            # buffers arrive and free the host copies, so peak host
+            # memory is O(one leaf), not the whole state — the receiving
+            # half of the bounded-memory heal (sender half:
+            # _send_sharded_streaming).
             target = self._state_dict_fn()
-            return join_state_sharded(
-                meta,
-                buffers,
-                target=target,
-                delete_target_leaves=self._delete_stale,
-            )
+            built: dict = {}
+            for ref, t_leaf in collect_ref_target_pairs(meta, target):
+                if isinstance(ref, _ShardedRef):
+                    bufs = []
+                    for k in range(len(ref.shapes)):
+                        (buf,) = self._pg.recv(
+                            src_rank, tag=f"ckpt{step}.t{ref.first + k}"
+                        ).wait(timeout)
+                        bufs.append(buf.reshape(-1))
+                    built[ref.first] = build_sharded_leaf(
+                        ref, bufs, t_leaf,
+                        delete_target_leaf=self._delete_stale,
+                    )
+                    del bufs  # host copies released leaf-by-leaf
+                else:
+                    (buf,) = self._pg.recv(
+                        src_rank, tag=f"ckpt{step}.t{ref.index}"
+                    ).wait(timeout)
+                    built[ref.index] = place_plain_leaf(
+                        ref, buf.reshape(-1), t_leaf
+                    )
+            return substitute_built_leaves(meta, built)
 
         from torchft_tpu.checkpointing._serialization import collect_refs
 

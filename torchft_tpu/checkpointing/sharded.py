@@ -77,18 +77,27 @@ def _index_key(index: Tuple) -> Tuple:
     return tuple(out)
 
 
-def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
+def split_state_sharded_lazy(
+    obj: Any,
+) -> Tuple[Any, List]:
     """Like ``_serialization.split_state`` but jax leaves contribute one
     buffer per UNIQUE addressable shard — no gather of the global array,
-    no duplicate bytes for replicated dims."""
-    buffers: List[np.ndarray] = []
+    no duplicate bytes for replicated dims.
+
+    Returns ``(meta, thunks)`` where each thunk materializes one wire
+    buffer when called.  Building the meta touches only shard METADATA
+    (shapes/indices); the device->host pulls happen thunk-by-thunk, so a
+    streaming sender holds O(one shard) on the host instead of the whole
+    state — the difference between healing a 32 GB state and OOMing the
+    sending host."""
+    thunks: List = []
 
     def walk(x: Any) -> Any:
         if _is_sharded_jax(x):
             shards = sorted(
                 x.addressable_shards, key=lambda s: s.device.id
             )
-            first = len(buffers)
+            first = len(thunks)
             shapes: List[Tuple[int, ...]] = []
             slot_map: List[int] = []
             keys: List[Tuple] = []
@@ -97,10 +106,11 @@ def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
                 key = _index_key(s.index)
                 if key not in uniq:
                     uniq[key] = len(shapes)
-                    data = np.asarray(s.data)
-                    shapes.append(tuple(data.shape))
+                    shapes.append(tuple(s.data.shape))  # metadata only
                     keys.append(key)
-                    buffers.append(np.ascontiguousarray(data))
+                    thunks.append(
+                        lambda s=s: np.ascontiguousarray(np.asarray(s.data))
+                    )
                 slot_map.append(uniq[key])
             return _ShardedRef(
                 first, shapes, slot_map, str(x.dtype), tuple(x.shape),
@@ -108,8 +118,8 @@ def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
             )
         if _is_array(x) and not np.isscalar(x):
             arr = np.asarray(x)
-            ref = _TensorRef(len(buffers), str(arr.dtype), tuple(arr.shape))
-            buffers.append(np.ascontiguousarray(arr))
+            ref = _TensorRef(len(thunks), str(arr.dtype), tuple(arr.shape))
+            thunks.append(lambda arr=arr: np.ascontiguousarray(arr))
             return ref
         if isinstance(x, dict):
             return {k: walk(v) for k, v in x.items()}
@@ -122,37 +132,141 @@ def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
             return [walk(v) for v in x]
         return x
 
-    return walk(obj), buffers
+    return walk(obj), thunks
 
 
-def collect_sharded_refs(meta: Any) -> List[Any]:
-    """All refs (_TensorRef and _ShardedRef) in buffer-index order; a
-    _ShardedRef occupies ``len(ref.shapes)`` consecutive indices."""
-    refs: List[Any] = []
-
-    def collect(x: Any) -> None:
-        if isinstance(x, (_TensorRef, _ShardedRef)):
-            refs.append(x)
-        elif isinstance(x, dict):
-            for v in x.values():
-                collect(v)
-        elif isinstance(x, (list, tuple)):
-            for v in x:
-                collect(v)
-
-    collect(meta)
-    refs.sort(key=lambda r: r.index if isinstance(r, _TensorRef) else r.first)
-    return refs
+def split_state_sharded(obj: Any) -> Tuple[Any, List[np.ndarray]]:
+    """Eager form of :func:`split_state_sharded_lazy` (all buffers
+    materialized) — for tests and small states."""
+    meta, thunks = split_state_sharded_lazy(obj)
+    return meta, [t() for t in thunks]
 
 
-def ref_buffer_meta(ref: Any) -> List[Tuple[int, str, Tuple[int, ...]]]:
-    """(buffer_index, dtype, shape) for each wire buffer a ref owns."""
-    if isinstance(ref, _TensorRef):
-        return [(ref.index, ref.dtype, ref.shape)]
-    return [
-        (ref.first + k, ref.dtype, shape)
-        for k, shape in enumerate(ref.shapes)
-    ]
+def build_sharded_leaf(
+    m: _ShardedRef,
+    bufs: List[np.ndarray],
+    target_leaf: Any,
+    delete_target_leaf: bool = False,
+) -> Any:
+    """Assembles ONE sharded leaf from its unique-shard host buffers onto
+    the sharding of ``target_leaf`` (see join_state_sharded for the
+    slice-key matching contract)."""
+    import jax
+
+    if target_leaf is None or not hasattr(target_leaf, "sharding"):
+        raise ValueError(
+            "sharded leaf needs a target jax array with the destination "
+            "sharding"
+        )
+    sharding = target_leaf.sharding
+    if tuple(target_leaf.shape) != tuple(m.global_shape):
+        raise ValueError(
+            f"target shape {tuple(target_leaf.shape)} != checkpoint "
+            f"shape {tuple(m.global_shape)}"
+        )
+    devs = sorted(sharding.addressable_devices, key=lambda d: d.id)
+    if len(devs) != len(m.slot_map):
+        raise ValueError(
+            f"target has {len(devs)} addressable devices, checkpoint "
+            f"leaf has {len(m.slot_map)} slots"
+        )
+    dtype = np.dtype(m.dtype)
+    key_to_buf = {tuple(k): i for i, k in enumerate(m.keys)}
+    idx_map = sharding.addressable_devices_indices_map(
+        tuple(m.global_shape)
+    )
+    singles = []
+    for dev in devs:
+        key = _index_key(idx_map[dev])
+        if key not in key_to_buf:
+            raise ValueError(
+                f"target sharding needs slice {key} which the checkpoint "
+                "does not contain (sender/receiver shardings differ)"
+            )
+        k = key_to_buf[key]
+        buf = bufs[k]
+        assert buf is not None, f"missing shard buffer {k}"
+        host = buf.reshape(m.shapes[k]).astype(dtype, copy=False)
+        singles.append(jax.device_put(host, dev))
+    arr = jax.make_array_from_single_device_arrays(
+        tuple(m.global_shape), sharding, singles
+    )
+    if delete_target_leaf:
+        target_leaf.delete()
+    return arr
+
+
+def place_plain_leaf(
+    m: _TensorRef, flat_buf: np.ndarray, target_leaf: Any
+) -> np.ndarray:
+    """Rebuilds one host (numpy) leaf, writing in place into a writable
+    same-shape ``target_leaf`` when possible (the ``join_state`` in-place
+    contract) — shared by the batch join and the streaming receiver."""
+    arr = flat_buf.reshape(m.shape)
+    if (
+        target_leaf is not None
+        and isinstance(target_leaf, np.ndarray)
+        and target_leaf.shape == arr.shape
+        and target_leaf.flags.writeable
+    ):
+        np.copyto(target_leaf, arr.astype(target_leaf.dtype, copy=False))
+        return target_leaf
+    return arr
+
+
+def collect_ref_target_pairs(
+    meta: Any, target: Optional[Any]
+) -> List[Tuple[Any, Any]]:
+    """(ref, structurally-corresponding target leaf) for every array ref,
+    in buffer-index order — the walk a STREAMING receiver needs to build
+    each leaf the moment its shards arrive."""
+    pairs: List[Tuple[Any, Any]] = []
+
+    def walk(m: Any, t: Any) -> None:
+        if isinstance(m, (_TensorRef, _ShardedRef)):
+            pairs.append((m, t))
+        elif isinstance(m, dict):
+            for k, v in m.items():
+                walk(v, t.get(k) if isinstance(t, dict) else None)
+        elif isinstance(m, (list, tuple)):
+            tt = (
+                t
+                if isinstance(t, (list, tuple)) and len(t) == len(m)
+                else [None] * len(m)
+            )
+            for v, tv in zip(m, tt):
+                walk(v, tv)
+
+    walk(meta, target)
+    pairs.sort(
+        key=lambda p: (
+            p[0].index if isinstance(p[0], _TensorRef) else p[0].first
+        )
+    )
+    return pairs
+
+
+def substitute_built_leaves(meta: Any, built: dict) -> Any:
+    """Rebuilds the pytree from meta with already-built leaves, keyed by
+    each ref's first buffer index."""
+
+    def walk(m: Any) -> Any:
+        if isinstance(m, _TensorRef):
+            return built[m.index]
+        if isinstance(m, _ShardedRef):
+            return built[m.first]
+        if isinstance(m, dict):
+            return {k: walk(v) for k, v in m.items()}
+        if isinstance(m, tuple):
+            mapped = [walk(v) for v in m]
+            if hasattr(m, "_fields"):
+                return type(m)(*mapped)
+            return tuple(mapped)
+        if isinstance(m, list):
+            return [walk(v) for v in m]
+        return m
+
+    return walk(meta)
 
 
 def join_state_sharded(
@@ -173,68 +287,18 @@ def join_state_sharded(
     Plain (host) leaves follow the ``join_state`` in-place contract:
     written into ``target``'s buffer when writable, else fresh.
     """
-    import jax
-
     def walk(m: Any, t: Any) -> Any:
         if isinstance(m, _ShardedRef):
-            if t is None or not hasattr(t, "sharding"):
-                raise ValueError(
-                    "sharded leaf needs a target jax array with the "
-                    "destination sharding"
-                )
-            sharding = t.sharding
-            if tuple(t.shape) != tuple(m.global_shape):
-                raise ValueError(
-                    f"target shape {tuple(t.shape)} != checkpoint "
-                    f"shape {tuple(m.global_shape)}"
-                )
-            devs = sorted(
-                sharding.addressable_devices, key=lambda d: d.id
+            bufs = [
+                buffers[m.first + k] for k in range(len(m.shapes))
+            ]
+            return build_sharded_leaf(
+                m, bufs, t, delete_target_leaf=delete_target_leaves
             )
-            if len(devs) != len(m.slot_map):
-                raise ValueError(
-                    f"target has {len(devs)} addressable devices, "
-                    f"checkpoint leaf has {len(m.slot_map)} slots"
-                )
-            dtype = np.dtype(m.dtype)
-            # Match each device to its buffer by SLICE INDEX (from the
-            # receiver's own sharding), not device enumeration order —
-            # robust to sender/receiver id-order skew.
-            key_to_buf = {
-                tuple(k): i for i, k in enumerate(m.keys)
-            }
-            idx_map = sharding.addressable_devices_indices_map(
-                tuple(m.global_shape)
-            )
-            singles = []
-            for slot, dev in enumerate(devs):
-                key = _index_key(idx_map[dev])
-                if key not in key_to_buf:
-                    raise ValueError(
-                        f"target sharding needs slice {key} which the "
-                        "checkpoint does not contain (sender/receiver "
-                        "shardings differ)"
-                    )
-                k = key_to_buf[key]
-                buf = buffers[m.first + k]
-                assert buf is not None, f"missing buffer {m.first + k}"
-                host = buf.reshape(m.shapes[k]).astype(dtype, copy=False)
-                singles.append(jax.device_put(host, dev))
-            arr = jax.make_array_from_single_device_arrays(
-                tuple(m.global_shape), sharding, singles
-            )
-            if delete_target_leaves:
-                t.delete()  # free the stale leaf's HBM before the next
-            return arr
         if isinstance(m, _TensorRef):
             buf = buffers[m.index]
             assert buf is not None, f"missing buffer {m.index}"
-            arr = buf.reshape(m.shape)
-            if t is not None and isinstance(t, np.ndarray):
-                if t.shape == arr.shape and t.flags.writeable:
-                    np.copyto(t, arr.astype(t.dtype, copy=False))
-                    return t
-            return arr
+            return place_plain_leaf(m, buf.reshape(-1), t)
         if isinstance(m, dict):
             return {
                 k: walk(v, t.get(k) if isinstance(t, dict) else None)
