@@ -264,6 +264,28 @@ def test_pg_transport_sharded_inplace_device_receive():
     store.shutdown()
 
 
+@pytest.mark.parametrize("num_chunks", [0, 3])
+def test_http_recv_buffers_are_writable(num_chunks):
+    """Healed arrays are mutated in place by training (params -= lr*g),
+    so the streamed receive must hand back WRITABLE arrays — frombuffer
+    over immutable bytes broke the wedged-collective recovery once."""
+    sender = HTTPTransport(num_chunks=num_chunks)
+    try:
+        state = sample_state()
+        sender.send_checkpoint([1], step=9, state_dict=state, timeout=10)
+        receiver = HTTPTransport()
+        try:
+            got = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=9, timeout=10
+            )
+            got["model"]["w1"] -= 1.0  # must not raise read-only
+            assert got["model"]["w1"].flags.writeable
+        finally:
+            receiver.shutdown()
+    finally:
+        sender.shutdown()
+
+
 def test_pg_transport_sharded_multi_dst():
     """A heal with TWO recovering replicas: each shard is pulled once and
     sent to both destinations; both receivers rebuild bitwise-equal
@@ -298,6 +320,37 @@ def test_pg_transport_sharded_multi_dst():
         np.testing.assert_array_equal(np.asarray(g["w"]), np.asarray(src["w"]))
         assert g["step"] == 11
     for pg in pgs:
+        pg.shutdown()
+    store.shutdown()
+
+
+def test_pg_transport_sharded_dead_dst_fails_fast():
+    """A dead recovering replica latches the socket PG group-wide (every
+    conn fails, by FT design) — the sharded send must surface that as an
+    exception promptly so the manager latches it, fails the commit, and
+    the next quorum reconfigures + re-heals (NOT hang per-shard)."""
+    import time as _time
+
+    store = TCPStoreServer()
+    pgs = [ProcessGroupSocket(timeout=3.0) for _ in range(3)]
+
+    def configure(rank):
+        pgs[rank].configure(f"{store.address()}/deaddst", rank, 3)
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        list(pool.map(configure, range(3)))
+
+    pgs[2].shutdown()  # dst 2 dies before the heal
+    _time.sleep(0.5)  # let rank 0's reader observe the EOF
+
+    src = _sharded_state(fill=6.0)
+    sender = PGTransport(pgs[0], timeout=3.0, sharded=True)
+    t0 = _time.monotonic()
+    with pytest.raises(Exception):
+        sender.send_checkpoint([1, 2], 8, src, 10)
+    # Fail-fast: bounded by one wait, not one wait per shard buffer.
+    assert _time.monotonic() - t0 < 15
+    for pg in (pgs[0], pgs[1]):
         pg.shutdown()
     store.shutdown()
 

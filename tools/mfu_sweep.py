@@ -72,12 +72,12 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
         int(np.prod(p.shape))
         for p in jax.tree_util.tree_leaves(state.params)
     )
-    flops = 6.0 * n_params * B * S + (
-        6.0 * cfg.num_layers * B * S * S * cfg.num_heads * cfg.head_dim
-    )
     kind = jax.devices()[0].device_kind
-    from bench import _peak_tflops  # repo-root bench.py helper
+    # Same estimates as the headline bench, or sweep-MFU and bench-MFU
+    # stop being comparable.
+    from bench import _flops_per_step, _peak_tflops
 
+    flops = _flops_per_step(n_params, cfg, B, S)
     peak = _peak_tflops(kind)
     mfu = (flops / dt / 1e12) / peak if peak else None
     del state, batch  # free HBM before the next config

@@ -22,8 +22,43 @@ from typing import Any, List, Optional
 
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.telemetry import timed, timeit
-from torchft_tpu.checkpointing._serialization import join_state, split_state
+from torchft_tpu.checkpointing._serialization import (
+    _LEN,
+    _read_exact,
+    collect_refs,
+    join_state,
+    split_state,
+)
 from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+
+def _array_leaf_ids(obj: Any) -> set:
+    """ids of every numpy array leaf in the caller's LIVE state dict —
+    the set a staged buffer must not alias while peers fetch."""
+    out: set = set()
+
+    def walk(x: Any) -> None:
+        if isinstance(x, np.ndarray):
+            out.add(id(x))
+        elif isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+
+    walk(obj)
+    return out
+
+
+def _raw_view(arr: Any) -> memoryview:
+    """Byte view of a staged buffer; ml_dtypes (bfloat16/fp8) sit outside
+    the buffer protocol and go through a uint8 reinterpret."""
+    a = np.ascontiguousarray(arr)
+    try:
+        return memoryview(a).cast("B")
+    except ValueError:
+        return memoryview(a.view(np.uint8)).cast("B")
 
 
 class _State:
@@ -66,8 +101,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if what == "metadata":
                 body = pickle.dumps({"num_chunks": state.num_chunks})
+                self._respond_small(body)
             elif what == "full":
-                body = dumps_parts(state.meta, state.buffers)
+                # STREAMED: header pickle + each raw buffer written
+                # straight to the socket as length-prefixed records — the
+                # server never builds a payload-sized pickle blob (a 12 GB
+                # state would otherwise spike to 2x its size per request).
+                assigned = list(range(len(state.buffers)))
+                self._respond_stream(state.meta, assigned, state.buffers)
             elif what.startswith("chunk_"):
                 idx = int(what[len("chunk_"):])
                 if state.num_chunks == 0 or idx >= state.num_chunks:
@@ -76,28 +117,48 @@ class _Handler(BaseHTTPRequestHandler):
                 # Round-robin buffer split (reference: values[i::num_chunks],
                 # http_transport.py:288-299); chunk 0 carries the meta skeleton.
                 assigned = list(range(idx, len(state.buffers), state.num_chunks))
-                payload = {
-                    "meta": state.meta if idx == 0 else None,
-                    "parts": {i: state.buffers[i] for i in assigned},
-                }
-                body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+                self._respond_stream(
+                    state.meta if idx == 0 else None,
+                    assigned,
+                    state.buffers,
+                )
             else:
                 self.send_error(404, "unknown resource")
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/octet-stream")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
         except BrokenPipeError:
             pass
         finally:
             state.lock.release_read()
 
+    def _respond_small(self, body: bytes) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
-def dumps_parts(meta: Any, buffers: List[Any]) -> bytes:
-    return pickle.dumps({"meta": meta, "buffers": buffers},
-                        protocol=pickle.HIGHEST_PROTOCOL)
+    def _respond_stream(
+        self, meta: Any, assigned: List[int], buffers: List[Any]
+    ) -> None:
+        """Length-prefixed record stream: pickle({"meta", "indices"}),
+        then each assigned buffer's raw bytes.  The exact Content-Length
+        is computable without materializing anything payload-sized, so
+        peak server memory per request is one small header."""
+        header = pickle.dumps(
+            {"meta": meta, "indices": assigned},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        views = [_raw_view(buffers[i]) for i in assigned]
+        total = 8 + len(header) + sum(8 + v.nbytes for v in views)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(total))
+        self.end_headers()
+        self.wfile.write(_LEN.pack(len(header)))
+        self.wfile.write(header)
+        for v in views:
+            self.wfile.write(_LEN.pack(v.nbytes))
+            self.wfile.write(v)
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -134,8 +195,21 @@ class HTTPTransport(CheckpointTransport):
         # same arrays while peers are still fetching.
         # Wall-time logged like the reference's _timeit (http_transport.py:31-36).
         with timeit("torchft::http_transport::stage_checkpoint"):
+            live_ids = _array_leaf_ids(state_dict)
             meta, buffers = split_state(state_dict)
-            buffers = [np.array(b, copy=True) for b in buffers]
+            # Copy ONLY buffers that may alias memory the trainer can
+            # mutate or free: the caller's live numpy leaves
+            # (split_state's ascontiguousarray returns contiguous numpy
+            # inputs as-is) and any non-owning view (np.asarray of a CPU
+            # jax array can be zero-copy over a donatable device buffer).
+            # A TPU train state's buffers are real host pulls (owndata),
+            # so it stages with zero extra payload-sized copies.
+            buffers = [
+                np.array(b, copy=True)
+                if (id(b) in live_ids or not b.flags.owndata)
+                else b
+                for b in buffers
+            ]
         with self._state.lock.w_lock(timeout):
             self._state.meta = meta
             self._state.buffers = buffers
@@ -157,27 +231,67 @@ class HTTPTransport(CheckpointTransport):
         )
         num_chunks = info["num_chunks"]
         if num_chunks <= 1:
-            payload = pickle.loads(
-                self._fetch(f"{base}/checkpoint/{step}/full", timeout)
+            meta, parts = self._fetch_records(
+                f"{base}/checkpoint/{step}/full", timeout
             )
-            return join_state(payload["meta"], payload["buffers"])
-        # Parallel chunk fetch (reference: http_transport.py:244-267).
-        with ThreadPoolExecutor(max_workers=num_chunks) as pool:
-            chunks = list(
-                pool.map(
-                    lambda i: pickle.loads(
-                        self._fetch(f"{base}/checkpoint/{step}/chunk_{i}", timeout)
-                    ),
-                    range(num_chunks),
+        else:
+            # Parallel chunk fetch (reference: http_transport.py:244-267).
+            with ThreadPoolExecutor(max_workers=num_chunks) as pool:
+                chunks = list(
+                    pool.map(
+                        lambda i: self._fetch_records(
+                            f"{base}/checkpoint/{step}/chunk_{i}", timeout
+                        ),
+                        range(num_chunks),
+                    )
                 )
+            meta = next(m for m, _ in chunks if m is not None)
+            parts = {}
+            for _, p in chunks:
+                parts.update(p)
+        # Raw record bytes -> typed flat arrays via the meta's refs
+        # (frombuffer: no second copy).
+        refs = collect_refs(meta)
+        buffers: List[Optional[Any]] = [None] * len(refs)
+        for ref in refs:
+            raw = parts.pop(ref.index)
+            buffers[ref.index] = np.frombuffer(
+                raw, dtype=np.dtype(ref.dtype)
             )
-        meta = next(c["meta"] for c in chunks if c["meta"] is not None)
-        total = sum(len(c["parts"]) for c in chunks)
-        buffers: List[Optional[Any]] = [None] * total
-        for c in chunks:
-            for i, buf in c["parts"].items():
-                buffers[i] = buf
         return join_state(meta, buffers)
+
+    @staticmethod
+    def _fetch_records(url: str, timeout: float):
+        """Fetches one streamed response: pickle({"meta","indices"})
+        header, then each buffer's raw bytes, read record-by-record off
+        the socket (no payload-sized intermediate).  Same bounded 404
+        retry as _fetch (sender staging can race the receiver's plan)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    hlen = _LEN.unpack(_read_exact(resp, 8))[0]
+                    header = pickle.loads(_read_exact(resp, hlen))
+                    parts = {}
+                    for idx in header["indices"]:
+                        blen = _LEN.unpack(_read_exact(resp, 8))[0]
+                        # Into a WRITABLE bytearray: healed arrays get
+                        # mutated in place by training (frombuffer over
+                        # bytes would be read-only).
+                        buf = bytearray(blen)
+                        view = memoryview(buf)
+                        got = 0
+                        while got < blen:
+                            n = resp.readinto(view[got:])
+                            if not n:
+                                raise EOFError("stream ended mid-record")
+                            got += n
+                        parts[idx] = buf
+                    return header["meta"], parts
+            except urllib.error.HTTPError as e:
+                if e.code != 404 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     @staticmethod
     def _fetch(url: str, timeout: float) -> bytes:

@@ -101,7 +101,12 @@ class PGTransport(CheckpointTransport):
             self._send_preamble(dst, step, blob, timeout)
         # Each shard is pulled device->host ONCE and sent to every dst
         # before its host copy is released (a multi-dst heal must not
-        # re-pull the whole state per destination).
+        # re-pull the whole state per destination).  No per-dst failure
+        # isolation on purpose: a dead member latches the socket PG
+        # group-wide (every conn/send fails, not just the dead dst's), so
+        # the correct recovery is the manager's — raise, latch the error,
+        # fail the commit, and let the next quorum reconfigure without
+        # the dead replica and re-run the heal.
         with ThreadPoolExecutor(max_workers=1) as prefetch:
             pending = None
             for i, thunk in enumerate(thunks):
