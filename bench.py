@@ -362,6 +362,7 @@ def _bench() -> dict:
         "sync_every": sync_every,
         "attn_impl": cfg.attn_impl,
         "long_context": long_ctx,
+        "heal_bench": _bench_heal(),
     }
     result.update(ft)
 
@@ -415,6 +416,50 @@ def _bench() -> dict:
             }
         )
     return result
+
+
+def _bench_heal() -> "dict | None":
+    """Small sharded heal-bandwidth probe (two OS processes over the
+    socket PG, 0.25 GB, virtual 8-device mesh) so every recorded bench
+    carries a heal number alongside throughput.  Failure-tolerant and
+    time-bounded: the headline must never die on this extra.  Full-size
+    drills: HEAL_DRILL_r03.json / checkpointing/pg_transport_bench.py.
+    Disable with BENCH_HEAL=0."""
+    if os.environ.get("BENCH_HEAL", "1") == "0" or os.environ.get(
+        "BENCH_TINY"
+    ):
+        return None
+    proc = None
+    try:
+        # Own process group so an outer-timeout kill takes the harness's
+        # recv grandchild and store server down with it (a bare SIGKILL
+        # of the direct child would skip its cleanup and orphan both).
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "torchft_tpu.checkpointing.pg_transport_bench",
+                "--size-gb", "0.25", "--leaves", "16",
+                "--sharded", "--devices", "8", "--timeout", "90",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True,
+        )
+        out, err = proc.communicate(timeout=240)
+        if proc.returncode != 0:
+            return {"error": (err or "nonzero exit")[-200:]}
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 - optional metric only
+        if proc is not None and proc.poll() is None:
+            import signal as _signal
+
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except OSError:
+                pass
+        return {"error": str(e)[:200]}
 
 
 def _bench_ft(
