@@ -363,6 +363,7 @@ def _bench() -> dict:
         "attn_impl": cfg.attn_impl,
         "long_context": long_ctx,
         "heal_bench": _bench_heal(),
+        "quorum_bench": _bench_quorum(),
     }
     result.update(ft)
 
@@ -459,6 +460,72 @@ def _bench_heal() -> "dict | None":
                 os.killpg(proc.pid, _signal.SIGKILL)
             except OSError:
                 pass
+        return {"error": str(e)[:200]}
+
+
+def _bench_quorum() -> "dict | None":
+    """Control-plane latency probe: two replicas form quorums against a
+    local C++ lighthouse; reports p50/p95 wall-time per quorum RPC across
+    20 rounds.  The reference's CI asserts its RPC round-trips stay under
+    1s (manager_integ_test.py:539-551); this records the actual figure
+    every round.  Disable with BENCH_QUORUM=0."""
+    if os.environ.get("BENCH_QUORUM", "1") == "0" or os.environ.get(
+        "BENCH_TINY"
+    ):
+        return None
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from torchft_tpu.coordination import (
+            LighthouseClient,
+            LighthouseServer,
+        )
+
+        rounds = 20
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=10000,
+            quorum_tick_ms=20,
+        )
+        clients = []
+        try:
+            # Local-only probe: connect to the loopback bind directly
+            # (lh.address() advertises TORCHFT_HOST_ADDR when set, which
+            # a multi-host node's config would point away from loopback).
+            port = lh.address().rsplit(":", 1)[1]
+            clients = [
+                LighthouseClient(f"127.0.0.1:{port}") for _ in range(2)
+            ]
+            lat: list = []
+
+            def one(c, rid, step):
+                t0 = time.perf_counter()
+                c.quorum(rid, timeout=20.0, step=step)
+                return time.perf_counter() - t0
+
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                for step in range(rounds):
+                    fs = [
+                        pool.submit(one, clients[i], f"qb{i}", step)
+                        for i in range(2)
+                    ]
+                    lat.extend(f.result(timeout=30) for f in fs)
+            lat.sort()
+            return {
+                "what": "steady-state 2-replica quorum RPC (proactive "
+                        "tick fast path; reference CI bound: <1s)",
+                "rounds": rounds,
+                "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                "p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 2),
+                "max_ms": round(lat[-1] * 1e3, 2),
+            }
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+            lh.shutdown()
+    except Exception as e:  # noqa: BLE001 - optional metric only
         return {"error": str(e)[:200]}
 
 
