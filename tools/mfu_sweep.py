@@ -21,12 +21,12 @@ import time
 
 
 def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
-               steps: int, warmup: int) -> dict:
+               steps: int, warmup: int, preset: str = "small") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from torchft_tpu.models import llama_small
+    from torchft_tpu.models import llama_debug, llama_small
     from torchft_tpu.parallel import auto_mesh
     from torchft_tpu.parallel.train import (
         build_model,
@@ -34,7 +34,8 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
         make_train_step,
     )
 
-    cfg = llama_small(
+    base = llama_small if preset == "small" else llama_debug
+    cfg = base(
         remat=remat,
         attn_impl="flash",
         flash_min_seq=1024,
@@ -107,6 +108,9 @@ def main() -> int:
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--model", choices=["small", "debug"], default="small",
+                   help="debug = tiny config for CPU smoke of the sweep "
+                   "harness itself")
     args = p.parse_args()
 
     sys.path.insert(0, ".")
@@ -116,7 +120,7 @@ def main() -> int:
         try:
             r = run_config(
                 bq, bk, bool(rm), args.batch, args.seq,
-                args.steps, args.warmup,
+                args.steps, args.warmup, preset=args.model,
             )
         except Exception as e:  # noqa: BLE001 - keep sweeping
             r = {"block_q": bq, "block_k": bk, "remat": bool(rm),
