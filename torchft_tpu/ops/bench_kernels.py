@@ -137,11 +137,59 @@ def main() -> int:
         "dense_ms": round(_time_call(dense_fn, *qkv), 3),
     }
 
+    # ---- offset-block kernel (ring attention's per-step fold) ----------
+    # Full causal attention assembled from two streamed kv blocks via an
+    # online-softmax merge must match dense — the single-chip proxy for
+    # the ring step (mathematically equivalent to the fold in
+    # parallel/ring_attention.py, which uses a logaddexp formulation).
+    from torchft_tpu.ops.flash_attention import flash_attention_block
+
+    half = S // 2
+    q_, k_, v_ = qkv
+
+    def merge(o1, l1, o2, l2):
+        m = jnp.maximum(l1, l2)
+        w1 = jnp.exp(l1 - m)[..., None]
+        w2 = jnp.exp(l2 - m)[..., None]
+        o1 = jnp.swapaxes(o1, 1, 2).astype(jnp.float32)
+        o2 = jnp.swapaxes(o2, 1, 2).astype(jnp.float32)
+        out = (o1 * w1 + o2 * w2) / (w1 + w2)
+        return jnp.swapaxes(out, 1, 2)
+
+    o1, l1 = flash_attention_block(q_, k_[:, :half], v_[:, :half], 0, 0)
+    o2, l2 = flash_attention_block(q_, k_[:, half:], v_[:, half:], 0, half)
+    block_out = np.asarray(merge(o1, l1, o2, l2), dtype=np.float32)
+    # Two latency points: the diagonal block (causal-masked, the first
+    # ring step) and a fully-in-the-past block (no masking, the common
+    # case in an N-step ring) — the past block is the one to budget
+    # ring-step time from.
+    diag_fn = jax.jit(
+        lambda q, k, v: flash_attention_block(q, k, v, 0, 0)
+    )
+    past_fn = jax.jit(
+        lambda q, k, v: flash_attention_block(q, k, v, half, 0)
+    )
+    result["flash_block_merge"] = {
+        "kv_blocks": 2,
+        "rel_err_vs_dense": float(
+            np.abs(block_out - dense_out).max() / scale
+        ),
+        "block_diag_ms": round(
+            _time_call(diag_fn, q_[:, :half], k_[:, :half], v_[:, :half]),
+            3,
+        ),
+        "block_past_ms": round(
+            _time_call(past_fn, q_[:, half:], k_[:, :half], v_[:, :half]),
+            3,
+        ),
+    }
+
     ok = (
         result["quantize"]["parity_with_host_exact"]
         and result["quantize"]["roundtrip_max_abs_err_vs_host"] < 1e-6
         and result["fused_reduce"]["rel_err"] < 0.02
         and result["flash_attention"]["rel_err_vs_dense"] < 0.03
+        and result["flash_block_merge"]["rel_err_vs_dense"] < 0.03
     )
     result["ok"] = bool(ok)
     print(json.dumps(result), flush=True)
