@@ -9,7 +9,12 @@ outside the compiled program and is carried by the Manager over DCN
 a torch DeviceMesh; here the managed axis wraps the jax mesh instead).
 """
 
-from torchft_tpu.parallel.mesh import MESH_AXES, auto_mesh, make_mesh  # noqa: F401
+from torchft_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    auto_mesh,
+    make_mesh,
+    make_multislice_mesh,
+)
 from torchft_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_shardings,

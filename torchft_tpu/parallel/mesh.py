@@ -46,6 +46,71 @@ def make_mesh(
     return Mesh(arr, MESH_AXES)
 
 
+def make_multislice_mesh(
+    num_slices: int,
+    dp: int = 1,
+    fsdp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    ep: int = 1,
+    pp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh spanning ``num_slices`` TPU slices connected over DCN (the
+    multi-pod scaling shape): the slice dimension folds into the
+    OUTERMOST ``dp`` coordinate, so the only cross-slice collective XLA
+    emits is the dp gradient all-reduce (which it performs
+    hierarchically: reduce inside each slice over ICI, one exchange over
+    DCN, broadcast back) — model axes (fsdp/ep/sp/tp/pp) never leave a
+    slice's ICI domain.  ``dp`` is the per-slice data-parallel factor;
+    the resulting mesh has ``dp_total = num_slices * dp``.
+
+    On real multislice hardware devices are grouped by
+    ``device.slice_index``; on a single slice or a virtual CPU platform
+    (tests, dryrun) contiguous equal blocks stand in for slices.  No
+    sharding rule changes: everything keyed on "dp" transparently spans
+    the DCN axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    per = dp * pp * fsdp * ep * sp * tp
+    need = num_slices * per
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    # Group by slice BEFORE any truncation: real slices usually hold
+    # more devices than ``per``, and truncating first would collapse the
+    # visible slice set to one (jax.devices() orders by slice) — the
+    # "multislice" mesh would then silently live inside a single slice.
+    by_slice: dict = {}
+    if all(
+        getattr(d, "slice_index", None) is not None for d in devices
+    ) and len({d.slice_index for d in devices}) >= num_slices:
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [
+            sorted(v, key=lambda d: d.id)
+            for _, v in sorted(by_slice.items())
+        ][:num_slices]
+        short = [i for i, g in enumerate(groups) if len(g) < per]
+        if short:
+            raise ValueError(
+                f"slice(s) {short} have fewer than {per} devices"
+            )
+        groups = [g[:per] for g in groups]
+    else:
+        groups = [
+            devices[i * per:(i + 1) * per] for i in range(num_slices)
+        ]
+    arr = np.stack(
+        [
+            np.asarray(g[:per]).reshape(dp, pp, fsdp, ep, sp, tp)
+            for g in groups
+        ]
+    ).reshape(num_slices * dp, pp, fsdp, ep, sp, tp)
+    return Mesh(arr, MESH_AXES)
+
+
 def auto_mesh(
     n_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

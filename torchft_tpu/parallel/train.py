@@ -189,22 +189,79 @@ def make_train_step(
     shardings: TrainState,
     optimizer: Optional[optax.GradientTransformation] = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, Any]]:
     """batch = {"inputs": [B,S] i32, "targets": [B,S] i32, "mask": [B,S]}.
-    Returns jitted (state, batch) -> (state, metrics)."""
+    Returns jitted (state, batch) -> (state, metrics).
+
+    ``accum_steps > 1`` runs gradient accumulation: the global batch is
+    split into ``accum_steps`` microbatches along the batch dim and
+    swept with ``lax.scan`` (ONE compiled microstep body — compile time
+    and activation HBM stay those of a microbatch, which is how a large
+    global batch fits a chip), accumulating fp32 gradients and applying
+    the optimizer once.  Per-microbatch losses are normalized by their
+    own mask counts and averaged, so with equal token counts per
+    microbatch the result matches the unaccumulated step exactly (the
+    usual data-parallel convention).  Requires B % accum_steps == 0.
+    """
     optimizer = optimizer or _DEFAULT_OPT
     bsh = batch_sharding(mesh)
     batch_sh = {"inputs": bsh, "targets": bsh, "mask": bsh}
 
-    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Any]:
-        inputs = jax.lax.with_sharding_constraint(
-            batch["inputs"], bsh
-        )
+    def grads_and_loss(params, batch):
+        inputs = jax.lax.with_sharding_constraint(batch["inputs"], bsh)
         loss, grads = jax.value_and_grad(
             lambda p: _loss_fn(
                 model, p, inputs, batch["targets"], batch["mask"]
             )
-        )(state.params)
+        )(params)
+        return loss, grads
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Any]:
+        if accum_steps <= 1:
+            loss, grads = grads_and_loss(state.params, batch)
+        else:
+            B = batch["inputs"].shape[0]
+            if B % accum_steps != 0:
+                raise ValueError(
+                    f"batch size {B} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+            # INTERLEAVED split (microbatch k = rows k::accum_steps):
+            # under the contiguous (dp, fsdp) row sharding every shard
+            # contributes the same fraction of each microbatch and the
+            # rows land exactly where the microbatch sharding wants them
+            # — a contiguous block split would leave each microbatch on
+            # 1/accum_steps of the shards and force a cross-device
+            # redistribution every scan iteration.
+            micro = {
+                k: jnp.moveaxis(
+                    v.reshape(
+                        B // accum_steps, accum_steps, *v.shape[1:]
+                    ),
+                    1,
+                    0,
+                )
+                for k, v in batch.items()
+            }
+            g0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+
+            def body(carry, mb):
+                acc_g, acc_loss = carry
+                loss, grads = grads_and_loss(state.params, mb)
+                acc_g = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc_g, grads
+                )
+                return (acc_g, acc_loss + loss), None
+
+            (gsum, loss_sum), _ = jax.lax.scan(
+                body, (g0, jnp.zeros((), jnp.float32)), micro
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+            loss = loss_sum * inv
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
