@@ -354,6 +354,33 @@ def test_wrap_future_swallow_and_timeout():
         m.shutdown()
 
 
+def test_goodput_accounting():
+    """goodput() splits wall time between commit gates by outcome: a
+    latched error turns that window into failed_s, clean gates into
+    committed_s, and the fraction reflects the split."""
+    import time as _time
+
+    m = make_manager()
+    try:
+        m.start_quorum()
+        assert m.should_commit() is True  # first gate: unattributed
+        _time.sleep(0.05)
+        m.start_quorum()
+        assert m.should_commit() is True  # ~50ms committed
+        m.start_quorum()
+        m.report_error(RuntimeError("injected"))
+        _time.sleep(0.05)
+        assert m.should_commit() is False  # ~50ms failed
+        g = m.goodput()
+        assert g["committed_steps"] == 2
+        assert g["failed_commits"] == 1
+        assert g["committed_s"] > 0 and g["failed_s"] > 0
+        assert 0.0 < g["goodput_frac"] < 1.0
+        assert g["heal_count"] == 0
+    finally:
+        m.shutdown()
+
+
 def test_wrap_future_completes_even_if_report_error_raises():
     """If report_error (or the logger) raises on the callback thread, the
     wrapped future must still resolve to the default — otherwise the

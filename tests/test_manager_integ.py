@@ -396,7 +396,11 @@ def test_wedged_collective_aborted_and_recovered(lighthouse) -> None:
                 manager.start_quorum()
                 manager.allreduce(np.zeros(4, np.float32)).wait(timeout=15)
                 manager.should_commit()
-            return {"params": snapshot, "commits": commits}
+            return {
+                "params": snapshot,
+                "commits": commits,
+                "goodput": manager.goodput(),
+            }
         finally:
             done_flags[replica].set()
             manager.shutdown()
@@ -415,6 +419,15 @@ def test_wedged_collective_aborted_and_recovered(lighthouse) -> None:
     # bitwise equal and both loops reached n_steps (loop exit condition).
     assert any(c is False for c in results[0]["commits"]), results
     np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
+    # Goodput accounting saw the failure (failed_s > 0 on the replica
+    # whose commit round failed) and any heal time was booked separately.
+    g0 = results[0]["goodput"]
+    assert g0["failed_commits"] >= 1 and g0["failed_s"] > 0, g0
+    for r in (0, 1):
+        g = results[r]["goodput"]
+        if g["heal_count"]:
+            assert g["heal_s"] > 0, g
+        assert g["goodput_frac"] is None or 0 <= g["goodput_frac"] <= 1
 
 
 def test_upscale_while_running(lighthouse) -> None:

@@ -26,6 +26,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from contextlib import contextmanager
 from datetime import timedelta
@@ -155,6 +156,26 @@ class Manager:
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._quorum_id = -1
+
+        # Goodput accounting (no reference counterpart; the TPU-ecosystem
+        # analog is the goodput library's productive-vs-lost split):
+        # wall time between consecutive commit gates, bucketed by outcome,
+        # plus heal transfer time.  Updated under _goodput_lock (the heal
+        # timer runs on the quorum thread).
+        self._goodput_lock = threading.Lock()
+        self._goodput = {
+            "committed_steps": 0,
+            "failed_commits": 0,
+            "committed_s": 0.0,
+            "failed_s": 0.0,
+            "heal_count": 0,
+            "heal_s": 0.0,
+        }
+        self._last_gate_t: Optional[float] = None
+        # Heal seconds inside the CURRENT inter-gate window: subtracted
+        # from the window before bucketing so heal time isn't counted as
+        # productive (or doubly as lost) time.
+        self._heal_since_gate = 0.0
 
         # Rendezvous store (replica-group local; reference uses torchrun's
         # TCPStore, manager.py:271-276).
@@ -472,13 +493,17 @@ class Manager:
                     )
                     with timeit(
                         "torchft::manager::recv_checkpoint", self._logger
-                    ):
+                    ) as t_heal:
                         state = self._checkpoint_transport.recv_checkpoint(
                             src_rank=(result.recover_src_replica_rank or 0),
                             metadata=metadata,
                             step=result.max_step,
                             timeout=self._timeout,
                         )
+                    with self._goodput_lock:
+                        self._goodput["heal_count"] += 1
+                        self._goodput["heal_s"] += t_heal["elapsed_s"]
+                        self._heal_since_gate += t_heal["elapsed_s"]
                     # torchft state applies immediately; user state is
                     # deferred to the main thread (manager.py:716-720).
                     self.load_state_dict(state["torchft"])
@@ -674,6 +699,27 @@ class Manager:
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"disallow_checkpoint failed: {e}")
 
+        # Goodput bookkeeping BEFORE the max-retries raise: the terminal
+        # failure window is exactly the one a post-mortem wants counted.
+        # Heal time inside the window is excluded from the outcome bucket
+        # (it is accounted separately as heal_s).
+        now = time.monotonic()
+        with self._goodput_lock:
+            if self._last_gate_t is not None:
+                dt = max(
+                    now - self._last_gate_t - self._heal_since_gate, 0.0
+                )
+                if answer:
+                    self._goodput["committed_s"] += dt
+                else:
+                    self._goodput["failed_s"] += dt
+            self._last_gate_t = now
+            self._heal_since_gate = 0.0
+            if answer:
+                self._goodput["committed_steps"] += 1
+            else:
+                self._goodput["failed_commits"] += 1
+
         if answer:
             self._step += 1
             self._batches_committed += self.num_participants()
@@ -693,6 +739,19 @@ class Manager:
                 )
         self._logger.info(f"should_commit={answer} (local_ok={local_ok})")
         return answer
+
+    def goodput(self) -> Dict[str, Any]:
+        """Productive-vs-lost wall-time split since startup: time between
+        commit gates bucketed by outcome, plus heal transfer time.
+        ``goodput_frac`` = committed / (committed + failed + heal); the
+        window before the first gate is unattributed."""
+        with self._goodput_lock:
+            out = dict(self._goodput)
+        denom = out["committed_s"] + out["failed_s"] + out["heal_s"]
+        out["goodput_frac"] = (
+            round(out["committed_s"] / denom, 4) if denom > 0 else None
+        )
+        return out
 
     # ------------------------------------------------------------------
     # Introspection (reference: manager.py:896-946)
@@ -723,6 +782,12 @@ class Manager:
     # ------------------------------------------------------------------
 
     def shutdown(self) -> None:
+        try:
+            g = self.goodput()
+            if g["committed_steps"] or g["failed_commits"]:
+                self._logger.info(f"goodput: {g}")
+        except Exception:  # noqa: BLE001 - shutdown must not fail on a log
+            pass
         self._executor.shutdown(wait=False, cancel_futures=True)
         self._checkpoint_transport.shutdown()
         self._client.close()

@@ -160,17 +160,23 @@ def timed(name: str) -> Callable:
 
 
 @contextlib.contextmanager
-def timeit(name: str, logger: Optional[Any] = None) -> Iterator[None]:
+def timeit(name: str, logger: Optional[Any] = None) -> Iterator[dict]:
     """Logs the wall-time of a block (checkpoint transfers, heals).
     ``logger`` needs an ``info(msg)`` method; defaults to module logging.
-    Exceptions from the block propagate (and are still timed)."""
+    Exceptions from the block propagate (and are still timed).
+
+    Yields a dict whose ``elapsed_s`` is filled when the block exits, so
+    a caller needing the duration shares THIS clock instead of running a
+    second one alongside."""
     t0 = time.monotonic()
+    holder: dict = {"elapsed_s": None}
     try:
-        yield
+        yield holder
     finally:
         # No return/break in this finally: it would swallow in-flight
         # exceptions (PEP 601) — a failed heal must stay failed.
         dt = time.monotonic() - t0
+        holder["elapsed_s"] = dt
         _SPAN_STATS.add(name, dt)
         msg = f"{name} took {dt:.3f}s"
         logged = False
