@@ -272,10 +272,10 @@ def _bench() -> dict:
     _materialize(metrics["loss"])
     raw_dt = (time.perf_counter() - t0) / n_steps
 
-    tokens_per_sec = B * S / raw_dt
+    # tokens/sec + MFU are derived AFTER the post-FT raw re-measure below
+    # picks the final window.
     flops = _flops_per_step(n_params, cfg, B, S)
     peak = _peak_tflops(device_kind)
-    mfu = (flops / raw_dt / 1e12) / (peak * n_dev) if peak else None
 
     # Long-context capability point (flash attention; the dense path OOMs
     # at S=8192 on this chip): one extra timed config, small and untimed
@@ -349,6 +349,36 @@ def _bench() -> dict:
         diloco_syncs=diloco_syncs,
         timeout=timeout,
     )
+
+    # Re-measure the raw step AFTER the FT loops and keep the faster of
+    # the two: the ratio compares loops run minutes apart, and a
+    # transient stall during the first raw window otherwise inflates the
+    # headline past 1.0 (observed on the shared 1-core box).  min() of
+    # two windows on either side of the FT phase is drift-resistant and
+    # never flatters the framework.  Skipped when the FT phase produced
+    # no ratio to protect.
+    if ft.get("diloco_ft_ms_per_step") is not None:
+        try:
+            state2, _ = init_train_state(
+                model, mesh, jax.random.PRNGKey(2), (B, S)
+            )
+            for _ in range(n_warmup):
+                state2, m2 = step(state2, batch)
+            _materialize(m2["loss"])
+            n2 = max(n_steps // 2, 3)
+            t0 = time.perf_counter()
+            for _ in range(n2):
+                state2, m2 = step(state2, batch)
+            _materialize(m2["loss"])
+            raw_dt2 = (time.perf_counter() - t0) / n2
+            raw_dt = min(raw_dt, raw_dt2)
+            del state2, m2
+        except Exception as e:  # noqa: BLE001 - keep the first measurement
+            print(f"raw re-measure skipped ({e})", file=sys.stderr)
+    # Derived throughput figures come from the SELECTED window (single
+    # source for the formulas).
+    tokens_per_sec = B * S / raw_dt
+    mfu = (flops / raw_dt / 1e12) / (peak * n_dev) if peak else None
 
     result = {
         "raw_ms_per_step": round(raw_dt * 1e3, 2),
