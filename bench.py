@@ -48,6 +48,20 @@ def _materialize(x) -> float:
     return float(np.asarray(x.reshape(-1)[0]))
 
 
+def _timed_window(step, state, batch, n_warmup: int, n_steps: int):
+    """Shared timing discipline for every raw-step window: warm (compile
+    + steady-state), materialize, time n async-chained steps, materialize.
+    Returns (seconds_per_step, final_state)."""
+    for _ in range(n_warmup):
+        state, metrics = step(state, batch)
+    _materialize(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    _materialize(metrics["loss"])
+    return (time.perf_counter() - t0) / n_steps, state
+
+
 _PEAK_BF16_TFLOPS = [
     ("v6", 918.0),  # Trillium
     ("v5p", 459.0),
@@ -263,14 +277,7 @@ def _bench() -> dict:
     payload_mb = n_params * 4 / 1e6
 
     # ---- loop 1: raw (async-chained, one forced sync) --------------------
-    for _ in range(n_warmup):
-        state, metrics = step(state, batch)
-    _materialize(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch)
-    _materialize(metrics["loss"])
-    raw_dt = (time.perf_counter() - t0) / n_steps
+    raw_dt, state = _timed_window(step, state, batch, n_warmup, n_steps)
 
     # tokens/sec + MFU are derived AFTER the post-FT raw re-measure below
     # picks the final window.
@@ -288,7 +295,7 @@ def _bench() -> dict:
         # the Pallas interpreter, where 8K-seq steps take hours.
         and jax.default_backend() == "tpu"
     ):
-        lstate = lm = None
+        lstate = None
         try:
             lb, ls = 2, 8192
             lcfg = llama_small(
@@ -310,14 +317,7 @@ def _bench() -> dict:
                 ),
                 "mask": jnp.ones((lb, ls), jnp.int32),
             }
-            for _ in range(2):
-                lstate, lm = lstep(lstate, lbatch)
-            _materialize(lm["loss"])
-            lt0 = time.perf_counter()
-            for _ in range(5):
-                lstate, lm = lstep(lstate, lbatch)
-            _materialize(lm["loss"])
-            ldt = (time.perf_counter() - lt0) / 5
+            ldt, lstate = _timed_window(lstep, lstate, lbatch, 2, 5)
             long_ctx = {
                 "seq_len": ls,
                 "batch": lb,
@@ -329,11 +329,11 @@ def _bench() -> dict:
         finally:
             # Release the probe's HBM even on failure, or the FT loops
             # below inherit a pinned 8K-seq TrainState.
-            del lstate, lm
+            del lstate
 
     # ---- FT loops (2-process replica pair) -------------------------------
     state_box = [state]
-    del state, metrics  # _bench_ft owns the only TrainState reference now
+    del state  # _bench_ft owns the only TrainState reference now
     ft = _bench_ft(
         model=model,
         mesh=mesh,
@@ -362,17 +362,11 @@ def _bench() -> dict:
             state2, _ = init_train_state(
                 model, mesh, jax.random.PRNGKey(2), (B, S)
             )
-            for _ in range(n_warmup):
-                state2, m2 = step(state2, batch)
-            _materialize(m2["loss"])
-            n2 = max(n_steps // 2, 3)
-            t0 = time.perf_counter()
-            for _ in range(n2):
-                state2, m2 = step(state2, batch)
-            _materialize(m2["loss"])
-            raw_dt2 = (time.perf_counter() - t0) / n2
+            raw_dt2, state2 = _timed_window(
+                step, state2, batch, n_warmup, max(n_steps // 2, 3)
+            )
             raw_dt = min(raw_dt, raw_dt2)
-            del state2, m2
+            del state2
         except Exception as e:  # noqa: BLE001 - keep the first measurement
             print(f"raw re-measure skipped ({e})", file=sys.stderr)
     # Derived throughput figures come from the SELECTED window (single

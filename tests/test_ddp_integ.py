@@ -1,0 +1,78 @@
+"""BASELINE config #3 end-to-end, at CPU scale: fault-tolerant DDP on
+the ResNet family (synthetic data, resnet-tiny standing in for the
+v5e-8 resnet50), two replica-group OS processes under the keep-alive
+runner; one group is SIGKILLed mid-run, relaunches, heals params +
+optimizer + BatchNorm stats from the survivor, and both finish with
+bitwise-identical parameters."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.orchestration import ReplicaGroupRunner, render_topology
+
+pytestmark = pytest.mark.slow
+
+
+def test_resnet_ddp_kill_heal_bitwise_equal(tmp_path):
+    # Enough steps that the kill always lands mid-run (the poll below
+    # samples every 0.5s; with too few steps a fast box could finish
+    # before the kill fires and the test would fail spuriously).
+    steps = 30
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=30000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=5000,
+    )
+    result_dir = str(tmp_path / "results")
+    runner = None
+    try:
+        specs = render_topology(
+            [
+                sys.executable, "train_ddp.py",
+                "--model", "resnet-tiny",
+                "--steps", str(steps),
+                "--batch-size", "16",
+                "--min-replicas", "2",
+                "--result-dir", result_dir,
+            ],
+            num_replica_groups=2,
+            lighthouse_addr=lighthouse.address(),
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        runner = ReplicaGroupRunner(
+            specs, max_restarts=3, log_dir=str(tmp_path / "logs")
+        )
+        runner.start()
+        # Kill group 1 once it has committed a couple of steps.
+        deadline = time.monotonic() + 240
+        killed = False
+        while time.monotonic() < deadline and not killed:
+            time.sleep(0.5)
+            for log in (tmp_path / "logs").glob("replica1_rank0.r0.log"):
+                if "step=2" in log.read_text():
+                    assert runner.kill_group(1), "kill failed"
+                    killed = True
+                    break
+        assert killed, "group 1 never reached step 2 within the deadline"
+        ok = runner.run_until_done(timeout=600)
+        assert ok, f"runner did not finish cleanly (restarts={runner.restarts})"
+        assert runner.restarts[1] >= 1, "killed group was never relaunched"
+    finally:
+        if runner is not None:
+            runner.stop()
+        lighthouse.shutdown()
+
+    results = {}
+    for g in range(2):
+        with open(os.path.join(result_dir, f"group{g}.json")) as f:
+            results[g] = json.load(f)
+    assert results[0]["final_step"] == steps
+    assert results[1]["final_step"] == steps
+    assert results[0]["param_sha256"] == results[1]["param_sha256"], results

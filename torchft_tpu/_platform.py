@@ -1,0 +1,21 @@
+"""Backend-platform pinning shared by the train-script entry points.
+
+The container may pre-pin an accelerator platform via ``jax.config`` at
+interpreter startup (sitecustomize), where the ``JAX_PLATFORMS`` env var
+alone is silently ignored — every trainer must re-pin through
+``jax.config`` BEFORE any backend initializes.  One helper so the next
+platform quirk is fixed in one place, not per-script."""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_pin_cpu() -> None:
+    """Honors ``JAX_PLATFORMS=cpu`` even when an accelerator platform was
+    pre-pinned via jax.config.  Safe to call any time before first device
+    use (backends initialize lazily)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

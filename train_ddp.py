@@ -24,6 +24,11 @@ import os
 import sys
 
 import jax
+
+from torchft_tpu._platform import maybe_pin_cpu
+
+maybe_pin_cpu()  # before any backend initializes
+
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -81,6 +86,11 @@ def main() -> int:
         help="synthetic image side; BASELINE #3 at full scale uses 224",
     )
     parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument(
+        "--result-dir", type=str, default=None,
+        help="write group{N}.json with final step + param sha256 (the "
+        "kill/heal bitwise-equality check, BASELINE #3)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -159,8 +169,18 @@ def main() -> int:
         )
 
     # Different replica groups draw different data shards (reference:
-    # DistributedSampler semantics, torchft/data.py:24-77).
-    data_key = jax.random.PRNGKey(hash(replica_group) % (2**31))
+    # DistributedSampler semantics, torchft/data.py:24-77).  Seed must be
+    # deterministic ACROSS incarnations: hash() is per-process-randomized
+    # (PYTHONHASHSEED), which would hand a relaunched group an unrelated
+    # data stream.
+    import zlib
+
+    seed = (
+        int(replica_group)
+        if replica_group.isdigit()
+        else zlib.crc32(replica_group.encode())
+    )
+    data_key = jax.random.PRNGKey(seed % (2**31))
 
     metrics = telemetry.get_metrics_logger()
     while manager.current_step() < args.steps:
@@ -200,6 +220,30 @@ def main() -> int:
                 committed=float(committed),
             )
 
+    if args.result_dir:
+        import hashlib
+        import json
+
+        os.makedirs(args.result_dir, exist_ok=True)
+        # Params only: BatchNorm stats are per-group mutable state fed by
+        # each group's OWN data shard and legitimately diverge.
+        flat = jax.tree_util.tree_leaves(opt.params)
+        digest = hashlib.sha256(
+            b"".join(
+                np.ascontiguousarray(np.asarray(x)).tobytes() for x in flat
+            )
+        ).hexdigest()
+        with open(
+            os.path.join(args.result_dir, f"group{replica_group}.json"), "w"
+        ) as f:
+            json.dump(
+                {
+                    "group": replica_group,
+                    "final_step": manager.current_step(),
+                    "param_sha256": digest,
+                },
+                f,
+            )
     manager.shutdown()
     print(f"[group {replica_group}] done at step {manager.current_step()}")
     return 0

@@ -24,6 +24,11 @@ import os
 import sys
 
 import jax
+
+from torchft_tpu._platform import maybe_pin_cpu
+
+maybe_pin_cpu()  # before any backend initializes
+
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -120,7 +125,16 @@ def main() -> int:
         should_quantize=args.quantize,
     )
 
-    data_key = jax.random.PRNGKey(hash(replica_group) % (2**31))
+    # Deterministic across incarnations (hash() is per-process-randomized;
+    # a relaunched group must resume its own data shard stream).
+    import zlib
+
+    seed = (
+        int(replica_group)
+        if replica_group.isdigit()
+        else zlib.crc32(replica_group.encode())
+    )
+    data_key = jax.random.PRNGKey(seed % (2**31))
     metrics = telemetry.get_metrics_logger()
     for inner in range(args.steps):
         telemetry.trace_window(inner)

@@ -31,10 +31,9 @@ import sys
 def _maybe_pin_cpu() -> None:
     """Honor JAX_PLATFORMS=cpu before any backend initializes (the container
     may pre-pin an accelerator platform via jax.config at import time)."""
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
+    from torchft_tpu._platform import maybe_pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_pin_cpu()
 
 
 def main() -> int:
