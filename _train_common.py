@@ -1,0 +1,36 @@
+"""Helpers shared by the train-script entry points (train_ddp.py,
+train_diloco.py, train_hsdp.py).
+
+Lives at the repo root ON PURPOSE: ``maybe_pin_cpu`` must run before any
+``torchft_tpu`` import (the package __init__ pulls in every submodule),
+or the "pin BEFORE any backend initializes" contract would silently
+depend on no submodule ever touching a device at import time."""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+
+def maybe_pin_cpu() -> None:
+    """Honors ``JAX_PLATFORMS=cpu`` even when an accelerator platform was
+    pre-pinned via jax.config at interpreter startup (sitecustomize),
+    where the env var alone is silently ignored.  Call before any
+    backend initializes (they initialize lazily)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def group_data_seed(replica_group: str) -> int:
+    """Deterministic data-shard seed for a replica group id: stable
+    ACROSS process incarnations (``hash()`` is per-process randomized,
+    which would hand a relaunched group an unrelated stream) and across
+    the trainers (DistributedSampler semantics, reference data.py)."""
+    seed = (
+        int(replica_group)
+        if replica_group.isdigit()
+        else zlib.crc32(replica_group.encode())
+    )
+    return seed % (2**31)

@@ -25,9 +25,9 @@ import sys
 
 import jax
 
-from torchft_tpu._platform import maybe_pin_cpu
+from _train_common import group_data_seed, maybe_pin_cpu
 
-maybe_pin_cpu()  # before any backend initializes
+maybe_pin_cpu()  # before any backend initializes or package import
 
 import jax.numpy as jnp
 import numpy as np
@@ -169,18 +169,11 @@ def main() -> int:
         )
 
     # Different replica groups draw different data shards (reference:
-    # DistributedSampler semantics, torchft/data.py:24-77).  Seed must be
-    # deterministic ACROSS incarnations: hash() is per-process-randomized
-    # (PYTHONHASHSEED), which would hand a relaunched group an unrelated
-    # data stream.
-    import zlib
-
-    seed = (
-        int(replica_group)
-        if replica_group.isdigit()
-        else zlib.crc32(replica_group.encode())
-    )
-    data_key = jax.random.PRNGKey(seed % (2**31))
+    # DistributedSampler semantics, torchft/data.py:24-77).  The stream
+    # is STEP-ADDRESSED (fold_in of the committed step), so a relaunched
+    # group that heals to step N resumes at batch N instead of replaying
+    # batches its first incarnation already committed.
+    data_base = jax.random.PRNGKey(group_data_seed(replica_group))
 
     metrics = telemetry.get_metrics_logger()
     while manager.current_step() < args.steps:
@@ -188,7 +181,7 @@ def main() -> int:
         # Scheduled profiler window (TORCHFT_TRACE_DIR; reference:
         # train_ddp.py:169-174 torch.profiler schedule).
         telemetry.trace_window(step)
-        data_key, batch_key = jax.random.split(data_key)
+        batch_key = jax.random.fold_in(data_base, step)
         x, y = synthetic_batch(batch_key, args.batch_size, S_img, n_cls)
 
         opt.zero_grad()  # quorum (async; overlaps with forward/backward)

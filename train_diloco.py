@@ -25,9 +25,9 @@ import sys
 
 import jax
 
-from torchft_tpu._platform import maybe_pin_cpu
+from _train_common import group_data_seed, maybe_pin_cpu
 
-maybe_pin_cpu()  # before any backend initializes
+maybe_pin_cpu()  # before any backend initializes or package import
 
 import jax.numpy as jnp
 import numpy as np
@@ -125,20 +125,13 @@ def main() -> int:
         should_quantize=args.quantize,
     )
 
-    # Deterministic across incarnations (hash() is per-process-randomized;
-    # a relaunched group must resume its own data shard stream).
-    import zlib
-
-    seed = (
-        int(replica_group)
-        if replica_group.isdigit()
-        else zlib.crc32(replica_group.encode())
-    )
-    data_key = jax.random.PRNGKey(seed % (2**31))
+    # Step-addressed data stream (fold_in of the loop position): stable
+    # across incarnations, resumable mid-stream (see _train_common).
+    data_base = jax.random.PRNGKey(group_data_seed(replica_group))
     metrics = telemetry.get_metrics_logger()
     for inner in range(args.steps):
         telemetry.trace_window(inner)
-        data_key, kx = jax.random.split(data_key)
+        kx = jax.random.fold_in(data_base, inner)
         x = jax.random.randint(
             kx, (args.batch_size, args.seq_len), 0, cfg.vocab_size
         )

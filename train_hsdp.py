@@ -31,7 +31,7 @@ import sys
 def _maybe_pin_cpu() -> None:
     """Honor JAX_PLATFORMS=cpu before any backend initializes (the container
     may pre-pin an accelerator platform via jax.config at import time)."""
-    from torchft_tpu._platform import maybe_pin_cpu
+    from _train_common import maybe_pin_cpu
 
     maybe_pin_cpu()
 
