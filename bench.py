@@ -41,6 +41,15 @@ import tempfile
 import time
 
 
+_T0 = time.time()
+
+
+def _progress(msg: str) -> None:
+    """Phase-boundary timestamps on stderr: when a driver-side timeout
+    kills the bench, the log shows which phase ate the budget."""
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
 def _materialize(x) -> float:
     """Forces device execution to finish by pulling one scalar to host."""
     import numpy as np
@@ -277,6 +286,7 @@ def _bench() -> dict:
     payload_mb = n_params * 4 / 1e6
 
     # ---- loop 1: raw (async-chained, one forced sync) --------------------
+    _progress(f"raw loop start (B={B} S={S} warmup={n_warmup} steps={n_steps})")
     raw_dt, state = _timed_window(step, state, batch, n_warmup, n_steps)
 
     # tokens/sec + MFU are derived AFTER the post-FT raw re-measure below
@@ -287,6 +297,7 @@ def _bench() -> dict:
     # Long-context capability point (flash attention; the dense path OOMs
     # at S=8192 on this chip): one extra timed config, small and untimed
     # on CPU/tiny runs.
+    _progress(f"raw loop done: {raw_dt*1e3:.1f} ms/step")
     long_ctx = None
     if (
         not os.environ.get("BENCH_TINY")
@@ -374,6 +385,11 @@ def _bench() -> dict:
     tokens_per_sec = B * S / raw_dt
     mfu = (flops / raw_dt / 1e12) / (peak * n_dev) if peak else None
 
+    _progress("heal bench start")
+    heal = _bench_heal()
+    _progress("quorum bench start")
+    quorum = _bench_quorum()
+
     result = {
         "raw_ms_per_step": round(raw_dt * 1e3, 2),
         "tokens_per_sec": round(tokens_per_sec, 1),
@@ -386,8 +402,8 @@ def _bench() -> dict:
         "sync_every": sync_every,
         "attn_impl": cfg.attn_impl,
         "long_context": long_ctx,
-        "heal_bench": _bench_heal(),
-        "quorum_bench": _bench_quorum(),
+        "heal_bench": heal,
+        "quorum_bench": quorum,
     }
     result.update(ft)
 
@@ -637,6 +653,7 @@ def _bench_ft(
         )
         ddp = DistributedDataParallel(manager, bucket_cap_mb=32.0)
 
+        _progress("diloco warmup fires start")
         # ---- loop 2: Streaming DiLoCo flagship (runs first: reuses the
         # raw loop's live train state, keeping peak HBM down) --------------
         # The framework's own algorithm (local_sgd.py): params split into
@@ -664,6 +681,7 @@ def _bench_ft(
             ).wait(timeout=timeout)
             manager.should_commit()
 
+        _progress("diloco warmup done; measured fires start")
         telemetry.reset_span_stats()
         exposed_wait_secs = []
         pending = None
@@ -718,6 +736,7 @@ def _bench_ft(
         )
         out["tunnel_transfer_ms_per_sync"] = round(transfer_ms, 1)
 
+        _progress(f"diloco done: {out['diloco_ft_ms_per_step']} ms/step; ddp start")
         # ---- loop 3: per-step fault-tolerant DDP -------------------------
         grad_step = make_grad_step(model, mesh, shardings)
         from torchft_tpu.parallel.train import default_optimizer

@@ -39,7 +39,7 @@ class FakeManager:
     def start_quorum(self, **kw):
         self.quorums += 1
 
-    def allreduce(self, tensors, should_quantize=False):
+    def allreduce(self, tensors, should_quantize=False, quantize_bits=8, pre_quantized=None):
         if not isinstance(tensors, (list, tuple)):
             tensors = [tensors]
         arrays = [np.array(t, dtype=np.float32) for t in tensors]
@@ -422,3 +422,60 @@ def test_diloco_commit_failure_on_both_replicas():
         np.testing.assert_array_equal(
             results[0]["backup"][key], results[1]["backup"][key]
         )
+
+
+def test_diloco_int4_error_feedback_unbiases_the_stream():
+    """With quantize_bits=4 + error_feedback, the residual carries each
+    sync's quantization error into the next payload, so the SUM of the
+    decoded stream tracks the true cumulative pseudograd within one
+    quantization step (telescoping: sum_k dq(Q(g+r_k)) = K*g + r_0 - r_K).
+    Without EF, a biased g accumulates its per-sync bias K times."""
+    import optax
+
+    from torchft_tpu.collectives import (
+        dequantize_blockwise,
+        quantize_blockwise,
+    )
+    from torchft_tpu.local_sgd import _Fragment
+
+    # A pseudograd whose values sit OFF the int4 grid: absmax 7.0 =>
+    # step 1.0; 0.3 quantizes to 0.0 with bias -0.3 every sync.
+    g = {"w": np.full((64,), 0.3, np.float32)}
+    g["w"][0] = 7.0  # pins the block scale to 1.0
+
+    def run(error_feedback: bool, syncs: int = 8):
+        mgr = FakeManager()
+        backup = {"w": np.zeros((64,), np.float32)}
+        local = {"w": -g["w"]}  # pseudograd = backup - local = g
+        frag = _Fragment(
+            0,
+            mgr,
+            ["w"],
+            lambda: local,
+            lambda p: None,
+            optax.sgd(1.0),
+            0.0,
+            should_quantize=True,
+            quantize_bits=4,
+            error_feedback=error_feedback,
+        )
+        frag._backup = {k: v.copy() for k, v in backup.items()}
+        decoded_sum = np.zeros_like(g["w"])
+        for _ in range(syncs):
+            mgr.allreduce_calls.clear()
+            frag.prepare_sync()
+            (payload,) = mgr.allreduce_calls[-1]
+            q, s = quantize_blockwise(payload, bits=4)
+            decoded_sum += dequantize_blockwise(q, s, payload.size, bits=4)
+            frag._pending = []  # skip perform_sync: keep g constant
+        return decoded_sum
+
+    syncs = 8
+    true_sum = g["w"] * syncs
+    ef_err = np.abs(run(True) - true_sum).max()
+    no_ef_err = np.abs(run(False) - true_sum).max()
+    # Without EF: bias -0.3 per sync on every 0.3 entry => 2.4 at K=8.
+    assert no_ef_err >= 2.0, no_ef_err
+    # With EF the telescoped error is bounded by one residual, <= step/2
+    # (plus fp noise).
+    assert ef_err <= 0.51, ef_err

@@ -264,6 +264,31 @@ def test_chunked_transfer_layout_matches_single_shot(monkeypatch):
     np.testing.assert_array_equal(backc, back1)
 
 
+def test_async_transfer_matches_sync(monkeypatch):
+    """quantize_for_transfer_async (eager dispatch on the caller's thread)
+    + pull_transfer_chunks must produce the bit-identical host payload the
+    synchronous quantize_for_transfer produces, in both the single-shot
+    and forced-chunked regimes."""
+    from torchft_tpu.ops import quantization as Q
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(2), (3 * 4 * Q.BLOCK + 123,), jnp.float32
+    )
+    q1, s1, n1 = Q.quantize_for_transfer(x)
+    chunks, n = Q.quantize_for_transfer_async(x)
+    qa, sa, na = Q.pull_transfer_chunks(chunks, n)
+    assert na == n1
+    np.testing.assert_array_equal(qa, q1)
+    np.testing.assert_array_equal(sa, s1)
+
+    monkeypatch.setattr(Q, "_TRANSFER_CHUNK", 4 * Q.BLOCK)
+    chunks, n = Q.quantize_for_transfer_async(x)
+    assert len(chunks) == 4
+    qc, sc, nc = Q.pull_transfer_chunks(chunks, n)
+    np.testing.assert_array_equal(qc, q1)
+    np.testing.assert_array_equal(sc, s1)
+
+
 def test_flash_gradients_bf16_tolerance():
     """bf16 backward: operands in bf16, accumulation fp32 (intentional —
     matches the forward and the MXU's native mode); pin the tolerance vs
@@ -285,3 +310,82 @@ def test_flash_gradients_bf16_tolerance():
         a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
         rel = float(jnp.max(jnp.abs(a32 - b32)) / (jnp.max(jnp.abs(b32)) + 1e-9))
         assert rel < 5e-2, rel
+
+
+# ---------------------------------------------------------------------------
+# int4 codec (bits=4): packing, parity, transfer layout
+# ---------------------------------------------------------------------------
+
+
+def test_nibble_pack_roundtrip():
+    from torchft_tpu.collectives import pack_nibbles, unpack_nibbles
+
+    rng = np.random.default_rng(7)
+    q = rng.integers(-7, 8, size=4096).astype(np.int8)
+    packed = pack_nibbles(q)
+    assert packed.size == q.size // 2
+    np.testing.assert_array_equal(unpack_nibbles(packed, q.size), q)
+
+
+def test_int4_host_roundtrip_error_bound():
+    rng = np.random.default_rng(8)
+    x = rng.normal(0, 2.0, (3 * HOST_BLOCK + 100,)).astype(np.float32)
+    q, s = quantize_blockwise(x, bits=4)
+    assert q.size == ((x.size + HOST_BLOCK - 1) // HOST_BLOCK) * HOST_BLOCK // 2
+    back = dequantize_blockwise(q, s, x.size, bits=4)
+    # per-block bound: scale/2, scale = blockwise absmax / 7
+    pad = np.zeros(s.size * HOST_BLOCK, np.float32)
+    pad[: x.size] = x
+    per_block_scale = np.repeat(s, HOST_BLOCK)[: x.size]
+    assert (np.abs(back - x) <= per_block_scale / 2 + 1e-6).all()
+
+
+def test_int4_device_matches_host_quantizer():
+    """fused_quantize(bits=4) through the interpret-mode Pallas kernel +
+    jnp packing must produce the bit-identical wire payload the host
+    numpy codec produces."""
+    from torchft_tpu.ops import fused_dequantize, fused_quantize
+
+    rng = np.random.default_rng(9)
+    x = rng.normal(0, 1.0, (2 * BLOCK + 64,)).astype(np.float32)
+    q_dev, s_dev, n = fused_quantize(jnp.asarray(x), 4)
+    q_host, s_host = quantize_blockwise(x, bits=4)
+    blocks = (n + BLOCK - 1) // BLOCK
+    np.testing.assert_array_equal(
+        np.asarray(q_dev).reshape(-1)[: blocks * BLOCK // 2], q_host
+    )
+    np.testing.assert_allclose(np.asarray(s_dev)[:blocks], s_host, rtol=1e-6)
+    # device payload decodes identically on either end
+    back_dev = np.asarray(fused_dequantize(q_host, s_host, n, 4))
+    back_host = dequantize_blockwise(q_host, s_host, n, bits=4)
+    np.testing.assert_array_equal(back_dev, back_host)
+
+
+def test_int4_transfer_layout_matches_host(monkeypatch):
+    from torchft_tpu.ops import quantization as Q
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(3), (3 * 4 * Q.BLOCK + 200,), jnp.float32
+    )
+    q1, s1, n1 = Q.quantize_for_transfer(x, bits=4)
+    q_host, s_host = quantize_blockwise(np.asarray(x), bits=4)
+    np.testing.assert_array_equal(q1, q_host)
+    # XLA folds the /7 into a reciprocal multiply -> scales can sit 1 ulp
+    # off the host's true division; q still matches bit-for-bit above.
+    np.testing.assert_allclose(s1, s_host, rtol=1e-6)
+    # The wire contract: the SAME payload bytes decode bit-identically on
+    # either end (scales ship with the payload; nobody re-derives them).
+    back = np.asarray(Q.dequantize_from_transfer(q1, s1, n1, bits=4))
+    np.testing.assert_array_equal(
+        back, dequantize_blockwise(q1, s1, n1, bits=4)
+    )
+
+    # chunked regime: layout must be bit-identical to single-shot
+    monkeypatch.setattr(Q, "_TRANSFER_CHUNK", 4 * Q.BLOCK)
+    chunks, n = Q.quantize_for_transfer_async(x, bits=4)
+    assert len(chunks) == 4
+    qc, sc, nc = Q.pull_transfer_chunks(chunks, n, bits=4)
+    np.testing.assert_array_equal(qc, q1)
+    np.testing.assert_allclose(sc, s1, rtol=1e-6)
+    backc = np.asarray(Q.dequantize_from_transfer(qc, sc, n, bits=4))
+    np.testing.assert_array_equal(backc, back)

@@ -86,11 +86,46 @@ def _parallel_over_blocks(n_blocks: int, fn) -> None:
         t.result()
 
 
-def quantize_blockwise(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """int8-quantizes a 1-D float array with one float32 scale per BLOCK
+def _qmax(bits: int) -> float:
+    """Symmetric integer range: 127 for int8, 7 for int4."""
+    if bits == 8:
+        return 127.0
+    if bits == 4:
+        return 7.0
+    raise ValueError(f"unsupported quantization width: {bits} bits")
+
+
+def pack_nibbles(q: np.ndarray) -> np.ndarray:
+    """Packs int8 values in [-7, 7] two-per-byte (two's-complement 4-bit
+    nibbles; even index -> low nibble). Wire format of the ``bits=4``
+    codec — halves outer-axis bytes vs int8 (the reference's fp8 is
+    8-bit; 4-bit matches the Streaming-DiLoCo-style compressed outer
+    sync)."""
+    u = q.astype(np.uint8) & 0xF
+    return (u[0::2] | (u[1::2] << 4)).view(np.int8)
+
+
+def unpack_nibbles(p: np.ndarray, n_vals: int) -> np.ndarray:
+    """Inverse of :func:`pack_nibbles`; returns int8 values of length
+    ``n_vals`` with sign extension."""
+    u = p.view(np.uint8)
+    out = np.empty(u.size * 2, dtype=np.uint8)
+    out[0::2] = u & 0xF
+    out[1::2] = u >> 4
+    # Two's-complement sign extension of the 4-bit field.
+    out = ((out ^ 8).astype(np.int8) - 8)
+    return out[:n_vals]
+
+
+def quantize_blockwise(
+    flat: np.ndarray, bits: int = 8
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Block-quantizes a 1-D float array with one float32 scale per BLOCK
     values (the rowwise-fp8 analog of quantization.py:44-162). Returns
-    (int8 values, float32 scales)."""
+    (int8 payload, float32 scales); with ``bits=4`` the payload is
+    nibble-packed (BLOCK/2 bytes per block)."""
     n = flat.size
+    qmax = _qmax(bits)
     blocks = (n + BLOCK - 1) // BLOCK
     q = np.empty(blocks * BLOCK, dtype=np.int8)
     scales = np.empty(blocks, dtype=np.float32)
@@ -106,23 +141,27 @@ def quantize_blockwise(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             chunk = padded
         mat = chunk.reshape(b1 - b0, BLOCK)
         s = np.abs(mat).max(axis=1)
-        s /= 127.0
+        s /= qmax
         np.copyto(s, 1.0, where=(s == 0))
         scales[b0:b1] = s
         # In-place pipeline: one fp32 temporary for the chunk only.
         buf = mat / s[:, None]
         np.rint(buf, out=buf)
-        np.clip(buf, -127, 127, out=buf)
+        np.clip(buf, -qmax, qmax, out=buf)
         q[b0 * BLOCK : b1 * BLOCK] = buf.reshape(-1)
 
     _parallel_over_blocks(blocks, work)
+    if bits == 4:
+        return pack_nibbles(q), scales
     return q, scales
 
 
 def dequantize_blockwise(
-    q: np.ndarray, scales: np.ndarray, n: int
+    q: np.ndarray, scales: np.ndarray, n: int, bits: int = 8
 ) -> np.ndarray:
     blocks = scales.size
+    if bits == 4:
+        q = unpack_nibbles(q, blocks * BLOCK)
     out = np.empty(blocks * BLOCK, dtype=np.float32)
 
     def work(b0: int, b1: int) -> None:
@@ -158,6 +197,7 @@ def allreduce_quantized_jax(
     arrays: Sequence["jax.Array"],  # noqa: F821 - imported lazily
     op: ReduceOp = ReduceOp.SUM,
     scale: float = 1.0,
+    bits: int = 8,
 ) -> Work:
     """Quantized allreduce for jax device arrays: quantize ON DEVICE with the
     Pallas kernels, pull int8 + per-block scales to host (~4x fewer bytes
@@ -204,11 +244,13 @@ def allreduce_quantized_jax(
     a0 = arrays[0]
     if len(arrays) == 1 and a0.ndim == 1 and a0.dtype == jnp.float32:
         # ravel/astype both short-circuited, so ``flat`` aliases the
-        # caller's buffer.  The quantize+pull below runs later on the
-        # collective thread, overlapped with the caller's next train
-        # step — which may DONATE this buffer (make_train_step and
-        # bench.py both donate), deleting it mid-pull.  Materialize an
-        # independent device snapshot before returning to the caller.
+        # caller's buffer.  Parts of the pipeline touch ``flat`` after
+        # this call returns (host path: the deferred host pull; device
+        # path: quantize kernels already enqueued but not yet executed)
+        # while the caller's next train step may DONATE this buffer
+        # (make_train_step and bench.py both donate), deleting it
+        # mid-use.  Materialize an independent device snapshot before
+        # returning to the caller.
         # (Below the ws<=1 return: the single-replica path never defers.)
         flat = jnp.copy(flat)
 
@@ -224,20 +266,35 @@ def allreduce_quantized_jax(
     # exactly this reason).
     host_quant = jax.default_backend() != "tpu"
 
+    # Device path: dispatch the quantize kernels NOW, on the caller's
+    # thread. Async dispatch returns immediately, but enqueues the kernels
+    # right behind the compute that produced ``flat`` — BEFORE the
+    # caller's next training window. The deferred host pull then overlaps
+    # that window; dispatched lazily from the collective thread instead,
+    # the kernels would queue behind the whole next window and the "pull"
+    # would spend its time waiting on unrelated compute (measured 24 s of
+    # a 3 s transfer in BENCH_TPU_r03).
+    q_chunks = None
+    n_elems = 0
+    if not host_quant:
+        q_chunks, n_elems = Q.quantize_for_transfer_async(flat, bits)
+        # The enqueued kernels hold their own reference to the snapshot;
+        # don't let the run() closure pin the full fp32 copy across the
+        # multi-second wire pipeline too.
+        flat = None
+
     def run() -> List["jax.Array"]:
-        # Device quantize + int8 host pull run on the collective thread:
-        # ``flat`` is an independent snapshot (see above) — deferring the
-        # pull overlaps it with the caller's next compute window (the
-        # streaming-DiLoCo overlap this path exists for).
         with trace_span("torchft::collectives::quantize_pull"):
             if host_quant:
                 flat_host = np.asarray(flat, dtype=np.float32)
                 n = flat_host.size
-                q_host, s_host = quantize_blockwise(flat_host)
+                q_host, s_host = quantize_blockwise(flat_host, bits)
             else:
-                q_host, s_host, n = Q.quantize_for_transfer(flat)
+                q_host, s_host, n = Q.pull_transfer_chunks(
+                    q_chunks, n_elems, bits
+                )
         with trace_span("torchft::collectives::wire"):
-            reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
+            reduced = _quantized_wire_pipeline(pg, q_host, s_host, n, bits)
         with trace_span("torchft::collectives::dequant_push"):
             if isinstance(reduced, np.ndarray):
                 # Tiny payload: the local reduce already produced the full
@@ -248,24 +305,48 @@ def allreduce_quantized_jax(
                 q_final, s_final = reduced
                 if host_quant:
                     out = jnp.asarray(
-                        dequantize_blockwise(q_final, s_final, n)
+                        dequantize_blockwise(q_final, s_final, n, bits)
                     )
                 else:
                     # Device-side dequantize (chunked; the sum stayed fp32
                     # on the wire pipeline so only one quantize->dequantize
                     # round trip of error per value).
-                    out = Q.dequantize_from_transfer(q_final, s_final, n)
+                    out = Q.dequantize_from_transfer(
+                        q_final, s_final, n, bits
+                    )
             if total_scale != 1.0:
                 out = out * total_scale
             outs = rebuild(out)
-            jax.block_until_ready(outs)
+            if host_quant:
+                # CPU backend: materialize so errors latch inside the
+                # collective (the tests' error-injection contract).
+                jax.block_until_ready(outs)
+            # TPU: leave the dequantize async-dispatched. Its execution
+            # naturally queues behind whatever window the caller has in
+            # flight, and wait() returning a not-yet-executed array is
+            # exactly XLA's async-dispatch contract — blocking here would
+            # re-serialize the window we just overlapped.
+            #
+            # FT error-latch boundary under async dispatch: everything
+            # DISPATCH-time still raises here on the collective thread and
+            # latches (shape errors, and HBM OOM — PJRT allocates output
+            # buffers at dispatch, so the big fp32 allocation in
+            # dequantize_from_transfer fails synchronously).  Only an
+            # EXECUTION-time device fault defers to the caller's next
+            # materialize, outside the latch — for static-shaped
+            # elementwise kernels on TPU there is no analog of CUDA's
+            # illegal-access class, so that residue is accepted as the
+            # price of the overlap.
         return outs
 
     return FutureWork(_spawn_collective(run))
 
 
 def reduce_scatter_quantized(
-    pg: ProcessGroup, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    pg: ProcessGroup,
+    arrays: Sequence[np.ndarray],
+    op: ReduceOp = ReduceOp.SUM,
+    bits: int = 8,
 ) -> Work:
     """Quantized reduce_scatter (reference: collectives.py:159-294): the
     alltoall + local-fp32-reduce half of the allreduce pipeline, WITHOUT the
@@ -285,8 +366,9 @@ def reduce_scatter_quantized(
         n = flat.size
         if ws <= 1:
             return flat, (0, n)
-        q_host, s_host = quantize_blockwise(flat)
+        q_host, s_host = quantize_blockwise(flat, bits)
         blocks = s_host.size
+        bpb = BLOCK // (8 // bits)
         me = pg.rank()
         counts = [len(c) for c in np.array_split(np.arange(blocks), ws)]
         starts = np.concatenate([[0], np.cumsum(counts)]) * BLOCK
@@ -296,13 +378,13 @@ def reduce_scatter_quantized(
             gathered = pg.allgather([q_host, s_host]).wait()
             acc = np.zeros(n, np.float32)
             for g_q, g_s in gathered:
-                acc += dequantize_blockwise(g_q, g_s, n)
+                acc += dequantize_blockwise(g_q, g_s, n, bits)
             shard = acc[start:end]
         else:
             q_chunks, s_chunks = [], []
             off = 0
             for c in counts:
-                q_chunks.append(q_host[off * BLOCK : (off + c) * BLOCK])
+                q_chunks.append(q_host[off * bpb : (off + c) * bpb])
                 s_chunks.append(s_host[off : off + c])
                 off += c
             all_q = pg.alltoall(q_chunks).wait()
@@ -310,7 +392,7 @@ def reduce_scatter_quantized(
             n_me = counts[me] * BLOCK
             acc = np.zeros(n_me, np.float32)
             for g_q, g_s in zip(all_q, all_s):
-                acc += dequantize_blockwise(g_q, g_s, n_me)
+                acc += dequantize_blockwise(g_q, g_s, n_me, bits)
             shard = acc[: end - start]
         if op == ReduceOp.AVG:
             shard = shard / ws
@@ -343,7 +425,11 @@ def bucketize(arrays: Sequence[np.ndarray], cap_bytes: int) -> List[List[int]]:
 
 
 def _quantized_wire_pipeline(
-    pg: ProcessGroup, q_host: np.ndarray, s_host: np.ndarray, n: int
+    pg: ProcessGroup,
+    q_host: np.ndarray,
+    s_host: np.ndarray,
+    n: int,
+    bits: int = 8,
 ):
     """The shared quantized-allreduce wire protocol: block-aligned alltoall
     of int8 chunks + scales -> local fp32 reduce -> requantize -> allgather.
@@ -357,11 +443,12 @@ def _quantized_wire_pipeline(
     """
     ws = pg.size()
     blocks = s_host.size
+    bpb = BLOCK // (8 // bits)  # payload bytes per block (256 when packed)
     if blocks < ws:
         gathered = pg.allgather([q_host, s_host]).wait()
         acc = np.zeros(n, np.float32)
         for g_q, g_s in gathered:
-            acc += dequantize_blockwise(g_q, g_s, n)
+            acc += dequantize_blockwise(g_q, g_s, n, bits)
         return acc
     # Contiguous block-aligned chunks so each chunk owns whole scales;
     # alltoall -> rank r reduces everyone's r-th chunk.
@@ -369,7 +456,7 @@ def _quantized_wire_pipeline(
     q_chunks, s_chunks = [], []
     off = 0
     for c in counts:
-        q_chunks.append(q_host[off * BLOCK : (off + c) * BLOCK])
+        q_chunks.append(q_host[off * bpb : (off + c) * bpb])
         s_chunks.append(s_host[off : off + c])
         off += c
     all_q = pg.alltoall(q_chunks).wait()
@@ -378,8 +465,8 @@ def _quantized_wire_pipeline(
     n_me = counts[me] * BLOCK
     acc = np.zeros(n_me, np.float32)
     for g_q, g_s in zip(all_q, all_s):
-        acc += dequantize_blockwise(g_q, g_s, n_me)
-    rq, rs = quantize_blockwise(acc)
+        acc += dequantize_blockwise(g_q, g_s, n_me, bits)
+    rq, rs = quantize_blockwise(acc, bits)
     gathered = pg.allgather([rq, np.asarray(rs)]).wait()
     q_final = np.concatenate([g[0] for g in gathered])
     s_final = np.concatenate([g[1] for g in gathered])
@@ -387,10 +474,19 @@ def _quantized_wire_pipeline(
 
 
 def allreduce_quantized(
-    pg: ProcessGroup, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    pg: ProcessGroup,
+    arrays: Sequence[np.ndarray],
+    op: ReduceOp = ReduceOp.SUM,
+    bits: int = 8,
+    pre_quantized: "Tuple[np.ndarray, np.ndarray] | None" = None,
 ) -> Work:
     """Quantized SUM/AVG allreduce, in place (reference:
-    collectives.py:297-415). Returns async Work whose result is ``arrays``."""
+    collectives.py:297-415). Returns async Work whose result is ``arrays``.
+    ``bits=4`` nibble-packs the wire payload (half the bytes of int8).
+
+    ``pre_quantized=(q, scales)``: callers that already quantized the
+    concatenated payload (DiLoCo's error-feedback residual needs q anyway)
+    pass it here so the payload is quantized exactly once."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
     ws = pg.size()
@@ -400,13 +496,16 @@ def allreduce_quantized(
     def run() -> List[np.ndarray]:
         flat, sizes = _flatten(arrays)
         n = flat.size
-        q_host, s_host = quantize_blockwise(flat)
-        reduced = _quantized_wire_pipeline(pg, q_host, s_host, n)
+        if pre_quantized is not None:
+            q_host, s_host = pre_quantized
+        else:
+            q_host, s_host = quantize_blockwise(flat, bits)
+        reduced = _quantized_wire_pipeline(pg, q_host, s_host, n, bits)
         if isinstance(reduced, np.ndarray):
             result = reduced
         else:
             q_final, s_final = reduced
-            result = dequantize_blockwise(q_final, s_final, n)
+            result = dequantize_blockwise(q_final, s_final, n, bits)
         if op == ReduceOp.AVG:
             result /= ws
         _unflatten_into(arrays, result, sizes)

@@ -33,7 +33,12 @@ class DistributedDataParallel:
         self._manager = manager
         self._bucket_cap = int(bucket_cap_mb * 1024 * 1024)
 
-    def allreduce_grads(self, grads: Any, should_quantize: bool = False) -> Any:
+    def allreduce_grads(
+        self,
+        grads: Any,
+        should_quantize: bool = False,
+        quantize_bits: int = 8,
+    ) -> Any:
         """Flattens ``grads`` into <=bucket_cap flat buffers per dtype, issues
         async manager allreduces for all buckets, waits, and rebuilds the
         pytree (values averaged over live participants)."""
@@ -66,7 +71,11 @@ class DistributedDataParallel:
         works: List[Tuple[Any, np.ndarray, List[int]]] = []
         for idx_list in buckets:
             flat = np.concatenate([host[i].reshape(-1) for i in idx_list])
-            work = self._manager.allreduce(flat, should_quantize=should_quantize)
+            work = self._manager.allreduce(
+                flat,
+                should_quantize=should_quantize,
+                quantize_bits=quantize_bits,
+            )
             works.append((work, flat, idx_list))
 
         out: List[Optional[np.ndarray]] = [None] * len(host)

@@ -92,11 +92,20 @@ class ManagedMesh:
 
     # -- collectives ------------------------------------------------------
 
-    def allreduce_grads(self, grads: Any, should_quantize: bool = False) -> Any:
+    def allreduce_grads(
+        self,
+        grads: Any,
+        should_quantize: bool = False,
+        quantize_bits: int = 8,
+    ) -> Any:
         """Average a gradient pytree across the replica axis (the managed
         dim's allreduce — what ManagedProcessGroup.allreduce is to DDP in the
         reference, process_group.py:1205-1238)."""
-        return self._ddp.allreduce_grads(grads, should_quantize=should_quantize)
+        return self._ddp.allreduce_grads(
+            grads,
+            should_quantize=should_quantize,
+            quantize_bits=quantize_bits,
+        )
 
     def __repr__(self) -> str:
         return (

@@ -137,6 +137,8 @@ class _Fragment:
         fragment_update_alpha: float,
         should_quantize: bool,
         bucket_cap_mb: float = 32.0,
+        quantize_bits: int = 8,
+        error_feedback: bool = False,
     ) -> None:
         self.index = index
         self._manager = manager
@@ -146,6 +148,9 @@ class _Fragment:
         self._opt = outer_optimizer
         self._alpha = fragment_update_alpha
         self._should_quantize = should_quantize
+        self._quantize_bits = quantize_bits
+        self._error_feedback = error_feedback
+        self._residuals: Dict[int, np.ndarray] = {}
         self._bucket_cap = int(bucket_cap_mb * 1024 * 1024)
 
         self._backup = _to_host(get_fragment())
@@ -214,10 +219,39 @@ class _Fragment:
 
         buckets = bucketize(leaves, self._bucket_cap)
         self._pending = []
-        for idx_list in buckets:
+        for b_idx, idx_list in enumerate(buckets):
             flat = np.concatenate([leaves[i].reshape(-1) for i in idx_list])
+            pre_q = None
+            if self._error_feedback and self._should_quantize:
+                # Residual (error-feedback) compensation: add the part of
+                # the previous syncs' pseudograds this replica's quantizer
+                # dropped, then store what THIS quantization drops.  The
+                # wire sum stays identical across replicas (each ships its
+                # own compensated payload), so global bitwise equality is
+                # preserved; residuals are replica-local and reset on heal
+                # (a healed replica restarts with zero residual — one
+                # sync's worth of its own quantization error, bounded by
+                # half a block scale per value).  Standard for <=4-bit
+                # outer syncs, where bare quantization bias accumulates
+                # across rounds.
+                from torchft_tpu.collectives import (
+                    dequantize_blockwise,
+                    quantize_blockwise,
+                )
+
+                r = self._residuals.get(b_idx)
+                if r is not None and r.size == flat.size:
+                    flat = flat + r
+                q, s = quantize_blockwise(flat, self._quantize_bits)
+                self._residuals[b_idx] = flat - dequantize_blockwise(
+                    q, s, flat.size, self._quantize_bits
+                )
+                pre_q = (q, s)  # quantized once: the allreduce reuses it
             work = self._manager.allreduce(
-                flat, should_quantize=self._should_quantize
+                flat,
+                should_quantize=self._should_quantize,
+                quantize_bits=self._quantize_bits,
+                pre_quantized=pre_q,
             )
             self._pending.append((work, idx_list))
         self._pending_leaves = leaves
@@ -309,6 +343,8 @@ class DiLoCo:
         fragment_update_alpha: float = 0.0,
         should_quantize: bool = False,
         bucket_cap_mb: float = 32.0,
+        quantize_bits: int = 8,
+        error_feedback: bool = False,
     ) -> None:
         n = len(fragments)
         assert n >= 1, "need at least one fragment"
@@ -348,6 +384,8 @@ class DiLoCo:
                 fragment_update_alpha,
                 should_quantize,
                 bucket_cap_mb,
+                quantize_bits,
+                error_feedback,
             )
             for i, (keys, get_fn, set_fn) in enumerate(fragments)
         ]

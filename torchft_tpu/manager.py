@@ -539,7 +539,11 @@ class Manager:
 
     @traced("torchft::manager::allreduce")
     def allreduce(
-        self, tensors: Any, should_quantize: bool = False
+        self,
+        tensors: Any,
+        should_quantize: bool = False,
+        quantize_bits: int = 8,
+        pre_quantized: Any = None,
     ) -> Work:
         """Fault-tolerant averaged allreduce across the replica axis
         (reference: manager.py:379-450). Accepts a numpy array, jax array, or
@@ -551,7 +555,9 @@ class Manager:
         ON DEVICE (Pallas kernels) before the device->host pull, so both the
         PCIe pull and the DCN wire move int8 + per-block scales instead of
         fp32 (~4x fewer bytes); the result is dequantized on device and
-        wait() returns NEW jax arrays."""
+        wait() returns NEW jax arrays. ``quantize_bits=4`` nibble-packs the
+        payload — half the wire bytes again (exceeds the reference's 8-bit
+        fp8 codec); all replicas must use the same width."""
         import jax
 
         items = list(tensors) if isinstance(tensors, (list, tuple)) else [tensors]
@@ -575,7 +581,10 @@ class Manager:
                 from torchft_tpu.collectives import allreduce_quantized_jax
 
                 work = allreduce_quantized_jax(
-                    self._pg, items, scale=1.0 / num_participants
+                    self._pg,
+                    items,
+                    scale=1.0 / num_participants,
+                    bits=quantize_bits,
                 )
             except Exception as e:
                 self._logger.exception(f"quantized allreduce failed: {e}")
@@ -603,13 +612,22 @@ class Manager:
         if self._participating_rank is None:
             for a in arrays:
                 a.fill(0)
+            # A caller-supplied quantized payload was built from the
+            # UN-zeroed arrays — discard it so the wire carries the zeros
+            # (the collective re-quantizes the zeroed flat).
+            pre_quantized = None
 
         num_participants = max(self.num_participants(), 1)
         try:
             if should_quantize:
                 from torchft_tpu.collectives import allreduce_quantized
 
-                work = allreduce_quantized(self._pg, arrays)
+                work = allreduce_quantized(
+                    self._pg,
+                    arrays,
+                    bits=quantize_bits,
+                    pre_quantized=pre_quantized,
+                )
             else:
                 work = self._pg.allreduce(arrays, ReduceOp.SUM)
         except Exception as e:

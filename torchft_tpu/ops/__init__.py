@@ -7,7 +7,9 @@ with interpret-mode fallback so the same code paths run in CPU tests.
 
 from torchft_tpu.ops.quantization import (  # noqa: F401
     BLOCK,
+    fused_dequantize,
     fused_dequantize_int8,
+    fused_quantize,
     fused_quantize_int8,
     fused_reduce_int8,
     quantize_for_transfer,

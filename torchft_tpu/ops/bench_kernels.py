@@ -49,7 +49,10 @@ def main() -> int:
     from torchft_tpu.models.llama import dense_attention
     from torchft_tpu.ops.flash_attention import flash_attention
     from torchft_tpu.ops.quantization import (
+        BLOCK,
+        fused_dequantize,
         fused_dequantize_int8,
+        fused_quantize,
         fused_quantize_int8,
         fused_reduce_int8,
     )
@@ -66,26 +69,70 @@ def main() -> int:
     rng = np.random.default_rng(0)
 
     # ---- int8 quantize/dequantize vs the host-numpy reference ----------
+    # The invariants the wire protocol actually relies on (TPU divide is
+    # not correctly-rounded IEEE, so quantize is NOT bit-exact vs host —
+    # round-boundary values flip by one level, measured 7/4.2M on v5e):
+    #   1. DEQUANTIZE is bit-exact host vs device (int8*fp32 multiply is
+    #      exact) — this is what makes cross-replica bitwise equality
+    #      hold, since each wire chunk is requantized by exactly one rank
+    #      and every replica decodes the same bytes.
+    #   2. Device quantize differs from host by at most 1 int8 level, on
+    #      a vanishing fraction of values; scales agree to 1 ulp.
+    #   3. Roundtrip error stays within the half-step quantization bound.
     n = 4 * 1024 * 1024
     x_host = rng.standard_normal(n).astype(np.float32)
     x = jnp.asarray(x_host)
     q, s, _ = fused_quantize_int8(x)
     jax.block_until_ready(q)
     q_ref, s_ref = quantize_blockwise(x_host)
-    quant_exact = bool(
-        np.array_equal(np.asarray(q).reshape(-1)[: q_ref.size], q_ref)
-        and np.allclose(np.asarray(s)[: s_ref.size], s_ref)
+    q_dev = np.asarray(q).reshape(-1)[: q_ref.size].astype(np.int32)
+    level_diff = np.abs(q_dev - q_ref.astype(np.int32))
+    s_dev = np.asarray(s)[: s_ref.size]
+    # Per-BLOCK relative error (normalizing by the global max would let a
+    # tiny block's scale diverge wildly and still pass).
+    scale_rel_err = float(
+        (np.abs(s_dev - s_ref) / (np.abs(s_ref) + 1e-30)).max()
     )
-    roundtrip = np.asarray(fused_dequantize_int8(q, s, n))
-    rt_ref = dequantize_blockwise(q_ref, s_ref, n)
-    max_err = float(np.abs(roundtrip - rt_ref).max())
+    # Host dequant of the device payload vs device dequant of the same
+    # payload: must be bit-identical.
+    dd = np.asarray(fused_dequantize_int8(q, s, n))
+    dh = dequantize_blockwise(np.asarray(q).reshape(-1), s_dev, n)
+    dequant_exact = bool(np.array_equal(dd, dh))
+    # Roundtrip bound: |x - dq| <= ~half a quantization step (with 1-ulp
+    # headroom for the scale disagreement).
+    per_elem_scale = np.repeat(s_dev, BLOCK)[:n]
+    rt_ok = bool(
+        (np.abs(dd - x_host) <= 0.501 * per_elem_scale + 1e-7).all()
+    )
     result["quantize"] = {
         "n": n,
-        "parity_with_host_exact": quant_exact,
-        "roundtrip_max_abs_err_vs_host": max_err,
+        "dequantize_bit_exact": dequant_exact,
+        "quantize_max_level_diff_vs_host": int(level_diff.max()),
+        "quantize_level_diff_count": int((level_diff != 0).sum()),
+        "scale_rel_err_vs_host": scale_rel_err,
+        "roundtrip_within_half_step": rt_ok,
         "quantize_ms": round(_time_call(fused_quantize_int8, x), 3),
         "dequantize_ms": round(
             _time_call(lambda: fused_dequantize_int8(q, s, n)), 3
+        ),
+    }
+
+    # ---- int4 codec (nibble-packed wire) -------------------------------
+    q4, s4, _ = fused_quantize(x, 4)
+    jax.block_until_ready(q4)
+    q4_ref, s4_ref = quantize_blockwise(x_host, bits=4)
+    q4_dev = np.asarray(q4).reshape(-1)[: q4_ref.size]
+    # Same-payload decode must be bit-identical on either end.
+    dd4 = np.asarray(fused_dequantize(q4_ref, s4_ref, n, 4))
+    dh4 = dequantize_blockwise(q4_ref, s4_ref, n, bits=4)
+    result["quantize_int4"] = {
+        "payload_bytes_per_value": 0.5,
+        "pack_matches_host_count": int(
+            (q4_dev != q4_ref.astype(np.int8)).sum()
+        ),
+        "dequantize_bit_exact": bool(np.array_equal(dd4, dh4)),
+        "quantize_ms": round(
+            _time_call(lambda: fused_quantize(x, 4)), 3
         ),
     }
 
@@ -137,6 +184,27 @@ def main() -> int:
         "dense_ms": round(_time_call(dense_fn, *qkv), 3),
     }
 
+    # Long-sequence latency point: at S=1024 a tunneled dispatch RTT
+    # (~65 ms) swamps both kernels; at S=8192 the O(S^2) work dominates,
+    # so this is the pair that actually shows the flash-vs-dense win
+    # (and the HBM saving: dense materializes the S^2 logits).
+    S_long = 8192
+    qkv_long = [
+        jnp.asarray(
+            rng.standard_normal((1, S_long, 8, 64)), jnp.bfloat16
+        )
+        for _ in range(3)
+    ]
+    try:
+        dense_long_ms = round(_time_call(dense_fn, *qkv_long), 3)
+    except Exception:  # dense S^2 logits can OOM a shared chip
+        dense_long_ms = None
+    result["flash_attention_long"] = {
+        "shape": [1, S_long, 8, 64],
+        "flash_ms": round(_time_call(flash_fn, *qkv_long), 3),
+        "dense_ms": dense_long_ms,
+    }
+
     # ---- offset-block kernel (ring attention's per-step fold) ----------
     # Full causal attention assembled from two streamed kv blocks via an
     # online-softmax merge must match dense — the single-chip proxy for
@@ -185,8 +253,14 @@ def main() -> int:
     }
 
     ok = (
-        result["quantize"]["parity_with_host_exact"]
-        and result["quantize"]["roundtrip_max_abs_err_vs_host"] < 1e-6
+        result["quantize"]["dequantize_bit_exact"]
+        and result["quantize"]["quantize_max_level_diff_vs_host"] <= 1
+        and result["quantize"]["quantize_level_diff_count"] <= n // 10_000
+        and result["quantize"]["scale_rel_err_vs_host"] < 1e-6
+        and result["quantize"]["roundtrip_within_half_step"]
+        and result["quantize_int4"]["dequantize_bit_exact"]
+        # nibble packing may inherit the same 1-level divide flips
+        and result["quantize_int4"]["pack_matches_host_count"] <= n // 10_000
         and result["fused_reduce"]["rel_err"] < 0.02
         and result["flash_attention"]["rel_err_vs_dense"] < 0.03
         and result["flash_block_merge"]["rel_err_vs_dense"] < 0.03

@@ -15,8 +15,15 @@ TPU port notes:
   kernels through the Pallas interpreter, so kernel logic is covered without
   a chip.
 
-Numerics match ``collectives.quantize_blockwise`` exactly: scale =
-absmax/127 (1.0 for all-zero blocks), round-to-nearest-even, clip to ±127.
+Numerics vs ``collectives.quantize_blockwise``: same formula (scale =
+absmax/127, 1.0 for all-zero blocks, round-to-nearest-even, clip to ±127),
+and DEQUANTIZE is bit-exact either side (int8·fp32 multiply is exact).
+QUANTIZE is *not* bit-exact on real TPUs — the VPU divide is not
+correctly-rounded IEEE, so round-boundary values can land one int8 level
+off the host result (measured 7 per 4.2M on v5e; see bench_kernels.py).
+That is within the quantization half-step and does not affect the wire
+protocol's cross-replica bitwise guarantee: each wire chunk is requantized
+by exactly one owner rank, and all replicas decode identical bytes.
 """
 
 from __future__ import annotations
@@ -47,26 +54,31 @@ def _pad_blocks(x: jax.Array) -> Tuple[jax.Array, int]:
     return padded.reshape(rows, BLOCK), n
 
 
-def _requantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _requantize(
+    x: jax.Array, qmax: float = 127.0
+) -> Tuple[jax.Array, jax.Array]:
     """Shared numerics for both kernels: rowwise absmax scale (1.0 for
-    all-zero rows), round-to-nearest-even, clip to ±127. Must stay in exact
-    parity with collectives.quantize_blockwise."""
+    all-zero rows), round-to-nearest-even, clip to ±qmax. Must stay in
+    parity with collectives.quantize_blockwise (see module docstring for
+    the TPU-divide caveat). ``qmax`` 127 = int8, 7 = int4."""
     absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
-    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
-    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax).astype(jnp.int8)
     return q, jnp.broadcast_to(scale, (x.shape[0], 128))
 
 
-def _quantize_kernel(x_ref, q_ref, s_ref):
-    q_ref[...], s_ref[...] = _requantize(x_ref[...])
+def _quantize_kernel(x_ref, q_ref, s_ref, *, qmax: float):
+    q_ref[...], s_ref[...] = _requantize(x_ref[...], qmax)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _quantize_rows(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+@functools.partial(jax.jit, static_argnames=("qmax",))
+def _quantize_rows(
+    x2d: jax.Array, qmax: float = 127.0
+) -> Tuple[jax.Array, jax.Array]:
     rows = x2d.shape[0]
     grid = (rows // _TILE,)
     return pl.pallas_call(
-        _quantize_kernel,
+        functools.partial(_quantize_kernel, qmax=qmax),
         grid=grid,
         in_specs=[pl.BlockSpec((_TILE, BLOCK), lambda i: (i, 0))],
         out_specs=[
@@ -81,13 +93,46 @@ def _quantize_rows(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
     )(x2d)
 
 
-def fused_quantize_int8(x: jax.Array) -> Tuple[jax.Array, jax.Array, int]:
-    """Quantizes a device array to (int8 values [rows, BLOCK], fp32 scales
-    [rows], element count). Pull the first two to host for a ~4x smaller
-    DCN transfer (reference: fused_quantize_into_fp8, quantization.py:531+)."""
+def _pack_nibbles_jnp(q: jax.Array) -> jax.Array:
+    """[rows, BLOCK] int8 in [-7,7] -> [rows, BLOCK//2] int8, flat layout
+    identical to collectives.pack_nibbles (even flat index -> low nibble).
+    Plain jnp ops OUTSIDE the Pallas kernel: XLA compiles int8 bitwise on
+    TPU fine, and keeping the kernel int8-only avoids Mosaic strided-lane
+    territory."""
+    u = q.astype(jnp.uint8) & 0xF
+    return (u[:, 0::2] | (u[:, 1::2] << 4)).astype(jnp.int8)
+
+
+def _unpack_nibbles_jnp(p: jax.Array) -> jax.Array:
+    """[rows, BLOCK//2] int8 -> [rows, BLOCK] int8 with sign extension."""
+    u = p.astype(jnp.uint8)
+    both = jnp.stack([u & 0xF, u >> 4], axis=-1).reshape(p.shape[0], -1)
+    return (jnp.bitwise_xor(both, 8).astype(jnp.int8) - 8)
+
+
+# Single source of truth for the bits->range policy lives in
+# collectives._qmax (no import cycle: collectives only imports this
+# module lazily, inside functions).
+from torchft_tpu.collectives import _qmax as _bits_qmax  # noqa: E402
+
+
+def fused_quantize(
+    x: jax.Array, bits: int = 8
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Quantizes a device array to (payload [rows, BLOCK or BLOCK/2], fp32
+    scales [rows], element count). Pull the first two to host for a ~4x
+    (int8) or ~8x (int4 nibble-packed) smaller DCN transfer (reference:
+    fused_quantize_into_fp8, quantization.py:531+)."""
     x2d, n = _pad_blocks(x)
-    q, s = _quantize_rows(x2d)
+    q, s = _quantize_rows(x2d, _bits_qmax(bits))
+    if bits == 4:
+        q = _pack_nibbles_jnp(q)
     return q, s[:, 0], n
+
+
+def fused_quantize_int8(x: jax.Array) -> Tuple[jax.Array, jax.Array, int]:
+    """int8 shorthand for :func:`fused_quantize` (the original API)."""
+    return fused_quantize(x, 8)
 
 
 def _dequantize_kernel(q_ref, s_ref, out_ref):
@@ -107,11 +152,13 @@ def _pad_rows(x: jax.Array) -> jax.Array:
     return jnp.pad(x, pad_widths)
 
 
-def fused_dequantize_int8(
-    q: jax.Array, scales: jax.Array, n: int
+def fused_dequantize(
+    q: jax.Array, scales: jax.Array, n: int, bits: int = 8
 ) -> jax.Array:
-    """Inverse of :func:`fused_quantize_int8`; returns a flat fp32 array of
+    """Inverse of :func:`fused_quantize`; returns a flat fp32 array of
     length ``n``. Accepts host-quantized payloads too (any row count)."""
+    if bits == 4:
+        q = _unpack_nibbles_jnp(jnp.asarray(q).reshape(-1, BLOCK // 2))
     q = _pad_rows(jnp.asarray(q).reshape(-1, BLOCK))
     rows = q.shape[0]
     scales = jnp.asarray(scales).reshape(-1)
@@ -130,6 +177,13 @@ def fused_dequantize_int8(
         interpret=_interpret(),
     )(q, s2d)
     return out.reshape(-1)[:n]
+
+
+def fused_dequantize_int8(
+    q: jax.Array, scales: jax.Array, n: int
+) -> jax.Array:
+    """int8 shorthand for :func:`fused_dequantize` (the original API)."""
+    return fused_dequantize(q, scales, n, 8)
 
 
 def _reduce_kernel(q_ref, s_ref, qo_ref, so_ref, *, ranks: int, avg: bool):
@@ -189,48 +243,86 @@ def fused_reduce_int8(
 _TRANSFER_CHUNK = 16 * 1024 * 1024  # 16M elems = 64 MB fp32 per chunk
 
 
-def quantize_for_transfer(x: jax.Array) -> Tuple[np.ndarray, np.ndarray, int]:
+def quantize_for_transfer(
+    x: jax.Array, bits: int = 8
+) -> Tuple[np.ndarray, np.ndarray, int]:
     """Device-quantize then pull to host: the device->host (and then DCN)
-    transfer moves int8 + per-block scales instead of fp32. The returned
-    (flat int8 [blocks*BLOCK], scales [blocks], n) is exactly the layout of
+    transfer moves the quantized payload + per-block scales instead of
+    fp32. The returned (payload, scales, n) is exactly the layout of
     ``collectives.quantize_blockwise``, so the receiving host (or device,
-    via :func:`fused_dequantize_int8`) can decode it directly.
+    via :func:`fused_dequantize`) can decode it directly.
 
-    Large payloads are processed in ``_TRANSFER_CHUNK``-element slices,
-    double-buffered (the next chunk's kernel is dispatched before the
-    current pull blocks), so peak extra device memory is TWO chunks'
-    worth of intermediates. Chunks are BLOCK-aligned, so the concatenated
-    host layout is bit-identical to the single-shot path."""
+    Composition of the async pair (one implementation of the chunking /
+    trimming logic; tests pin the two paths bit-identical): dispatch all
+    chunk kernels, then pull. Per-chunk double buffering emerges from the
+    same structure — every kernel is enqueued before the first pull
+    blocks."""
+    return pull_transfer_chunks(*quantize_for_transfer_async(x, bits), bits)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "bits"))
+def _quantize_slice(flat: jax.Array, start: jax.Array, m: int, bits: int = 8):
+    """Slice + pad + quantize fused in ONE jitted computation. The slice
+    never materializes as a standalone dispatched buffer — with many
+    chunks enqueued at once (the async path), per-chunk fp32 slice copies
+    would otherwise sum to a second full-size payload of queued HBM."""
+    piece = jax.lax.dynamic_slice(flat, (start,), (m,))
+    x2d, _ = _pad_blocks(piece)
+    q, s = _quantize_rows(x2d, _bits_qmax(bits))
+    if bits == 4:
+        q = _pack_nibbles_jnp(q)
+    return q, s[:, 0]
+
+
+def quantize_for_transfer_async(
+    x: jax.Array, bits: int = 8
+) -> Tuple[list, int]:
+    """Dispatch-only half of :func:`quantize_for_transfer`: enqueues every
+    chunk's quantize kernel (async — returns as soon as XLA has the work)
+    WITHOUT pulling anything to host. Returns (chunks, n) where chunks is
+    ``[(q, s, m), ...]`` of not-yet-materialized device arrays; finish with
+    :func:`pull_transfer_chunks`, possibly on another thread.
+
+    Why two halves: the pull blocks until the kernels (and everything
+    queued before them) execute. Dispatching the kernels on the CALLER's
+    thread enqueues them immediately after the compute that produced
+    ``x`` — before the caller's next training window — so a deferred pull
+    overlaps that window instead of waiting behind it.
+
+    Peak queued HBM beyond the input: the int8+scales outputs (~1.25
+    bytes/elem total — they must coexist anyway, they ARE the payload)
+    plus ONE executing chunk's fp32 intermediates (slice/pad live only
+    inside `_quantize_slice`'s execution, not per queued chunk). At most
+    two slice-size compilations exist (full chunk + tail) since ``start``
+    is traced and only ``m`` is static.
+    """
     flat = x.reshape(-1)
     n = flat.size
     if n <= _TRANSFER_CHUNK:
-        q, s, _ = fused_quantize_int8(flat)
-        blocks = (n + BLOCK - 1) // BLOCK
-        return (
-            np.asarray(q).reshape(-1)[: blocks * BLOCK],
-            np.asarray(s)[:blocks],
-            n,
-        )
+        return [fused_quantize(flat, bits)], n
+    chunks = []
+    for start in range(0, n, _TRANSFER_CHUNK):
+        m = min(_TRANSFER_CHUNK, n - start)
+        q, s = _quantize_slice(flat, start, m, bits)
+        chunks.append((q, s, m))
+    return chunks, n
+
+
+def pull_transfer_chunks(
+    chunks: list, n: int, bits: int = 8
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pulls the device chunks from :func:`quantize_for_transfer_async` to
+    host, returning the same (q, scales, n) layout — bit-identical — as
+    :func:`quantize_for_transfer`."""
+    bpb = BLOCK // (8 // bits)
     q_parts = []
     s_parts = []
-    # Double-buffered: chunk i+1's quantize kernel is dispatched (async)
-    # before chunk i's host pull blocks, so kernel time hides under the
-    # transfer. Peak extra HBM = 2 chunks.
-    pending = []  # [(q, s, m)]
-    for start in range(0, n, _TRANSFER_CHUNK):
-        piece = flat[start : start + _TRANSFER_CHUNK]
-        pending.append(fused_quantize_int8(piece))
-        if len(pending) > 1:
-            q, s, m = pending.pop(0)
-            blocks = (m + BLOCK - 1) // BLOCK
-            q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
-            s_parts.append(np.asarray(s)[:blocks])
-            del q, s
-    q, s, m = pending.pop(0)
-    blocks = (m + BLOCK - 1) // BLOCK
-    q_parts.append(np.asarray(q).reshape(-1)[: blocks * BLOCK])
-    s_parts.append(np.asarray(s)[:blocks])
-    del q, s
+    for q, s, m in chunks:
+        blocks = (m + BLOCK - 1) // BLOCK
+        q_parts.append(np.asarray(q).reshape(-1)[: blocks * bpb])
+        s_parts.append(np.asarray(s)[:blocks])
+    if len(q_parts) == 1:
+        return q_parts[0], s_parts[0], n
     return np.concatenate(q_parts), np.concatenate(s_parts), n
 
 
@@ -242,21 +334,25 @@ def _place_chunk(buf: jax.Array, piece: jax.Array, start) -> jax.Array:
 
 
 def dequantize_from_transfer(
-    q: np.ndarray, scales: np.ndarray, n: int
+    q: np.ndarray, scales: np.ndarray, n: int, bits: int = 8
 ) -> jax.Array:
-    """Host int8 payload -> device fp32, chunked like
+    """Host quantized payload -> device fp32, chunked like
     :func:`quantize_for_transfer`: each chunk is dequantized and written
     (buffer-donated) into a preallocated output, so peak transient HBM is
     output + one chunk regardless of payload size."""
     if n <= _TRANSFER_CHUNK:
-        return fused_dequantize_int8(q, scales, n)
+        return fused_dequantize(q, scales, n, bits)
+    bpb = BLOCK // (8 // bits)
     blocks_per_chunk = _TRANSFER_CHUNK // BLOCK
     out = jnp.zeros((n,), jnp.float32)
     for start_blk in range(0, (n + BLOCK - 1) // BLOCK, blocks_per_chunk):
         start = start_blk * BLOCK
-        q_piece = q[start : (start_blk + blocks_per_chunk) * BLOCK]
+        q_piece = q[start_blk * bpb : (start_blk + blocks_per_chunk) * bpb]
         s_piece = scales[start_blk : start_blk + blocks_per_chunk]
-        m = min(q_piece.size, n - start)
-        piece = fused_dequantize_int8(q_piece, s_piece, m)
+        m = min(
+            min(q_piece.size * (8 // bits), blocks_per_chunk * BLOCK),
+            n - start,
+        )
+        piece = fused_dequantize(q_piece, s_piece, m, bits)
         out = _place_chunk(out, piece, jnp.asarray(start))
     return out

@@ -54,6 +54,15 @@ def main() -> int:
                         help="weight of LOCAL params in the post-commit merge")
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--quantize", action="store_true")
+    parser.add_argument(
+        "--quantize-bits", type=int, default=8, choices=(8, 4),
+        help="wire width for --quantize (4 = nibble-packed, half the bytes)",
+    )
+    parser.add_argument(
+        "--error-feedback", action="store_true",
+        help="carry quantization residuals into the next sync "
+        "(recommended with --quantize-bits 4)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -123,6 +132,8 @@ def main() -> int:
         fragment_sync_delay=args.fragment_sync_delay,
         fragment_update_alpha=args.fragment_update_alpha,
         should_quantize=args.quantize,
+        quantize_bits=args.quantize_bits,
+        error_feedback=args.error_feedback,
     )
 
     # Step-addressed data stream (fold_in of the loop position): stable

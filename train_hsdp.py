@@ -47,7 +47,11 @@ def main() -> int:
     parser.add_argument("--seq", type=int, default=64)
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--quantize", action="store_true",
-                        help="int8-quantize the outer gradient allreduce")
+                        help="quantize the outer gradient allreduce")
+    parser.add_argument(
+        "--quantize-bits", type=int, default=8, choices=(8, 4),
+        help="wire width for --quantize (4 = nibble-packed)",
+    )
     parser.add_argument(
         "--ckpt-transport", choices=["http", "pg-sharded"], default="http",
         help="heal transport: http = full-state fetch; pg-sharded = "
@@ -217,7 +221,9 @@ def main() -> int:
             }
             loss, grads = grad_step(params, batch)  # inner: compiled HSDP
             grads = mm.allreduce_grads(
-                grads, should_quantize=args.quantize
+                grads,
+                should_quantize=args.quantize,
+                quantize_bits=args.quantize_bits
             )  # outer: FT replica axis over DCN
             # Fenced: the commit decision + param/opt update must be one
             # critical section vs concurrent checkpoint sends (async
