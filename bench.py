@@ -146,7 +146,11 @@ def peer_main(config_path: str) -> int:
                 manager.should_commit()
             manager.start_quorum()
             frag = [grads_np[i] for i in fragments[k % len(fragments)]]
-            pending = manager.allreduce(frag, should_quantize=True)
+            pending = manager.allreduce(
+                frag,
+                should_quantize=True,
+                quantize_bits=int(cfg.get("quant_bits", 8)),
+            )
         pending.wait(timeout=float(cfg["timeout"]))
         manager.should_commit()
         for _ in range(cfg["ddp_iters"]):
@@ -208,6 +212,9 @@ def _bench() -> dict:
     # inner steps + one fragment-sized outer allreduce).
     diloco_syncs = int(os.environ.get("BENCH_DILOCO_SYNCS", 5))
     timeout = float(os.environ.get("BENCH_TIMEOUT", 300.0))
+    # Wire width of the quantized outer allreduce (8 = int8, 4 = packed
+    # int4 — half the tunnel/DCN bytes per sync).
+    quant_bits = int(os.environ.get("BENCH_QUANT_BITS", 8))
 
     n_dev = len(jax.devices())
     device_kind = jax.devices()[0].device_kind
@@ -358,6 +365,7 @@ def _bench() -> dict:
         sync_every=sync_every,
         n_fragments=n_fragments,
         diloco_syncs=diloco_syncs,
+        quant_bits=quant_bits,
         timeout=timeout,
     )
 
@@ -584,6 +592,7 @@ def _bench_ft(
     n_fragments: int,
     diloco_syncs: int,
     timeout: float,
+    quant_bits: int = 8,
 ) -> dict:
     import jax
     import numpy as np
@@ -633,6 +642,7 @@ def _bench_ft(
                     "lighthouse": lighthouse.address(),
                     "ddp_iters": ddp_warmup + ddp_steps,
                     "diloco_syncs": diloco_syncs,
+                    "quant_bits": quant_bits,
                     "bucket_cap_mb": 32.0,
                     "timeout": timeout,
                     "quorum_timeout": timeout,
@@ -677,7 +687,9 @@ def _bench_ft(
         for k0 in range(n_fragments):
             manager.start_quorum()
             manager.allreduce(
-                frag_leaves(st.params, k0), should_quantize=True
+                frag_leaves(st.params, k0),
+                should_quantize=True,
+                quantize_bits=quant_bits,
             ).wait(timeout=timeout)
             manager.should_commit()
 
@@ -697,7 +709,9 @@ def _bench_ft(
                 manager.should_commit()
             manager.start_quorum()
             pending = manager.allreduce(
-                frag_leaves(st.params, k), should_quantize=True
+                frag_leaves(st.params, k),
+                should_quantize=True,
+                quantize_bits=quant_bits,
             )
         if pending is not None:  # diloco_syncs >= 1
             t_w = time.perf_counter()
@@ -709,6 +723,7 @@ def _bench_ft(
         inner_steps = max(diloco_syncs * window, 1)
         out["diloco_ft_ms_per_step"] = round(total / inner_steps * 1e3, 2)
         out["n_fragments"] = n_fragments
+        out["quant_bits"] = quant_bits
         out["fragment_window_steps"] = window
         out["outer_exposed_wait_ms"] = round(
             float(np.mean(exposed_wait_secs)) * 1e3, 1

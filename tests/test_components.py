@@ -101,7 +101,7 @@ class _FakeManager:
     def participating_rank(self):
         return self.rank
 
-    def allreduce(self, tensors, should_quantize=False, quantize_bits=8, pre_quantized=None):
+    def allreduce(self, tensors, should_quantize=False, quantize_bits=8, on_local_quantized=None):
         from torchft_tpu.work import DummyWork
 
         arrays = [np.array(t) for t in (

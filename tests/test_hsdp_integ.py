@@ -24,7 +24,12 @@ pytestmark = pytest.mark.slow
 def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path, ckpt_transport):
     """pg-sharded runs the same kill/heal with the addressable-shard PG
     transport: the healed state never exists as a gathered host pytree
-    (checkpointing/sharded.py) — the 8B-scale heal path."""
+    (checkpointing/sharded.py) — the 8B-scale heal path.
+
+    The pg-sharded variant additionally runs the outer allreduce on the
+    int4 nibble-packed wire (--quantize --quantize-bits 4): bitwise
+    equality after a kill/heal proves the low-bit codec is deterministic
+    through quorum churn, not just in unit tests."""
     steps = 8
     lighthouse = LighthouseServer(
         bind="127.0.0.1:0",
@@ -44,7 +49,12 @@ def test_hsdp_two_groups_kill_heal_bitwise_equal(tmp_path, ckpt_transport):
                 "--min-replicas", "2",
                 "--ckpt-transport", ckpt_transport,
                 "--result-dir", result_dir,
-            ],
+            ]
+            + (
+                ["--quantize", "--quantize-bits", "4"]
+                if ckpt_transport == "pg-sharded"
+                else []
+            ),
             num_replica_groups=2,
             lighthouse_addr=lighthouse.address(),
             env={

@@ -39,10 +39,19 @@ class FakeManager:
     def start_quorum(self, **kw):
         self.quorums += 1
 
-    def allreduce(self, tensors, should_quantize=False, quantize_bits=8, pre_quantized=None):
+    def allreduce(self, tensors, should_quantize=False, quantize_bits=8, on_local_quantized=None):
         if not isinstance(tensors, (list, tuple)):
             tensors = [tensors]
         arrays = [np.array(t, dtype=np.float32) for t in tensors]
+        if should_quantize and on_local_quantized is not None:
+            # Mirror the real collective's contract: quantize the flat
+            # payload and hand (flat, q, s) to the hook (collectives.py
+            # invokes it on the collective thread right after quantize).
+            from torchft_tpu.collectives import quantize_blockwise
+
+            flat = np.concatenate([a.reshape(-1) for a in arrays])
+            q, s = quantize_blockwise(flat, quantize_bits)
+            on_local_quantized(flat, q, s)
         # Simulate averaging with a peer holding zeros: result = x / num.
         out = [a / self.num for a in arrays]
         self.allreduce_calls.append(arrays)

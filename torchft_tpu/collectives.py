@@ -16,7 +16,7 @@ quantizing on-device before the device->host pull.
 from __future__ import annotations
 
 import threading
-from typing import List, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple
 
 import numpy as np
 
@@ -368,7 +368,6 @@ def reduce_scatter_quantized(
             return flat, (0, n)
         q_host, s_host = quantize_blockwise(flat, bits)
         blocks = s_host.size
-        bpb = BLOCK // (8 // bits)
         me = pg.rank()
         counts = [len(c) for c in np.array_split(np.arange(blocks), ws)]
         starts = np.concatenate([[0], np.cumsum(counts)]) * BLOCK
@@ -381,18 +380,7 @@ def reduce_scatter_quantized(
                 acc += dequantize_blockwise(g_q, g_s, n, bits)
             shard = acc[start:end]
         else:
-            q_chunks, s_chunks = [], []
-            off = 0
-            for c in counts:
-                q_chunks.append(q_host[off * bpb : (off + c) * bpb])
-                s_chunks.append(s_host[off : off + c])
-                off += c
-            all_q = pg.alltoall(q_chunks).wait()
-            all_s = pg.alltoall(s_chunks).wait()
-            n_me = counts[me] * BLOCK
-            acc = np.zeros(n_me, np.float32)
-            for g_q, g_s in zip(all_q, all_s):
-                acc += dequantize_blockwise(g_q, g_s, n_me, bits)
+            acc = _alltoall_chunk_reduce(pg, q_host, s_host, counts, bits)
             shard = acc[: end - start]
         if op == ReduceOp.AVG:
             shard = shard / ws
@@ -424,6 +412,34 @@ def bucketize(arrays: Sequence[np.ndarray], cap_bytes: int) -> List[List[int]]:
     return buckets
 
 
+def _alltoall_chunk_reduce(
+    pg: ProcessGroup,
+    q_host: np.ndarray,
+    s_host: np.ndarray,
+    counts: "List[int]",
+    bits: int,
+) -> np.ndarray:
+    """Shared wire step of both quantized collectives: split the payload
+    into per-rank block-aligned chunks, alltoall, and dequantize-accumulate
+    every peer's contribution for MY chunk in fp32. Returns the fp32 sum of
+    this rank's chunk (counts[rank] * BLOCK values, padded)."""
+    bpb = BLOCK // (8 // bits)  # payload bytes per block
+    q_chunks, s_chunks = [], []
+    off = 0
+    for c in counts:
+        q_chunks.append(q_host[off * bpb : (off + c) * bpb])
+        s_chunks.append(s_host[off : off + c])
+        off += c
+    all_q = pg.alltoall(q_chunks).wait()
+    all_s = pg.alltoall(s_chunks).wait()
+    me = pg.rank()
+    n_me = counts[me] * BLOCK
+    acc = np.zeros(n_me, np.float32)
+    for g_q, g_s in zip(all_q, all_s):
+        acc += dequantize_blockwise(g_q, g_s, n_me, bits)
+    return acc
+
+
 def _quantized_wire_pipeline(
     pg: ProcessGroup,
     q_host: np.ndarray,
@@ -443,7 +459,6 @@ def _quantized_wire_pipeline(
     """
     ws = pg.size()
     blocks = s_host.size
-    bpb = BLOCK // (8 // bits)  # payload bytes per block (256 when packed)
     if blocks < ws:
         gathered = pg.allgather([q_host, s_host]).wait()
         acc = np.zeros(n, np.float32)
@@ -453,19 +468,7 @@ def _quantized_wire_pipeline(
     # Contiguous block-aligned chunks so each chunk owns whole scales;
     # alltoall -> rank r reduces everyone's r-th chunk.
     counts = [len(c) for c in np.array_split(np.arange(blocks), ws)]
-    q_chunks, s_chunks = [], []
-    off = 0
-    for c in counts:
-        q_chunks.append(q_host[off * bpb : (off + c) * bpb])
-        s_chunks.append(s_host[off : off + c])
-        off += c
-    all_q = pg.alltoall(q_chunks).wait()
-    all_s = pg.alltoall(s_chunks).wait()
-    me = pg.rank()
-    n_me = counts[me] * BLOCK
-    acc = np.zeros(n_me, np.float32)
-    for g_q, g_s in zip(all_q, all_s):
-        acc += dequantize_blockwise(g_q, g_s, n_me, bits)
+    acc = _alltoall_chunk_reduce(pg, q_host, s_host, counts, bits)
     rq, rs = quantize_blockwise(acc, bits)
     gathered = pg.allgather([rq, np.asarray(rs)]).wait()
     q_final = np.concatenate([g[0] for g in gathered])
@@ -478,15 +481,18 @@ def allreduce_quantized(
     arrays: Sequence[np.ndarray],
     op: ReduceOp = ReduceOp.SUM,
     bits: int = 8,
-    pre_quantized: "Tuple[np.ndarray, np.ndarray] | None" = None,
+    on_local_quantized: "Callable | None" = None,
 ) -> Work:
     """Quantized SUM/AVG allreduce, in place (reference:
     collectives.py:297-415). Returns async Work whose result is ``arrays``.
     ``bits=4`` nibble-packs the wire payload (half the bytes of int8).
 
-    ``pre_quantized=(q, scales)``: callers that already quantized the
-    concatenated payload (DiLoCo's error-feedback residual needs q anyway)
-    pass it here so the payload is quantized exactly once."""
+    ``on_local_quantized(flat, q, scales)`` is invoked on the collective
+    thread right after THIS rank's payload is quantized — DiLoCo's
+    error-feedback residual (flat - dequantize(q, s)) hooks in here, so
+    the payload is quantized exactly once and the residual math stays off
+    the training thread. The callback sees the flat that actually hit the
+    wire (zeros on a non-participating replica)."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
     ws = pg.size()
@@ -496,10 +502,9 @@ def allreduce_quantized(
     def run() -> List[np.ndarray]:
         flat, sizes = _flatten(arrays)
         n = flat.size
-        if pre_quantized is not None:
-            q_host, s_host = pre_quantized
-        else:
-            q_host, s_host = quantize_blockwise(flat, bits)
+        q_host, s_host = quantize_blockwise(flat, bits)
+        if on_local_quantized is not None:
+            on_local_quantized(flat, q_host, s_host)
         reduced = _quantized_wire_pipeline(pg, q_host, s_host, n, bits)
         if isinstance(reduced, np.ndarray):
             result = reduced

@@ -221,7 +221,7 @@ class _Fragment:
         self._pending = []
         for b_idx, idx_list in enumerate(buckets):
             flat = np.concatenate([leaves[i].reshape(-1) for i in idx_list])
-            pre_q = None
+            on_quantized = None
             if self._error_feedback and self._should_quantize:
                 # Residual (error-feedback) compensation: add the part of
                 # the previous syncs' pseudograds this replica's quantizer
@@ -234,24 +234,32 @@ class _Fragment:
                 # half a block scale per value).  Standard for <=4-bit
                 # outer syncs, where bare quantization bias accumulates
                 # across rounds.
-                from torchft_tpu.collectives import (
-                    dequantize_blockwise,
-                    quantize_blockwise,
-                )
-
+                #
+                # The residual math runs on the COLLECTIVE thread via the
+                # on_local_quantized hook (one quantize pass total, and
+                # prepare_sync stays dispatch-cheap); the write is ordered
+                # before the next prepare_sync by perform_sync's wait().
                 r = self._residuals.get(b_idx)
                 if r is not None and r.size == flat.size:
                     flat = flat + r
-                q, s = quantize_blockwise(flat, self._quantize_bits)
-                self._residuals[b_idx] = flat - dequantize_blockwise(
-                    q, s, flat.size, self._quantize_bits
-                )
-                pre_q = (q, s)  # quantized once: the allreduce reuses it
+
+                def on_quantized(
+                    wire_flat, q, s, b_idx=b_idx
+                ):  # collective thread
+                    from torchft_tpu.collectives import dequantize_blockwise
+
+                    self._residuals[b_idx] = (
+                        wire_flat
+                        - dequantize_blockwise(
+                            q, s, wire_flat.size, self._quantize_bits
+                        )
+                    )
+
             work = self._manager.allreduce(
                 flat,
                 should_quantize=self._should_quantize,
                 quantize_bits=self._quantize_bits,
-                pre_quantized=pre_q,
+                on_local_quantized=on_quantized,
             )
             self._pending.append((work, idx_list))
         self._pending_leaves = leaves

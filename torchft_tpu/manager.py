@@ -543,7 +543,7 @@ class Manager:
         tensors: Any,
         should_quantize: bool = False,
         quantize_bits: int = 8,
-        pre_quantized: Any = None,
+        on_local_quantized: Any = None,
     ) -> Work:
         """Fault-tolerant averaged allreduce across the replica axis
         (reference: manager.py:379-450). Accepts a numpy array, jax array, or
@@ -566,6 +566,12 @@ class Manager:
         )
 
         if jax_path:
+            if on_local_quantized is not None:
+                raise ValueError(
+                    "on_local_quantized is a host-path hook (numpy inputs): "
+                    "the device path quantizes in chunks on-device and has "
+                    "no single host-side (flat, q, s) moment to expose"
+                )
             if self.errored() is not None:
                 return DummyWork(items)
             try:
@@ -608,14 +614,13 @@ class Manager:
             # error already latched by _async_quorum
             return DummyWork(arrays)
         # Non-participants (healing/spares) contribute zeros
-        # (reference: manager.py:410-411).
+        # (reference: manager.py:410-411); the collective quantizes the
+        # zeroed arrays, so an error-feedback callback observes the zeros
+        # that actually hit the wire (its residual resets — same contract
+        # as a heal).
         if self._participating_rank is None:
             for a in arrays:
                 a.fill(0)
-            # A caller-supplied quantized payload was built from the
-            # UN-zeroed arrays — discard it so the wire carries the zeros
-            # (the collective re-quantizes the zeroed flat).
-            pre_quantized = None
 
         num_participants = max(self.num_participants(), 1)
         try:
@@ -626,7 +631,7 @@ class Manager:
                     self._pg,
                     arrays,
                     bits=quantize_bits,
-                    pre_quantized=pre_quantized,
+                    on_local_quantized=on_local_quantized,
                 )
             else:
                 work = self._pg.allreduce(arrays, ReduceOp.SUM)

@@ -317,10 +317,14 @@ def pull_transfer_chunks(
     bpb = BLOCK // (8 // bits)
     q_parts = []
     s_parts = []
-    for q, s, m in chunks:
+    for i, (q, s, m) in enumerate(chunks):
         blocks = (m + BLOCK - 1) // BLOCK
         q_parts.append(np.asarray(q).reshape(-1)[: blocks * bpb])
         s_parts.append(np.asarray(s)[:blocks])
+        # Release the device buffers as they are consumed: the caller's
+        # closure may keep `chunks` alive through the whole wire pipeline,
+        # and these are the payload-sized HBM allocations.
+        chunks[i] = None
     if len(q_parts) == 1:
         return q_parts[0], s_parts[0], n
     return np.concatenate(q_parts), np.concatenate(s_parts), n

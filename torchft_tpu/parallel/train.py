@@ -111,7 +111,12 @@ def init_train_state(
 # model at B=8,S=1024 are >1 GB and their log_softmax + backward dlogits
 # multiply that — the dominant HBM transient of the whole step. Chunking
 # bounds it at [B,_LOSS_CHUNK,V] (~130 MB) with jax.checkpoint recompute.
-_LOSS_CHUNK = 128
+# Env-tunable (TORCHFT_LOSS_CHUNK) so the on-chip MFU sweep can A/B chunk
+# sizes without code edits — larger chunks = fewer scan iterations and
+# bigger head matmuls at proportionally more transient HBM.
+import os as _os
+
+_LOSS_CHUNK = int(_os.environ.get("TORCHFT_LOSS_CHUNK", 128))
 
 
 def _lm_head_projection(model: Transformer, params):
