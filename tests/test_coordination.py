@@ -77,6 +77,27 @@ def test_lighthouse_http_dashboard(lighthouse) -> None:
         assert b"quorum_id" in resp.read()
 
 
+def test_lighthouse_prometheus_metrics(lighthouse) -> None:
+    """/metrics serves Prometheus text exposition with per-replica
+    heartbeat ages (exceeds the reference, which has only the HTML
+    dashboard — SURVEY §5 'No Prometheus-style metrics endpoint')."""
+    import urllib.request
+
+    client = LighthouseClient(lighthouse.address())
+    client.heartbeat("prom-replica")
+    try:
+        with urllib.request.urlopen(
+            f"http://{lighthouse.address()}/metrics", timeout=5
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+    finally:
+        client.close()
+    assert "# TYPE torchft_lighthouse_quorum_id gauge" in body
+    assert 'torchft_lighthouse_heartbeat_age_ms{replica="prom-replica"}' in body
+    assert "torchft_lighthouse_participants" in body
+
+
 def test_manager_quorum_and_heal(lighthouse) -> None:
     """Two replica groups; one lags and must heal from the other."""
     mgr_a = ManagerServer(

@@ -547,3 +547,101 @@ def test_quorum_rpc_round_trip_under_one_second(lighthouse) -> None:
         assert all(dt < 10.0 for dt in durations), durations
     finally:
         manager.shutdown()
+
+
+def test_lighthouse_outage_and_restart() -> None:
+    """Control-plane outage: the lighthouse process dies mid-training.
+    In-flight quorums fail -> both replicas' commits fail (steps are
+    discarded, training does NOT crash); when a new lighthouse comes back
+    at the SAME address, the next round's quorum transparently reconnects
+    (connections are per-call, manager_server.cc lighthouse_quorum) and
+    commits resume. The reference survives this via _quorum_with_retries
+    (manager.rs:250-306); this pins the same property end-to-end."""
+    import threading
+    import time
+
+    ws = 2
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=30000,
+        quorum_tick_ms=20,
+    )
+    addr = lh.address()
+    port = int(addr.rsplit(":", 1)[1])
+    barrier = threading.Barrier(ws + 1)  # workers + coordinator
+    results: dict = {r: [] for r in range(ws)}
+
+    def run(replica: int):
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            min_replica_size=2,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=60.0,
+            replica_id=f"lhout{replica}",
+            lighthouse_addr=addr,
+            group_rank=0,
+            group_world_size=1,
+            max_retries=5,
+        )
+        try:
+            for rnd in range(3):
+                barrier.wait(timeout=120)  # coordinator gates each round
+                # Round 1 (outage): a short per-call quorum timeout keeps
+                # the expected failure fast. Sync-mode quorum failures
+                # RAISE (reference: wait_quorum propagates); a trainer
+                # catches and falls through to the commit vote, which the
+                # latched error forces to False — the step is discarded,
+                # the loop lives on.
+                try:
+                    manager.start_quorum(timeout=6.0 if rnd == 1 else 60.0)
+                except Exception:
+                    assert manager.errored() is not None
+                arr = np.full(512, float(replica + 1), dtype=np.float32)
+                manager.allreduce(arr).wait(timeout=30)
+                committed = manager.should_commit()
+                results[replica].append((committed, float(arr[0])))
+        finally:
+            manager.shutdown()
+
+    pool = ThreadPoolExecutor(max_workers=ws)
+    try:
+        futs = [pool.submit(run, r) for r in range(ws)]
+        barrier.wait(timeout=60)  # round 0: healthy
+        time.sleep(0.1)
+        # Wait for round 0 to finish (workers block on the next barrier),
+        # then take the control plane down before releasing round 1.
+        while barrier.n_waiting < ws:
+            time.sleep(0.2)
+            for f in futs:
+                if f.done():
+                    f.result()  # surface worker crashes instead of hanging
+        lh.shutdown()
+        barrier.wait(timeout=60)  # round 1: lighthouse is GONE
+        while barrier.n_waiting < ws:
+            time.sleep(0.2)
+            for f in futs:
+                if f.done():
+                    f.result()
+        # Restart at the same address (SO_REUSEADDR in net.cc).
+        lh2 = LighthouseServer(
+            bind=f"127.0.0.1:{port}", min_replicas=2,
+            join_timeout_ms=30000, quorum_tick_ms=20,
+        )
+        try:
+            barrier.wait(timeout=60)  # round 2: control plane is back
+            for f in futs:
+                f.result(timeout=180)
+        finally:
+            lh2.shutdown()
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+
+    for r in range(ws):
+        assert len(results[r]) == 3
+        committed, avg = results[r][0]
+        assert committed and avg == 1.5, results[r]  # healthy round
+        committed, _ = results[r][1]
+        assert not committed, results[r]  # outage: discarded, no crash
+        committed, avg = results[r][2]
+        assert committed and avg == 1.5, results[r]  # recovered

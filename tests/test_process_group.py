@@ -604,3 +604,32 @@ def test_allreduce_quantized_int4_wire(store):
     assert err < 0.3, f"mean relative error too high for int4: {err}"
     for g in groups:
         g.shutdown()
+
+
+def test_reduce_scatter_quantized_int4(store):
+    """bits=4 reduce_scatter: each rank gets its block-aligned shard of
+    the fp32 sum, decoded from the nibble-packed wire."""
+    from torchft_tpu.collectives import reduce_scatter_quantized
+
+    ws = 2
+    n = 4 * 512  # 4 blocks: 2 per rank
+    groups = _make_group(store, ws, prefix="rs4")
+    rng = np.random.default_rng(13)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = data[0] + data[1]
+
+    def run(rank):
+        shard, (start, end) = reduce_scatter_quantized(
+            groups[rank], [data[rank].copy()], bits=4
+        ).wait(timeout=60)
+        return shard, start, end
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    covered = []
+    tol = 2 * max(np.abs(d).max() for d in data) / 7.0
+    for shard, start, end in results:
+        assert np.abs(shard[: end - start] - expected[start:end]).max() <= tol
+        covered.append((start, end))
+    assert covered == [(0, 1024), (1024, 2048)]
+    for g in groups:
+        g.shutdown()

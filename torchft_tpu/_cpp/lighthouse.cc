@@ -299,6 +299,61 @@ std::string Lighthouse::render_status_html() {
   return html.str();
 }
 
+static std::string prom_escape(const std::string& s) {
+  // Prometheus label values must escape backslash, double-quote, and
+  // newline — replica ids are client-supplied strings.
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+std::string Lighthouse::render_metrics() {
+  // Prometheus text exposition (the reference lighthouse has only an HTML
+  // dashboard; a scrapeable endpoint is what production monitoring needs).
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t now = now_ms();
+  std::ostringstream m;
+  m << "# HELP torchft_lighthouse_quorum_id Current quorum id.\n"
+    << "# TYPE torchft_lighthouse_quorum_id gauge\n"
+    << "torchft_lighthouse_quorum_id " << state_.quorum_id << "\n";
+  m << "# HELP torchft_lighthouse_quorum_generation Quorum broadcasts since "
+       "boot.\n"
+    << "# TYPE torchft_lighthouse_quorum_generation counter\n"
+    << "torchft_lighthouse_quorum_generation " << quorum_gen_ << "\n";
+  m << "# HELP torchft_lighthouse_participants Replicas currently waiting in "
+       "the next quorum.\n"
+    << "# TYPE torchft_lighthouse_participants gauge\n"
+    << "torchft_lighthouse_participants " << state_.participants.size()
+    << "\n";
+  m << "# HELP torchft_lighthouse_quorum_members Members of the last "
+       "delivered quorum.\n"
+    << "# TYPE torchft_lighthouse_quorum_members gauge\n"
+    << "torchft_lighthouse_quorum_members "
+    << (state_.prev_quorum ? state_.prev_quorum->participants.size() : 0)
+    << "\n";
+  m << "# HELP torchft_lighthouse_heartbeat_age_ms Milliseconds since each "
+       "replica's last heartbeat.\n"
+    << "# TYPE torchft_lighthouse_heartbeat_age_ms gauge\n";
+  for (const auto& kv : state_.heartbeats)
+    m << "torchft_lighthouse_heartbeat_age_ms{replica=\""
+      << prom_escape(kv.first) << "\"} " << (now - kv.second) << "\n";
+  if (state_.prev_quorum) {
+    m << "# HELP torchft_lighthouse_member_step Training step each quorum "
+         "member reported.\n"
+      << "# TYPE torchft_lighthouse_member_step gauge\n";
+    for (const auto& mem : state_.prev_quorum->participants)
+      m << "torchft_lighthouse_member_step{replica=\""
+        << prom_escape(mem.replica_id) << "\"} " << mem.step << "\n";
+  }
+  return m.str();
+}
+
 void Lighthouse::handle_http(int fd) {
   std::string req = read_http_request(fd, 10000);
   std::string path = "/";
@@ -316,6 +371,9 @@ void Lighthouse::handle_http(int fd) {
   } else if (path == "/status.json") {
     body = status_json().dump();
     ctype = "application/json";
+  } else if (path == "/metrics") {
+    body = render_metrics();
+    ctype = "text/plain; version=0.0.4";
   } else if (path.rfind("/replica/", 0) == 0 &&
              path.size() > 14 &&
              path.compare(path.size() - 5, 5, "/kill") == 0) {

@@ -51,6 +51,7 @@ class Lighthouse {
   Json handle_request(const Json& req, int64_t deadline_ms);
   Json quorum_rpc(const Json& req, int64_t deadline_ms);
   std::string render_status_html();
+  std::string render_metrics();
   Json status_json();
 
   std::string bind_host_;
