@@ -47,7 +47,7 @@ class LlamaConfig:
     param_dtype: Dtype = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
-    # 'dense' | 'flash' | 'ring'. flash = Pallas on-chip blocked attention
+    # 'dense' | 'flash' | 'ring' | 'ulysses'. flash = Pallas on-chip blocked attention
     # (ops/flash_attention.py, dense fallback for odd seq lens); ring
     # shards the sequence over the 'sp' mesh axis.
     attn_impl: str = "dense"
@@ -73,7 +73,7 @@ class LlamaConfig:
     # MoEMLP sows the aux term under "intermediates"; the train loss adds
     # coef * mean(aux) (parallel/train.py:_loss_fn).
     router_aux_coef: float = 0.01
-    # Bound by parallel.train when attn_impl == 'ring'.
+    # Bound by parallel.train when attn_impl is 'ring' or 'ulysses'.
     attn_fn: Optional[Callable[..., jax.Array]] = None
 
     @property
@@ -202,8 +202,10 @@ class Attention(nn.Module):
         v = dense(cfg.num_kv_heads, "wv")(x)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if cfg.attn_impl == "ring":
-            assert cfg.attn_fn is not None, "ring attention needs cfg.attn_fn"
+        if cfg.attn_impl in ("ring", "ulysses"):
+            assert cfg.attn_fn is not None, (
+                f"{cfg.attn_impl} attention needs cfg.attn_fn"
+            )
             out = cfg.attn_fn(q, k, v)
         elif cfg.attn_impl == "flash":
             from torchft_tpu.ops.flash_attention import (

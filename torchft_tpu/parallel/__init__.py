@@ -30,6 +30,9 @@ from torchft_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention,
     ring_attention_shard,
 )
+from torchft_tpu.parallel.ulysses import (  # noqa: F401
+    make_ulysses_attention,
+)
 from torchft_tpu.parallel.train import (  # noqa: F401
     TrainState,
     init_train_state,

@@ -112,7 +112,7 @@ def _check_cfg(cfg: LlamaConfig, n_stages: int) -> None:
     if cfg.num_experts > 0:
         raise ValueError("pipeline: MoE aux-loss sow is not plumbed "
                          "through shard_map; use the ep axis instead")
-    if cfg.attn_impl == "ring":
+    if cfg.attn_impl in ("ring", "ulysses"):
         raise ValueError("pipeline: compose with sp later; use dense/flash")
 
 

@@ -37,10 +37,17 @@ class TrainState:
 
 
 def build_model(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Transformer:
-    """Binds ring attention to the mesh when requested."""
+    """Binds the mesh-bound context-parallel attention when requested:
+    ``ring`` (ppermute k/v streaming) or ``ulysses`` (all-to-all
+    seq<->head re-shard; parallel/ulysses.py)."""
     if cfg.attn_impl == "ring":
         assert mesh is not None, "ring attention requires a mesh"
         cfg = dataclasses.replace(cfg, attn_fn=make_ring_attention(mesh))
+    elif cfg.attn_impl == "ulysses":
+        from torchft_tpu.parallel.ulysses import make_ulysses_attention
+
+        assert mesh is not None, "ulysses attention requires a mesh"
+        cfg = dataclasses.replace(cfg, attn_fn=make_ulysses_attention(mesh))
     return Transformer(cfg)
 
 

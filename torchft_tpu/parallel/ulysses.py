@@ -1,0 +1,140 @@
+"""Ulysses-style all-to-all sequence (context) parallelism.
+
+The second context-parallel mode next to ring attention
+(``parallel/ring_attention.py``): instead of streaming k/v blocks around
+the ICI ring, two ``all_to_all`` collectives re-shard the activations from
+sequence-sharded to head-sharded and back:
+
+    [B, S/sp, H, D]  --all_to_all-->  [B, S, H/sp, D]
+        (attention over the FULL sequence, H/sp heads per chip)
+    [B, S, H/sp, D]  --all_to_all-->  [B, S/sp, H, D]
+
+Each chip then runs ordinary (flash) attention over the full sequence for
+its head subset — no per-block online-softmax folding, and the Pallas
+flash kernel applies unmodified. Communication volume is 2 all_to_alls of
+the qkv/out activations, independent of the number of ring steps, which
+wins over the ring when heads are plentiful and sequence shards are small;
+the ring wins when H/sp < 1 would be needed or activations dominate.
+
+The reference has no context parallelism at all (SURVEY.md §2.3: CP
+delegated to the consuming trainer); both modes here are TPU-first
+designs over a mesh axis.
+
+GQA note: k/v heads are repeated up to the smallest multiple that (a)
+divides evenly over the ``sp`` axis and (b) divides the q-head count, so
+grouped-query models work at any (heads, kv_heads, sp) combination at the
+cost of the minimal kv duplication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchft_tpu.parallel.ring_attention import shard_map
+
+
+def _kv_expand_factor(h_q: int, h_kv: int, sp: int) -> int:
+    """Smallest r such that sp divides h_kv*r and h_kv*r divides h_q
+    (falls back to full MHA expansion r = h_q/h_kv)."""
+    for r in range(1, h_q // h_kv + 1):
+        hk = h_kv * r
+        if h_q % hk == 0 and hk % sp == 0:
+            return r
+    return h_q // h_kv
+
+
+def ulysses_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Per-shard Ulysses body (inside shard_map): q/k/v are the LOCAL
+    sequence shards [b, S/sp, h, D]; returns the local output shard."""
+    from torchft_tpu.models.llama import dense_attention
+    from torchft_tpu.ops.flash_attention import flash_attention, supports
+
+    sp = jax.lax.axis_size(axis_name)
+    if sp == 1:
+        # Degenerate axis: same auto-flash heuristic as the sp>1 branch,
+        # so an sp=1 mesh doesn't silently materialize S^2 dense scores.
+        flash1 = use_flash
+        if flash1 is None:
+            flash1 = causal and q.shape[1] >= 1024 and supports(q.shape[1])
+        if flash1 and supports(q.shape[1]):
+            return flash_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal)
+
+    h_q, h_kv = q.shape[2], k.shape[2]
+    assert h_q % sp == 0, (
+        f"Ulysses needs heads ({h_q}) divisible by the {axis_name} axis "
+        f"({sp}); use ring attention otherwise"
+    )
+    r = _kv_expand_factor(h_q, h_kv, sp)
+    if r > 1:
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
+
+    # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1).
+    a2a = partial(
+        jax.lax.all_to_all,
+        axis_name=axis_name,
+        split_axis=2,
+        concat_axis=1,
+        tiled=True,
+    )
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+
+    s_full = qg.shape[1]
+    flash = use_flash
+    if flash is None:
+        flash = causal and s_full >= 1024 and supports(s_full)
+    if flash:
+        out = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        out = dense_attention(qg, kg, vg, causal=causal)
+
+    # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2).
+    return jax.lax.all_to_all(
+        out.astype(q.dtype),
+        axis_name=axis_name,
+        split_axis=1,
+        concat_axis=2,
+        tiled=True,
+    )
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+    causal: bool = True,
+    use_flash: Optional[bool] = None,
+):
+    """Returns attn_fn(q, k, v) usable inside a pjit'd program — the
+    all-to-all counterpart of :func:`make_ring_attention`, same sharding
+    contract: [B, S, H, Dh] with batch over ``batch_axes``, sequence over
+    ``seq_axis``, heads over ``head_axis``."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def attn_fn(q, k, v):
+        return ulysses_attention_shard(
+            q, k, v, axis_name=seq_axis, causal=causal, use_flash=use_flash
+        )
+
+    return attn_fn
