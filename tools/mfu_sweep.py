@@ -21,18 +21,30 @@ import time
 
 
 def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
-               steps: int, warmup: int, preset: str = "small") -> dict:
+               steps: int, warmup: int, preset: str = "small",
+               loss_chunk: int = 0) -> dict:
+    import os
+
+    if loss_chunk:
+        # train.py reads TORCHFT_LOSS_CHUNK at import; set + reload so one
+        # sweep process can A/B chunk sizes.
+        os.environ["TORCHFT_LOSS_CHUNK"] = str(loss_chunk)
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from torchft_tpu.models import llama_debug, llama_small
     from torchft_tpu.parallel import auto_mesh
-    from torchft_tpu.parallel.train import (
-        build_model,
-        init_train_state,
-        make_train_step,
-    )
+    from torchft_tpu.parallel import train as train_mod
+
+    if loss_chunk:
+        import importlib
+
+        importlib.reload(train_mod)
+        assert train_mod._LOSS_CHUNK == loss_chunk
+    build_model = train_mod.build_model
+    init_train_state = train_mod.init_train_state
+    make_train_step = train_mod.make_train_step
 
     base = llama_small if preset == "small" else llama_debug
     cfg = base(
@@ -86,6 +98,7 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
         "block_q": block_q,
         "block_k": block_k,
         "remat": remat,
+        "loss_chunk": loss_chunk or None,
         "batch": [B, S],
         "ms_per_step": round(dt * 1e3, 2),
         "tokens_per_sec": round(B * S / dt, 1),
@@ -111,6 +124,9 @@ def main() -> int:
     p.add_argument("--model", choices=["small", "debug"], default="small",
                    help="debug = tiny config for CPU smoke of the sweep "
                    "harness itself")
+    p.add_argument("--loss-chunks", nargs="*", type=int, default=[],
+                   help="additionally sweep TORCHFT_LOSS_CHUNK values "
+                   "(128 is the default chunk) at the best flash config")
     args = p.parse_args()
 
     sys.path.insert(0, ".")
@@ -125,6 +141,25 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 - keep sweeping
             r = {"block_q": bq, "block_k": bk, "remat": bool(rm),
                  "error": str(e)[:200]}
+        print(json.dumps(r), flush=True)
+        if "ms_per_step" in r and (
+            best is None or r["ms_per_step"] < best["ms_per_step"]
+        ):
+            best = r
+    # Loss-chunk sweep at the best (or default) flash config. Chunk size
+    # changes the checkpointed head-scan granularity — the r02 profile
+    # lead (docs/MFU_NOTES.md suspect #1).
+    for lc in args.loss_chunks:
+        bq = best["block_q"] if best else 512
+        bk = best["block_k"] if best else 512
+        rm = best["remat"] if best else False
+        try:
+            r = run_config(
+                bq, bk, bool(rm), args.batch, args.seq,
+                args.steps, args.warmup, preset=args.model, loss_chunk=lc,
+            )
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            r = {"loss_chunk": lc, "error": str(e)[:200]}
         print(json.dumps(r), flush=True)
         if "ms_per_step" in r and (
             best is None or r["ms_per_step"] < best["ms_per_step"]
