@@ -488,3 +488,29 @@ def test_diloco_int4_error_feedback_unbiases_the_stream():
     # With EF the telescoped error is bounded by one residual, <= step/2
     # (plus fp noise).
     assert ef_err <= 0.51, ef_err
+
+
+def test_local_sgd_quantized_sync():
+    """LocalSGD can run its parameter average over the int8 quantized wire
+    (parity-plus: the reference's LocalSGD is unquantized). Sub-8-bit is
+    rejected with a pointer at DiLoCo+error_feedback: weight-magnitude
+    quantization error recurs every sync with nothing to cancel it."""
+    m = FakeManager()
+    box = Box(make_params())
+    seen = {}
+
+    orig = m.allreduce
+
+    def spy(tensors, should_quantize=False, quantize_bits=8, **kw):
+        seen["q"] = should_quantize
+        seen["bits"] = quantize_bits
+        return orig(tensors, should_quantize, quantize_bits, **kw)
+
+    m.allreduce = spy
+    ls = LocalSGD(m, box.get, box.set, sync_every=1, should_quantize=True)
+    assert ls.step() is True
+    assert seen == {"q": True, "bits": 8}
+
+    with pytest.raises(ValueError, match="DiLoCo"):
+        LocalSGD(m, box.get, box.set, sync_every=1,
+                 should_quantize=True, quantize_bits=4)

@@ -23,12 +23,6 @@ import time
 def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
                steps: int, warmup: int, preset: str = "small",
                loss_chunk: int = 0) -> dict:
-    import os
-
-    if loss_chunk:
-        # train.py reads TORCHFT_LOSS_CHUNK at import; set + reload so one
-        # sweep process can A/B chunk sizes.
-        os.environ["TORCHFT_LOSS_CHUNK"] = str(loss_chunk)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -37,11 +31,12 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
     from torchft_tpu.parallel import auto_mesh
     from torchft_tpu.parallel import train as train_mod
 
+    # _LOSS_CHUNK is read at trace time (make_train_step re-jits per
+    # config), so a direct module override A/Bs chunk sizes without env
+    # mutation or module reloads; restored in the finally below.
+    saved_chunk = train_mod._LOSS_CHUNK
     if loss_chunk:
-        import importlib
-
-        importlib.reload(train_mod)
-        assert train_mod._LOSS_CHUNK == loss_chunk
+        train_mod._LOSS_CHUNK = loss_chunk
     build_model = train_mod.build_model
     init_train_state = train_mod.init_train_state
     make_train_step = train_mod.make_train_step
@@ -93,6 +88,7 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
     flops = _flops_per_step(n_params, cfg, B, S)
     peak = _peak_tflops(kind)
     mfu = (flops / dt / 1e12) / peak if peak else None
+    train_mod._LOSS_CHUNK = saved_chunk
     del state, batch  # free HBM before the next config
     return {
         "block_q": block_q,
@@ -131,21 +127,29 @@ def main() -> int:
 
     sys.path.insert(0, ".")
     best = None
-    for spec in args.configs:
-        bq, bk, rm = (int(x) for x in spec.split("x"))
+
+    def run_and_record(best, err_tag, **cfg):
         try:
             r = run_config(
-                bq, bk, bool(rm), args.batch, args.seq,
-                args.steps, args.warmup, preset=args.model,
+                cfg.pop("bq"), cfg.pop("bk"), cfg.pop("rm"),
+                args.batch, args.seq, args.steps, args.warmup,
+                preset=args.model, **cfg,
             )
         except Exception as e:  # noqa: BLE001 - keep sweeping
-            r = {"block_q": bq, "block_k": bk, "remat": bool(rm),
-                 "error": str(e)[:200]}
+            r = dict(err_tag, error=str(e)[:200])
         print(json.dumps(r), flush=True)
         if "ms_per_step" in r and (
             best is None or r["ms_per_step"] < best["ms_per_step"]
         ):
             best = r
+        return best
+
+    for spec in args.configs:
+        bq, bk, rm = (int(x) for x in spec.split("x"))
+        best = run_and_record(
+            best, {"block_q": bq, "block_k": bk, "remat": bool(rm)},
+            bq=bq, bk=bk, rm=bool(rm),
+        )
     # Loss-chunk sweep at the best (or default) flash config. Chunk size
     # changes the checkpointed head-scan granularity — the r02 profile
     # lead (docs/MFU_NOTES.md suspect #1).
@@ -153,18 +157,10 @@ def main() -> int:
         bq = best["block_q"] if best else 512
         bk = best["block_k"] if best else 512
         rm = best["remat"] if best else False
-        try:
-            r = run_config(
-                bq, bk, bool(rm), args.batch, args.seq,
-                args.steps, args.warmup, preset=args.model, loss_chunk=lc,
-            )
-        except Exception as e:  # noqa: BLE001 - keep sweeping
-            r = {"loss_chunk": lc, "error": str(e)[:200]}
-        print(json.dumps(r), flush=True)
-        if "ms_per_step" in r and (
-            best is None or r["ms_per_step"] < best["ms_per_step"]
-        ):
-            best = r
+        best = run_and_record(
+            best, {"loss_chunk": lc},
+            bq=bq, bk=bk, rm=bool(rm), loss_chunk=lc,
+        )
     if best:
         print(json.dumps({"best": best}), flush=True)
     return 0
