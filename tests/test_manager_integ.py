@@ -645,3 +645,110 @@ def test_lighthouse_outage_and_restart() -> None:
         assert not committed, results[r]  # outage: discarded, no crash
         committed, avg = results[r][2]
         assert committed and avg == 1.5, results[r]  # recovered
+
+
+def test_quorum_retries_through_flaky_lighthouse() -> None:
+    """Reference parity (manager.rs MockLighthouse tests, 1109-1217): with
+    quorum_retries > 0, a manager rides out a lighthouse that drops the
+    first connections. A TCP proxy fronts a real lighthouse and kills the
+    first two connections; the per-attempt deadline slices in
+    manager_server.cc lighthouse_quorum must retry through it."""
+    import socket
+    import threading
+    import time
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=5000,
+        quorum_tick_ms=20,
+    )
+    real_host, real_port = lh.address().rsplit(":", 1)
+    drops = {"left": 2, "total": 0}
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    proxy_port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def pipe(a, b):
+        try:
+            while True:
+                data = a.recv(65536)
+                if not data:
+                    break
+                b.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (a, b):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            # Peek the first frame so only QUORUM connections are dropped —
+            # the heartbeat loop's persistent connection must not absorb
+            # the programmed failures (the point is exercising
+            # lighthouse_quorum's retry slices, manager.rs MockLighthouse
+            # style).
+            try:
+                conn.settimeout(5.0)
+                head = conn.recv(4096)
+            except OSError:
+                conn.close()
+                continue
+            is_quorum = b'"quorum"' in head
+            if is_quorum:
+                drops["total"] += 1
+                if drops["left"] > 0:
+                    drops["left"] -= 1
+                    conn.close()  # flaky: reset the connection outright
+                    continue
+            conn.settimeout(None)
+            try:
+                up = socket.create_connection((real_host, int(real_port)), 5)
+                up.sendall(head)  # replay the consumed bytes
+            except OSError:
+                conn.close()  # transient upstream failure: keep serving
+                continue
+            threading.Thread(target=pipe, args=(conn, up), daemon=True).start()
+            threading.Thread(target=pipe, args=(up, conn), daemon=True).start()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    manager = None
+    try:
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            min_replica_size=1,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=30.0,
+            replica_id="flaky0",
+            lighthouse_addr=f"127.0.0.1:{proxy_port}",
+            group_rank=0,
+            group_world_size=1,
+            quorum_retries=4,
+        )
+        t0 = time.monotonic()
+        manager.start_quorum()  # must survive the two dropped connections
+        arr = np.full(64, 2.0, dtype=np.float32)
+        manager.allreduce(arr).wait(timeout=30)
+        assert manager.should_commit()
+        # Both programmed drops were consumed by QUORUM connections, and a
+        # retried quorum connection then succeeded.
+        assert drops["left"] == 0 and drops["total"] >= 3, drops
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        stop.set()
+        srv.close()
+        lh.shutdown()

@@ -23,12 +23,6 @@ import time
 def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
                steps: int, warmup: int, preset: str = "small",
                loss_chunk: int = 0) -> dict:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from torchft_tpu.models import llama_debug, llama_small
-    from torchft_tpu.parallel import auto_mesh
     from torchft_tpu.parallel import train as train_mod
 
     # _LOSS_CHUNK is read at trace time (make_train_step re-jits per
@@ -37,6 +31,24 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
     saved_chunk = train_mod._LOSS_CHUNK
     if loss_chunk:
         train_mod._LOSS_CHUNK = loss_chunk
+    try:
+        return _run_config_inner(
+            train_mod, block_q, block_k, remat, B, S, steps, warmup,
+            preset, loss_chunk,
+        )
+    finally:
+        train_mod._LOSS_CHUNK = saved_chunk
+
+
+def _run_config_inner(train_mod, block_q, block_k, remat, B, S, steps,
+                      warmup, preset, loss_chunk):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu.models import llama_debug, llama_small
+    from torchft_tpu.parallel import auto_mesh
+
     build_model = train_mod.build_model
     init_train_state = train_mod.init_train_state
     make_train_step = train_mod.make_train_step
@@ -88,7 +100,6 @@ def run_config(block_q: int, block_k: int, remat: bool, B: int, S: int,
     flops = _flops_per_step(n_params, cfg, B, S)
     peak = _peak_tflops(kind)
     mfu = (flops / dt / 1e12) / peak if peak else None
-    train_mod._LOSS_CHUNK = saved_chunk
     del state, batch  # free HBM before the next config
     return {
         "block_q": block_q,

@@ -115,19 +115,14 @@ class LocalSGD:
         manager = self._manager
         manager.start_quorum()
         params = self._get()
+        # Leaves go to the manager AS-IS: Manager.allreduce itself routes
+        # all-jax quantized inputs to the on-device Pallas quantize path
+        # (int8+scales across PCIe) and hosts everything else — pulling to
+        # host here would demote quantized syncs to fp32-over-PCIe and
+        # duplicate the manager's dispatch condition.
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        if self._should_quantize and all(
-            isinstance(x, jax.Array) for x in leaves
-        ):
-            # Device leaves go straight to the manager's jax path: Pallas
-            # quantize ON DEVICE, int8+scales across PCIe (~4x fewer
-            # bytes) — pulling to host first would silently demote this
-            # to the host-quantize path and ship fp32 over PCIe.
-            flat = leaves
-        else:
-            flat = jax.tree_util.tree_leaves(_to_host(params))
         work = manager.allreduce(
-            list(flat),
+            list(leaves),
             should_quantize=self._should_quantize,
             quantize_bits=self._quantize_bits,
         )
