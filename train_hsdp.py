@@ -49,6 +49,12 @@ def main() -> int:
     parser.add_argument("--quantize", action="store_true",
                         help="quantize the outer gradient allreduce")
     parser.add_argument(
+        "--attn", choices=["default", "ring", "ulysses"], default="default",
+        help="inner-mesh attention: 'ring' (ppermute k/v streaming) or "
+        "'ulysses' (all-to-all seq<->head) context parallelism over sp; "
+        "'default' keeps the model preset's impl",
+    )
+    parser.add_argument(
         "--quantize-bits", type=int, default=8, choices=(8, 4),
         help="wire width for --quantize (4 = nibble-packed)",
     )
@@ -128,6 +134,10 @@ def main() -> int:
             "small": llama_small,
             "moe": llama_moe_debug,
         }[args.model]()
+        if args.attn != "default":
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, attn_impl=args.attn)
         model = build_model(cfg, mesh)
         state, shardings = init_train_state(
             model, mesh, jax.random.PRNGKey(0), (B, S)
