@@ -514,3 +514,25 @@ def test_local_sgd_quantized_sync():
     with pytest.raises(ValueError, match="DiLoCo"):
         LocalSGD(m, box.get, box.set, sync_every=1,
                  should_quantize=True, quantize_bits=4)
+
+
+def test_error_feedback_residuals_reset_on_heal():
+    """A healed replica's residuals tracked its PRE-heal stream; loading
+    the global state must clear them (the documented heal contract)."""
+    import optax
+
+    from torchft_tpu.local_sgd import _Fragment
+
+    m = FakeManager()
+    local = {"w": np.full((64,), -0.3, np.float32)}
+    frag = _Fragment(
+        0, m, ["w"], lambda: local, lambda p: None, optax.sgd(1.0), 0.0,
+        should_quantize=True, quantize_bits=4, error_feedback=True,
+    )
+    frag._backup = {"w": np.zeros((64,), np.float32)}
+    frag.prepare_sync()
+    frag._pending = []
+    assert frag._residuals, "EF sync must record a residual"
+    state_fn, load_fn = m.registered["DiLoCoFragment_0"]
+    load_fn(state_fn())  # heal: reload the global state
+    assert not frag._residuals

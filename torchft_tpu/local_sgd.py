@@ -197,7 +197,11 @@ class _Fragment:
     def _load_state_dict(self, state: Dict[str, Any]) -> None:
         self._backup = state["backup"]
         self._opt_state = state["opt_state"]
-        # The healed local params restart from the global state.
+        # The healed local params restart from the global state; the
+        # error-feedback residuals tracked the PRE-heal local stream, so
+        # they reset too (the documented heal contract: at most one
+        # sync's worth of this replica's own quantization error is lost).
+        self._residuals.clear()
         self._set(self._backup)
 
     @traced("torchft::local_sgd::prepare_sync")
