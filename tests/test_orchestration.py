@@ -9,6 +9,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 from torchft_tpu.coordination import LighthouseServer, ManagerServer
 from torchft_tpu.orchestration import (
@@ -184,3 +185,75 @@ def test_kill_via_lighthouse():
         if server is not None:
             server.shutdown()
         lighthouse.shutdown()
+
+
+@pytest.mark.slow
+def test_diloco_int4_ef_kill_heal_bitwise_equal(tmp_path):
+    """Streaming DiLoCo across two OS processes on the int4+error-feedback
+    wire, SIGKILL one group mid-run: the relaunched incarnation heals the
+    GLOBAL state (fragment backups + outer optimizer), the quantized sync
+    rounds re-align (min_replicas=2 lockstep), and both groups finish the
+    outer-step target with sha256-identical global state — the low-bit
+    codec and residual reset compose with heal end-to-end."""
+    import json
+
+    outer_steps = 10
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=30000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=5000,
+    )
+    result_dir = str(tmp_path / "results")
+    runner = None
+    try:
+        specs = render_topology(
+            [
+                sys.executable, "train_diloco.py",
+                "--outer-steps", str(outer_steps),
+                "--sync-every", "4",
+                "--n-fragments", "2",
+                "--fragment-sync-delay", "0",
+                "--min-replicas", "2",
+                "--quantize", "--quantize-bits", "4", "--error-feedback",
+                "--batch-size", "4", "--seq-len", "64",
+                "--result-dir", result_dir,
+            ],
+            num_replica_groups=2,
+            lighthouse_addr=lighthouse.address(),
+            env={
+                "JAX_PLATFORMS": "cpu",
+                "TORCHFT_QUORUM_TIMEOUT_SEC": "120",
+                "TORCHFT_TIMEOUT_SEC": "60",
+            },
+        )
+        runner = ReplicaGroupRunner(
+            specs, max_restarts=3, log_dir=str(tmp_path / "logs")
+        )
+        runner.start()
+        deadline = time.monotonic() + 300
+        killed = False
+        while time.monotonic() < deadline and not killed:
+            time.sleep(1.0)
+            for log in (tmp_path / "logs").glob("replica1_rank0.r0.log"):
+                if "outer_step=2" in log.read_text():
+                    assert runner.kill_group(1), "kill failed"
+                    killed = True
+                    break
+        assert killed, "group 1 never reached outer step 2 in the deadline"
+        ok = runner.run_until_done(timeout=600)
+        assert ok, f"runner did not finish cleanly (restarts={runner.restarts})"
+        assert runner.restarts[1] >= 1, "killed group was never relaunched"
+    finally:
+        if runner is not None:
+            runner.stop()
+        lighthouse.shutdown()
+
+    results = {}
+    for g in range(2):
+        with open(os.path.join(result_dir, f"group{g}.json")) as f:
+            results[g] = json.load(f)
+    assert results[0]["final_outer_step"] >= outer_steps
+    assert results[1]["final_outer_step"] >= outer_steps
+    assert results[0]["global_sha"] == results[1]["global_sha"], results

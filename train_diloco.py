@@ -43,6 +43,19 @@ from torchft_tpu.process_group import ProcessGroupSocket
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=200, help="inner steps")
+    parser.add_argument(
+        "--outer-steps", type=int, default=0,
+        help="if >0, run until manager.current_step() reaches this OUTER "
+        "step instead of a fixed inner count — the restart-safe loop (a "
+        "relaunched incarnation's inner counter restarts, but every "
+        "incarnation converges to the same outer target)",
+    )
+    parser.add_argument(
+        "--result-dir", type=str, default=None,
+        help="write group{REPLICA_GROUP_ID}.json with a sha256 over the "
+        "GLOBAL state (fragment backups + outer optimizer) at exit — the "
+        "cross-group bitwise-equality contract",
+    )
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=64)
     parser.add_argument("--inner-lr", type=float, default=3e-4)
@@ -140,7 +153,17 @@ def main() -> int:
     # across incarnations, resumable mid-stream (see _train_common).
     data_base = jax.random.PRNGKey(group_data_seed(replica_group))
     metrics = telemetry.get_metrics_logger()
-    for inner in range(args.steps):
+
+    def inner_iter():
+        if args.outer_steps > 0:
+            i = 0
+            while manager.current_step() < args.outer_steps:
+                yield i
+                i += 1
+        else:
+            yield from range(args.steps)
+
+    for inner in inner_iter():
         telemetry.trace_window(inner)
         kx = jax.random.fold_in(data_base, inner)
         x = jax.random.randint(
@@ -169,8 +192,32 @@ def main() -> int:
                     inner_step=inner,
                 )
 
+    final_outer = manager.current_step()
+    if args.result_dir:
+        import hashlib
+        import json as _json
+
+        os.makedirs(args.result_dir, exist_ok=True)
+        h = hashlib.sha256()
+        for frag in diloco.fragments:
+            for key in sorted(frag.keys):
+                for leaf in jax.tree_util.tree_leaves(frag._backup[key]):
+                    h.update(np.ascontiguousarray(
+                        np.asarray(leaf, np.float32)
+                    ).tobytes())
+            for leaf in jax.tree_util.tree_leaves(frag._opt_state):
+                h.update(np.ascontiguousarray(
+                    np.asarray(leaf, np.float32)
+                ).tobytes())
+        with open(
+            os.path.join(args.result_dir, f"group{replica_group}.json"), "w"
+        ) as f:
+            _json.dump(
+                {"final_outer_step": final_outer, "global_sha": h.hexdigest()},
+                f,
+            )
     manager.shutdown()
-    print(f"[group {replica_group}] done at outer step {manager.current_step()}")
+    print(f"[group {replica_group}] done at outer step {final_outer}")
     return 0
 
 
