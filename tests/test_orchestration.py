@@ -51,6 +51,7 @@ def test_render_topology_env():
     assert "MASTER_PORT" not in solo[0].env
 
 
+@pytest.mark.slow
 def test_chaos_runner_kills_heal_and_state_equal(tmp_path):
     """The north-star fault story, locally (VERDICT r1 item 6): 3 replica
     groups train under the keep-alive runner; two deterministic SIGKILLs
