@@ -752,3 +752,49 @@ def test_quorum_retries_through_flaky_lighthouse() -> None:
         stop.set()
         srv.close()
         lh.shutdown()
+
+
+def test_allreduce_reduce_op_sum(lighthouse) -> None:
+    """reduce_op surface parity (reference manager.py:379-450): SUM
+    returns the raw cross-replica sum; the AVG default divides by the
+    live participant count."""
+    ws = 2
+    results = {}
+
+    def run(replica: int):
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            min_replica_size=2,
+            use_async_quorum=False,
+            timeout=20.0,
+            quorum_timeout=60.0,
+            replica_id=f"rop{replica}",
+            lighthouse_addr=lighthouse.address(),
+            group_rank=0,
+            group_world_size=1,
+        )
+        try:
+            manager.start_quorum()
+            from torchft_tpu.process_group import ReduceOp
+
+            val = float(replica * 2 + 1)  # 1.0 and 3.0
+            s = manager.allreduce(
+                np.full(8, val, np.float32), reduce_op=ReduceOp.SUM
+            ).wait(timeout=30)[0]
+            a = manager.allreduce(np.full(8, val, np.float32)).wait(
+                timeout=30
+            )[0]
+            assert manager.should_commit()
+            results[replica] = (float(s[0]), float(a[0]))
+        finally:
+            manager.shutdown()
+
+    pool = ThreadPoolExecutor(max_workers=ws)
+    try:
+        futs = [pool.submit(run, r) for r in range(ws)]
+        for f in futs:
+            f.result(timeout=150)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+    assert results[0] == (4.0, 2.0), results  # sum=1+3, avg=2
+    assert results[1] == (4.0, 2.0), results

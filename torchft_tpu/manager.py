@@ -544,11 +544,13 @@ class Manager:
         should_quantize: bool = False,
         quantize_bits: int = 8,
         on_local_quantized: Any = None,
+        reduce_op: ReduceOp = ReduceOp.AVG,
     ) -> Work:
-        """Fault-tolerant averaged allreduce across the replica axis
-        (reference: manager.py:379-450). Accepts a numpy array, jax array, or
-        list thereof; result/in-place output = input averaged over live
-        participants. Returns completed-or-failed Work; errors are latched,
+        """Fault-tolerant allreduce across the replica axis (reference:
+        manager.py:379-450, same ``reduce_op`` surface: AVG divides by the
+        live participant count — the FT default, membership-change-safe —
+        and SUM returns the raw sum). Accepts a numpy array, jax array, or
+        list thereof. Returns completed-or-failed Work; errors are latched,
         never raised here.
 
         With ``should_quantize=True`` and jax-array inputs, quantization runs
@@ -560,6 +562,10 @@ class Manager:
         fp8 codec); all replicas must use the same width."""
         import jax
 
+        if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError(
+                f"manager.allreduce supports SUM/AVG, got {reduce_op}"
+            )
         items = list(tensors) if isinstance(tensors, (list, tuple)) else [tensors]
         jax_path = should_quantize and all(
             isinstance(t, jax.Array) for t in items
@@ -583,13 +589,16 @@ class Manager:
 
                 items = [jnp.zeros_like(t) for t in items]
             num_participants = max(self.num_participants(), 1)
+            scale = (
+                1.0 / num_participants if reduce_op == ReduceOp.AVG else 1.0
+            )
             try:
                 from torchft_tpu.collectives import allreduce_quantized_jax
 
                 work = allreduce_quantized_jax(
                     self._pg,
                     items,
-                    scale=1.0 / num_participants,
+                    scale=scale,
                     bits=quantize_bits,
                 )
             except Exception as e:
@@ -640,7 +649,16 @@ class Manager:
             self.report_error(e)
             return DummyWork(arrays)
 
-        return _ManagedWork(self, work, arrays, scale=1.0 / num_participants)
+        return _ManagedWork(
+            self,
+            work,
+            arrays,
+            scale=(
+                1.0 / num_participants
+                if reduce_op == ReduceOp.AVG
+                else 1.0
+            ),
+        )
 
     # ------------------------------------------------------------------
     # Errors / commit protocol
