@@ -1,0 +1,151 @@
+"""DDP quantized-wire numerical regression with golden fixtures
+(companion to test_diloco_regression.py, same harness discipline —
+reference: diloco_regression_test.py:30-127).
+
+Two replica-group threads with real Managers (C++ manager-server
+subprocesses), a real in-proc C++ lighthouse, and socket process groups
+push deterministic per-replica gradients through
+``DistributedDataParallel.allreduce_grads`` on the int4+error-feedback
+wire every step. The full per-step parameter history is pinned against a
+committed JSON fixture: silent drift in the DDP bucket path, the nibble
+codec, or the ErrorFeedback residual math fails here.
+
+The int4 wire is lossy but DETERMINISTIC (blockwise quantize -> fp32
+alltoall reduce -> allgather), so comparisons are exact, and both
+replicas must decode bitwise-identical averaged gradients.
+
+Regenerate fixtures with:  WRITE_FIXTURE=true pytest tests/test_ddp_regression.py
+"""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import List
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.ddp import DistributedDataParallel
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import ProcessGroupSocket
+
+FIXTURE_DIR = Path(__file__).parent / "fixtures"
+WRITE_FIXTURE = os.environ.get("WRITE_FIXTURE", "").lower() in ("1", "true")
+
+STEPS = 6
+N = 16  # param/grad width; spans two quantizer blocks at block size 8
+
+
+def _grad(replica: int, step: int) -> np.ndarray:
+    """Deterministic, replica-distinct, non-representable values (forces
+    real quantization error so error feedback has work to do)."""
+    base = np.sin(np.arange(N, dtype=np.float32) * 0.7 + step)
+    return ((replica + 1) * 0.1 * base).astype(np.float32)
+
+
+def _run_replica(
+    replica: int,
+    lighthouse_addr: str,
+    barrier: threading.Barrier,
+    quantize_bits: int,
+    error_feedback: bool,
+) -> List[List[float]]:
+    params = np.linspace(-2.0, 2.0, N, dtype=np.float32)
+    manager = Manager(
+        pg=ProcessGroupSocket(timeout=15.0),
+        min_replica_size=2,
+        use_async_quorum=False,
+        timeout=15.0,
+        quorum_timeout=30.0,
+        replica_id=f"ddpregr{replica}",
+        lighthouse_addr=lighthouse_addr,
+        group_rank=0,
+        group_world_size=1,
+        init_sync=False,
+    )
+    ddp = DistributedDataParallel(
+        manager,
+        error_feedback=error_feedback,
+        quantize_bits=quantize_bits,
+    )
+    history: List[List[float]] = []
+    try:
+        for step in range(STEPS):
+            barrier.wait(timeout=60)
+            manager.start_quorum()
+            out = ddp.allreduce_grads(
+                {"w": _grad(replica, step)}, should_quantize=True
+            )
+            if manager.should_commit():
+                params = params - out["w"]
+            history.append([float(v) for v in params])
+        if error_feedback:
+            assert ddp._residuals, "EF run must record bucket residuals"
+    finally:
+        manager.shutdown()
+    return history
+
+
+def _run_pair(quantize_bits: int, error_feedback: bool):
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=20000,
+        quorum_tick_ms=50,
+    )
+    barrier = threading.Barrier(2)
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = [
+                pool.submit(
+                    _run_replica,
+                    r,
+                    lighthouse.address(),
+                    barrier,
+                    quantize_bits,
+                    error_feedback,
+                )
+                for r in range(2)
+            ]
+            return [f.result(timeout=180) for f in futs]
+    finally:
+        lighthouse.shutdown()
+
+
+def _check_golden(name: str, history: List[List[float]]) -> None:
+    path = FIXTURE_DIR / f"{name}.json"
+    if WRITE_FIXTURE:
+        FIXTURE_DIR.mkdir(exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(history, f, indent=1)
+        pytest.skip(f"wrote fixture {path}")
+    assert path.exists(), (
+        f"missing fixture {path}; regenerate with WRITE_FIXTURE=true"
+    )
+    with open(path) as f:
+        golden = json.load(f)
+    assert history == golden, (
+        f"parameter history drifted from golden {name}; if the change is "
+        "intentional, regenerate with WRITE_FIXTURE=true"
+    )
+
+
+@pytest.mark.timeout(240)
+def test_ddp_golden_int4_error_feedback() -> None:
+    h0, h1 = _run_pair(quantize_bits=4, error_feedback=True)
+    assert h0 == h1, "replicas decoded different averaged gradients"
+    _check_golden("ddp_int4ef", h0)
+
+
+@pytest.mark.timeout(240)
+def test_ddp_int4_error_feedback_changes_the_stream() -> None:
+    """EF compensates each step's payload with the previous step's
+    residual, so the int4 histories with and without feedback must
+    diverge — pinning that the hook actually fires on the DDP path (a
+    silently-dropped hook would make the EF fixture vacuous)."""
+    h_ef, _ = _run_pair(quantize_bits=4, error_feedback=True)
+    h_plain, _ = _run_pair(quantize_bits=4, error_feedback=False)
+    assert h_ef != h_plain

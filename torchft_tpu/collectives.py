@@ -412,6 +412,65 @@ def bucketize(arrays: Sequence[np.ndarray], cap_bytes: int) -> List[List[int]]:
     return buckets
 
 
+class ErrorFeedback:
+    """Replica-local error-feedback residual store for quantized
+    collectives (host path).
+
+    Each sync, the caller compensates its payload with the residual the
+    previous sync's quantizer dropped, and the ``on_local_quantized``
+    hook (running on the collective thread) records what THIS
+    quantization drops.  Residuals never cross the wire — each replica
+    ships its own compensated payload — so cross-replica bitwise
+    equality of the reduced result is unaffected.
+
+    Heal safety: ``clear()`` bumps a generation counter, and a hook
+    created before the clear drops its write — an in-flight allreduce
+    issued pre-heal cannot re-insert a stale pre-heal residual after
+    the store was reset (the collective thread races the heal
+    otherwise).  Reference ceiling is 8-bit fp8 with no feedback
+    (torchft/collectives.py:297-415); feedback is what makes <=4-bit
+    wire widths usable across many rounds.
+    """
+
+    def __init__(self, bits: int) -> None:
+        self._bits = bits
+        self._residuals: dict = {}
+        self._generation = 0
+        self._lock = threading.Lock()
+
+    def compensate(self, key, flat: np.ndarray) -> np.ndarray:
+        """Returns ``flat`` plus the stored residual for ``key`` (no-op
+        when absent or shape-mismatched, e.g. after a re-bucketing)."""
+        r = self._residuals.get(key)
+        if r is not None and r.size == flat.size:
+            return flat + r
+        return flat
+
+    def make_hook(self, key) -> Callable:
+        """Builds the ``on_local_quantized(wire_flat, q, s)`` callback
+        that stores the new residual, pinned to the CURRENT generation."""
+        gen = self._generation
+
+        def on_local_quantized(wire_flat, q, s):  # collective thread
+            residual = wire_flat - dequantize_blockwise(
+                q, s, wire_flat.size, self._bits
+            )
+            with self._lock:
+                if self._generation == gen:
+                    self._residuals[key] = residual
+
+        return on_local_quantized
+
+    def clear(self) -> None:
+        """Drops all residuals AND invalidates in-flight hooks (heal)."""
+        with self._lock:
+            self._generation += 1
+            self._residuals.clear()
+
+    def __bool__(self) -> bool:
+        return bool(self._residuals)
+
+
 def _alltoall_chunk_reduce(
     pg: ProcessGroup,
     q_host: np.ndarray,
@@ -499,21 +558,31 @@ def allreduce_quantized(
     if ws <= 1:
         return DummyWork(list(arrays))
 
+    from torchft_tpu.telemetry import trace_span
+
     def run() -> List[np.ndarray]:
-        flat, sizes = _flatten(arrays)
-        n = flat.size
-        q_host, s_host = quantize_blockwise(flat, bits)
-        if on_local_quantized is not None:
-            on_local_quantized(flat, q_host, s_host)
-        reduced = _quantized_wire_pipeline(pg, q_host, s_host, n, bits)
-        if isinstance(reduced, np.ndarray):
-            result = reduced
-        else:
-            q_final, s_final = reduced
-            result = dequantize_blockwise(q_final, s_final, n, bits)
-        if op == ReduceOp.AVG:
-            result /= ws
-        _unflatten_into(arrays, result, sizes)
+        # Same span names as the device (jax) path so bench/telemetry
+        # consumers see one uniform phase decomposition: "quantize_pull"
+        # is the host quantize here (there is no device pull), "wire" the
+        # alltoall-reduce-allgather pipeline, "dequant_push" the decode +
+        # write-back.
+        with trace_span("torchft::collectives::quantize_pull"):
+            flat, sizes = _flatten(arrays)
+            n = flat.size
+            q_host, s_host = quantize_blockwise(flat, bits)
+            if on_local_quantized is not None:
+                on_local_quantized(flat, q_host, s_host)
+        with trace_span("torchft::collectives::wire"):
+            reduced = _quantized_wire_pipeline(pg, q_host, s_host, n, bits)
+        with trace_span("torchft::collectives::dequant_push"):
+            if isinstance(reduced, np.ndarray):
+                result = reduced
+            else:
+                q_final, s_final = reduced
+                result = dequantize_blockwise(q_final, s_final, n, bits)
+            if op == ReduceOp.AVG:
+                result /= ws
+            _unflatten_into(arrays, result, sizes)
         return list(arrays)
 
     return FutureWork(_spawn_collective(run))

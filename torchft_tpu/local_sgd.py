@@ -173,7 +173,9 @@ class _Fragment:
         self._should_quantize = should_quantize
         self._quantize_bits = quantize_bits
         self._error_feedback = error_feedback
-        self._residuals: Dict[int, np.ndarray] = {}
+        from torchft_tpu.collectives import ErrorFeedback
+
+        self._residuals = ErrorFeedback(quantize_bits)
         self._bucket_cap = int(bucket_cap_mb * 1024 * 1024)
 
         self._backup = _to_host(get_fragment())
@@ -201,6 +203,9 @@ class _Fragment:
         # error-feedback residuals tracked the PRE-heal local stream, so
         # they reset too (the documented heal contract: at most one
         # sync's worth of this replica's own quantization error is lost).
+        # clear() also invalidates the hooks of any allreduce still in
+        # flight from before the heal, so the collective thread can't
+        # re-insert a stale pre-heal residual after this reset.
         self._residuals.clear()
         self._set(self._backup)
 
@@ -252,35 +257,18 @@ class _Fragment:
             if self._error_feedback and self._should_quantize:
                 # Residual (error-feedback) compensation: add the part of
                 # the previous syncs' pseudograds this replica's quantizer
-                # dropped, then store what THIS quantization drops.  The
-                # wire sum stays identical across replicas (each ships its
-                # own compensated payload), so global bitwise equality is
-                # preserved; residuals are replica-local and reset on heal
-                # (a healed replica restarts with zero residual — one
-                # sync's worth of its own quantization error, bounded by
-                # half a block scale per value).  Standard for <=4-bit
-                # outer syncs, where bare quantization bias accumulates
-                # across rounds.
+                # dropped, then store what THIS quantization drops
+                # (collectives.ErrorFeedback; replica-local, preserves
+                # cross-replica bitwise equality, reset on heal).
+                # Standard for <=4-bit outer syncs, where bare
+                # quantization bias accumulates across rounds.
                 #
                 # The residual math runs on the COLLECTIVE thread via the
                 # on_local_quantized hook (one quantize pass total, and
                 # prepare_sync stays dispatch-cheap); the write is ordered
                 # before the next prepare_sync by perform_sync's wait().
-                r = self._residuals.get(b_idx)
-                if r is not None and r.size == flat.size:
-                    flat = flat + r
-
-                def on_quantized(
-                    wire_flat, q, s, b_idx=b_idx
-                ):  # collective thread
-                    from torchft_tpu.collectives import dequantize_blockwise
-
-                    self._residuals[b_idx] = (
-                        wire_flat
-                        - dequantize_blockwise(
-                            q, s, wire_flat.size, self._quantize_bits
-                        )
-                    )
+                flat = self._residuals.compensate(b_idx, flat)
+                on_quantized = self._residuals.make_hook(b_idx)
 
             work = self._manager.allreduce(
                 flat,

@@ -547,11 +547,20 @@ class Manager:
         reduce_op: ReduceOp = ReduceOp.AVG,
     ) -> Work:
         """Fault-tolerant allreduce across the replica axis (reference:
-        manager.py:379-450, same ``reduce_op`` surface: AVG divides by the
-        live participant count — the FT default, membership-change-safe —
-        and SUM returns the raw sum). Accepts a numpy array, jax array, or
-        list thereof. Returns completed-or-failed Work; errors are latched,
+        manager.py:379-450). Accepts a numpy array, jax array, or list
+        thereof. Returns completed-or-failed Work; errors are latched,
         never raised here.
+
+        .. warning:: ``reduce_op`` semantics DIVERGE from the reference
+           deliberately. The reference's default ``ReduceOp.SUM`` divides
+           the reduced tensor by ``num_participants`` afterwards (i.e. its
+           SUM *yields the average*; manager.py:430-437), and its AVG
+           delegates averaging to the process group. Here the ops mean
+           what they say: ``AVG`` (the default) divides by the live
+           participant count — the FT-correct, membership-change-safe
+           average — and ``SUM`` returns the raw unscaled sum. Code ported
+           from the reference that explicitly passes ``ReduceOp.SUM`` and
+           expects an average must pass ``ReduceOp.AVG`` here.
 
         With ``should_quantize=True`` and jax-array inputs, quantization runs
         ON DEVICE (Pallas kernels) before the device->host pull, so both the

@@ -127,7 +127,10 @@ def main() -> int:
     dh4 = dequantize_blockwise(q4_ref, s4_ref, n, bits=4)
     result["quantize_int4"] = {
         "payload_bytes_per_value": 0.5,
-        "pack_matches_host_count": int(
+        # Counts PACKED BYTES where device packing differs from the host
+        # packer (each byte holds two nibbles; same tolerance class as
+        # the int8 1-level divide flips).
+        "pack_mismatch_byte_count": int(
             (q4_dev != q4_ref.astype(np.int8)).sum()
         ),
         "dequantize_bit_exact": bool(np.array_equal(dd4, dh4)),
@@ -260,7 +263,8 @@ def main() -> int:
         and result["quantize"]["roundtrip_within_half_step"]
         and result["quantize_int4"]["dequantize_bit_exact"]
         # nibble packing may inherit the same 1-level divide flips
-        and result["quantize_int4"]["pack_matches_host_count"] <= n // 10_000
+        and result["quantize_int4"]["pack_mismatch_byte_count"]
+        <= n // 10_000
         and result["fused_reduce"]["rel_err"] < 0.02
         and result["flash_attention"]["rel_err_vs_dense"] < 0.03
         and result["flash_block_merge"]["rel_err_vs_dense"] < 0.03

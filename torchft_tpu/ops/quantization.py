@@ -260,13 +260,36 @@ def quantize_for_transfer(
     return pull_transfer_chunks(*quantize_for_transfer_async(x, bits), bits)
 
 
-@functools.partial(jax.jit, static_argnames=("m", "bits"))
-def _quantize_slice(flat: jax.Array, start: jax.Array, m: int, bits: int = 8):
-    """Slice + pad + quantize fused in ONE jitted computation. The slice
-    never materializes as a standalone dispatched buffer — with many
-    chunks enqueued at once (the async path), per-chunk fp32 slice copies
-    would otherwise sum to a second full-size payload of queued HBM."""
-    piece = jax.lax.dynamic_slice(flat, (start,), (m,))
+@functools.partial(jax.jit, static_argnames=("n_full", "bits"))
+def _quantize_row(
+    flat: jax.Array, row: jax.Array, n_full: int, bits: int = 8
+):
+    """One full-size chunk: slice + pad + quantize fused in ONE jitted
+    computation (the slice never materializes as a standalone dispatched
+    buffer — with many chunks enqueued at once, per-chunk fp32 slice
+    copies would otherwise sum to a second full-size payload of queued
+    HBM).  The chunk is addressed as a ROW of the (n_full, chunk) view
+    rather than by flat element offset: the traced index stays a small
+    int32 row number, so payloads past 2**31 elements can't silently
+    slice the wrong region (jax x64 is disabled, so a traced element
+    offset would wrap)."""
+    body = flat[: n_full * _TRANSFER_CHUNK].reshape(n_full, _TRANSFER_CHUNK)
+    piece = jax.lax.dynamic_slice(
+        body, (row, 0), (1, _TRANSFER_CHUNK)
+    ).reshape(-1)
+    x2d, _ = _pad_blocks(piece)
+    q, s = _quantize_rows(x2d, _bits_qmax(bits))
+    if bits == 4:
+        q = _pack_nibbles_jnp(q)
+    return q, s[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("start", "m", "bits"))
+def _quantize_tail(flat: jax.Array, start: int, m: int, bits: int = 8):
+    """The final partial chunk. ``start`` is STATIC (one value per flat
+    size, so no compile blowup) — a static basic-index slice carries
+    64-bit offsets and is safe past 2**31 elements."""
+    piece = flat[start : start + m]
     x2d, _ = _pad_blocks(piece)
     q, s = _quantize_rows(x2d, _bits_qmax(bits))
     if bits == 4:
@@ -292,19 +315,23 @@ def quantize_for_transfer_async(
     Peak queued HBM beyond the input: the int8+scales outputs (~1.25
     bytes/elem total — they must coexist anyway, they ARE the payload)
     plus ONE executing chunk's fp32 intermediates (slice/pad live only
-    inside `_quantize_slice`'s execution, not per queued chunk). At most
-    two slice-size compilations exist (full chunk + tail) since ``start``
-    is traced and only ``m`` is static.
+    inside `_quantize_row`'s execution, not per queued chunk). At most
+    two slice-size compilations exist per flat size (full-chunk rows,
+    where only the row INDEX is traced, + the static tail).
     """
     flat = x.reshape(-1)
     n = flat.size
     if n <= _TRANSFER_CHUNK:
         return [fused_quantize(flat, bits)], n
+    n_full = n // _TRANSFER_CHUNK
     chunks = []
-    for start in range(0, n, _TRANSFER_CHUNK):
-        m = min(_TRANSFER_CHUNK, n - start)
-        q, s = _quantize_slice(flat, start, m, bits)
-        chunks.append((q, s, m))
+    for i in range(n_full):
+        q, s = _quantize_row(flat, i, n_full, bits)
+        chunks.append((q, s, _TRANSFER_CHUNK))
+    tail = n - n_full * _TRANSFER_CHUNK
+    if tail:
+        q, s = _quantize_tail(flat, n_full * _TRANSFER_CHUNK, tail, bits)
+        chunks.append((q, s, tail))
     return chunks, n
 
 

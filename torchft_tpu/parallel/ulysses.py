@@ -95,8 +95,11 @@ def ulysses_attention_shard(
     s_full = qg.shape[1]
     flash = use_flash
     if flash is None:
-        flash = causal and s_full >= 1024 and supports(s_full)
-    if flash:
+        flash = causal and s_full >= 1024
+    # Same guard as the sp==1 branch: an explicit use_flash=True on an
+    # unsupported full-sequence length (e.g. not block-aligned) falls
+    # back to dense instead of failing inside the kernel.
+    if flash and supports(s_full):
         out = flash_attention(qg, kg, vg, causal=causal)
     else:
         out = dense_attention(qg, kg, vg, causal=causal)

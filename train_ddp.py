@@ -91,6 +91,16 @@ def main() -> int:
         help="write group{N}.json with final step + param sha256 (the "
         "kill/heal bitwise-equality check, BASELINE #3)",
     )
+    parser.add_argument("--quantize", action="store_true")
+    parser.add_argument(
+        "--quantize-bits", type=int, default=8, choices=(8, 4),
+        help="wire width for --quantize (4 = nibble-packed, half the bytes)",
+    )
+    parser.add_argument(
+        "--error-feedback", action="store_true",
+        help="carry per-bucket quantization residuals into the next step "
+        "(recommended with --quantize-bits 4)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -158,7 +168,11 @@ def main() -> int:
         group_world_size=1,
     )
     opt = OptimizerWrapper(manager, optax.adam(args.lr), params)
-    ddp = DistributedDataParallel(manager)
+    ddp = DistributedDataParallel(
+        manager,
+        error_feedback=args.error_feedback,
+        quantize_bits=args.quantize_bits,
+    )
     if batch_stats[0] is not None:
         # BatchNorm stats heal with the params so a recovered replica's
         # normalization matches its checkpoint source.
@@ -188,7 +202,8 @@ def main() -> int:
         loss, new_stats, grads = loss_and_grads(
             opt.params, batch_stats[0], x, y
         )
-        grads = ddp.allreduce_grads(grads)  # outer replica axis, over DCN
+        # Outer replica axis, over DCN (optionally int8/int4 on the wire).
+        grads = ddp.allreduce_grads(grads, should_quantize=args.quantize)
         # Stats advance inside the commit fence: a heal snapshot must
         # never pair step-N params with step-(N-1) BatchNorm stats.
         committed = opt.step(
