@@ -20,6 +20,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: the persistent XLA compile cache is deliberately NOT enabled for
+# the test suite.  On this box, reloading certain AOT-cached CPU
+# executables aborts the process outright (deterministically — e.g. the
+# pipeline train step; the cpu_aot_loader machine-feature warnings are
+# the tell), and a mid-suite hard abort is worse than slower compiles.
+# __graft_entry__.dryrun_multichip still uses the cache because its
+# parent process retries cold (cache wiped) when the child dies.
+
 # ---------------------------------------------------------------------------
 # Per-test timeouts (reference discipline: its pyproject enforces a global
 # 60s via pytest-timeout).  pytest-timeout isn't in this image, so we

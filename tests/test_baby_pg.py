@@ -151,6 +151,7 @@ def test_child_is_separate_process(store):
         _shutdown(groups)
 
 
+@pytest.mark.slow
 def test_wedged_child_killed_and_respawned(store):
     """The Baby-PG scenario: the collective layer wedges (never errors).
     wait() times out, abort() SIGKILLs the child — the trainer process
@@ -274,6 +275,7 @@ def test_shutdown_completes_while_cmd_pipe_wedged(store):
     stop_spam.set()
 
 
+@pytest.mark.slow
 def test_set_timeout_reaches_child(store):
     """set_timeout takes effect on the live child: a wedged peer now fails
     in ~2s, not the configure-time 60s."""
@@ -304,6 +306,7 @@ def test_errored_group_returns_error_work(store):
         pg.shutdown()
 
 
+@pytest.mark.slow
 def test_reconfigure_loop(store):
     """Repeated kill-and-respawn cycles stay correct (reference:
     process_group_test.py:631-665)."""

@@ -82,6 +82,7 @@ def test_http_transport_roundtrip(num_chunks):
         receiver.shutdown()
 
 
+@pytest.mark.slow
 def test_http_transport_wrong_step_and_disallow():
     sender = HTTPTransport()
     receiver = HTTPTransport()

@@ -48,6 +48,7 @@ def test_retention_keeps_latest(tmp_path):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.slow
 def test_sharded_train_state_roundtrip(tmp_path):
     """A sharded TrainState survives save -> restore INTO the same
     shardings, and training continues from the restored state."""

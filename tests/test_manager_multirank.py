@@ -233,6 +233,7 @@ def test_multirank_heal_uses_per_rank_metadata(lighthouse) -> None:
         )
 
 
+@pytest.mark.slow
 def test_multirank_single_rank_death_group_restart(lighthouse) -> None:
     """One RANK (not the whole group) dies mid-run; torchelastic semantics
     restart the whole group, which heals from the healthy group and

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from torchft_tpu.models import Transformer, llama_debug
 
@@ -151,6 +152,7 @@ class TestMoE:
             leaf = g[key]["kernel"] if key == "router" else g[key]
             assert float(jnp.max(jnp.abs(leaf))) > 0.0, key
 
+    @pytest.mark.slow
     def test_ep_sharding_rules_and_pjit_step(self):
         """Expert params shard over 'ep'; a full train step on a virtual
         mesh with ep=2 compiles and runs."""
@@ -233,6 +235,7 @@ class TestMoE:
             MoEMLP(cfg).init(jax.random.PRNGKey(0), x)
 
 
+@pytest.mark.slow
 def test_resnet50_param_count_and_variants():
     """BASELINE config #3's model: ResNet-50 v1.5 at the canonical 25.56M
     params; the CIFAR variant trains with mutable batch stats."""
