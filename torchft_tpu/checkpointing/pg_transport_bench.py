@@ -87,6 +87,71 @@ def _build_state(
     )
 
 
+def _calibrate(n_bytes: int) -> dict:
+    """Environment floor for the same byte count: raw single-stream TCP
+    loopback between two OS processes (what ANY transport pays on this
+    box before doing anything useful) and single-thread memcpy.  The
+    transport's recv wall divided by raw_tcp_s isolates FRAMEWORK
+    overhead from environment bandwidth — on a contended 1-core host the
+    GB/s number alone conflates the two (HEAL_DRILL_r03's caveat)."""
+    import socket
+
+    code = (
+        "import socket,time\n"
+        "s=socket.socket(); s.bind(('127.0.0.1',0)); s.listen(1)\n"
+        "print(s.getsockname()[1],flush=True)\n"
+        "c,_=s.accept(); t0=time.perf_counter(); n=0\n"
+        "while True:\n"
+        "    b=c.recv(1<<22)\n"
+        "    if not b: break\n"
+        "    n+=len(b)\n"
+        "print('RECV',n,time.perf_counter()-t0,flush=True)\n"
+    )
+    child = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE, text=True
+    )
+    try:
+        import select
+
+        ready, _, _ = select.select([child.stdout], [], [], 60.0)
+        if not ready:
+            raise TimeoutError("calibration receiver never printed its port")
+        port = int(child.stdout.readline())
+        buf = memoryview(bytearray(1 << 22))
+        conn = socket.create_connection(("127.0.0.1", port))
+        sent = 0
+        while sent < n_bytes:
+            m = min(len(buf), n_bytes - sent)
+            conn.sendall(buf[:m])
+            sent += m
+        conn.close()
+        tail, _ = child.communicate(timeout=600)
+        rec = [ln for ln in tail.splitlines() if ln.startswith("RECV")][-1]
+        _, got, wall = rec.split()
+        assert int(got) == n_bytes, (got, n_bytes)
+        tcp_s = float(wall)
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+    import numpy as np
+
+    m_bytes = min(n_bytes, 1 << 30)
+    src = np.ones(m_bytes, np.uint8)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dst = src.copy()
+        best = min(best, time.perf_counter() - t0)
+    del dst
+    gb = 1 << 30
+    return {
+        "raw_tcp_s": round(tcp_s, 3),
+        "raw_tcp_gb_per_s": round(n_bytes / gb / tcp_s, 3),
+        "memcpy_gb_per_s": round(m_bytes / gb / best, 3),
+    }
+
+
 def _run_receiver(args: argparse.Namespace) -> int:
     if args.sharded:
         _ensure_cpu_mesh(args.devices)
@@ -131,6 +196,12 @@ def main(argv: List[str] | None = None) -> int:
                    help="host numpy pytree, full-state transfer (default)")
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument(
+        "--calibrate", action="store_true",
+        help="also measure the environment floor for the same bytes "
+        "(raw 2-process TCP loopback + memcpy) and report the "
+        "transport's recv wall relative to it",
+    )
     p.add_argument("--store", default=None, help=argparse.SUPPRESS)
     p.add_argument("--role", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
@@ -185,6 +256,16 @@ def main(argv: List[str] | None = None) -> int:
             "gb_per_s": round(payload / (1 << 30) / peer["recv_s"], 3),
             "checksum_ok": ok,
         }
+        if args.calibrate:
+            cal = _calibrate(payload)
+            result["calibration"] = cal
+            # recv wall over the raw byte-move floor: ~1.0 means the
+            # transport is environment-bandwidth-bound (framework adds
+            # nothing); production heal time then scales as
+            # vs_raw_tcp * payload / NIC rate.
+            result["vs_raw_tcp"] = round(
+                peer["recv_s"] / cal["raw_tcp_s"], 3
+            )
         print(json.dumps(result), flush=True)
         pg.shutdown()
         return 0 if ok else 1
