@@ -532,6 +532,9 @@ def _bench_heal() -> "dict | None":
                 "torchft_tpu.checkpointing.pg_transport_bench",
                 "--size-gb", "0.25", "--leaves", "16",
                 "--sharded", "--devices", "8", "--timeout", "90",
+                # vs_raw_tcp in every recorded bench: transport recv wall
+                # over the box's raw byte-move floor (HEAL_DRILL_r04).
+                "--calibrate",
             ],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,

@@ -140,6 +140,48 @@ def test_ddp_golden_int4_error_feedback() -> None:
     _check_golden("ddp_int4ef", h0)
 
 
+def test_device_and_host_bucket_layouts_identical() -> None:
+    """The TPU device-quantize path sends one allreduce PER BUCKET so it
+    stays collective-for-collective symmetric with host-path replicas
+    (the socket PG pairs ops in issue order).  That only holds if
+    bucketize groups jax device arrays exactly as it groups their numpy
+    host copies — pin the dtype/nbytes-equivalence that symmetry rests
+    on, across mixed dtypes and a bucket-cap split."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import bucketize
+
+    leaves = [
+        jnp.ones((300_000,), jnp.float32),   # ~1.2 MB
+        jnp.ones((64,), jnp.int32),
+        jnp.ones((300_000,), jnp.float32),
+        jnp.ones((128, 128), jnp.float32),
+        jnp.ones((32,), jnp.int32),
+    ]
+    host = [np.asarray(x) for x in leaves]
+    cap = 1 * 1024 * 1024  # 1 MB: forces the fp32 leaves apart
+    assert bucketize(leaves, cap) == bucketize(host, cap)
+    assert len(bucketize(leaves, cap)) >= 3  # the cap actually split
+
+
+def test_error_feedback_width_pinned_at_construction() -> None:
+    """A per-call quantize_bits that diverges from the ctor width would
+    make the EF hook mis-decode its own wire payload — rejected loudly."""
+
+    class _NoopManager:
+        pass
+
+    ddp = DistributedDataParallel(
+        _NoopManager(), error_feedback=True, quantize_bits=4
+    )
+    with pytest.raises(ValueError, match="error-feedback width"):
+        ddp.allreduce_grads(
+            {"w": np.ones(8, np.float32)},
+            should_quantize=True,
+            quantize_bits=8,
+        )
+
+
 @pytest.mark.timeout(240)
 def test_ddp_int4_error_feedback_changes_the_stream() -> None:
     """EF compensates each step's payload with the previous step's
