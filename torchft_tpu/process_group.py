@@ -32,7 +32,7 @@ import numpy as np
 
 from torchft_tpu import _net
 from torchft_tpu.store import StoreClient
-from torchft_tpu.telemetry import flight_recorder
+from torchft_tpu.telemetry import add_bytes, flight_recorder
 from torchft_tpu.work import DummyWork, ErrorWork, FutureWork, Work
 
 import logging
@@ -157,6 +157,7 @@ class _PeerConn:
             while True:
                 header = _net.recv_json(self.sock)
                 payload = _net.recv_frame(self.sock)
+                add_bytes("pg_wire_rx", len(payload))
                 # Put under the lock so recv()'s delete-when-empty can never
                 # strand a message in an unlinked queue.
                 with self._queues_lock:
@@ -187,6 +188,10 @@ class _PeerConn:
         with self.send_lock:
             _net.send_json(self.sock, header)
             _net.send_frame(self.sock, data)
+        # Data-plane wire accounting (payload only; the JSON header is
+        # tens of bytes) — what makes the quantized codecs' byte cut
+        # measurable on any backend (telemetry.byte_stats).
+        add_bytes("pg_wire_tx", data.nbytes)
 
     def recv(self, tag: str, timeout: float) -> np.ndarray:
         try:

@@ -97,6 +97,48 @@ def reset_span_stats() -> None:
     _SPAN_STATS.reset()
 
 
+class _ByteCounters:
+    """Process-local byte accounting (e.g. data-plane wire traffic).
+
+    The quantized collectives exist to cut wire bytes; these counters
+    make the cut MEASURABLE on any backend (the reference proves its
+    codec the same way — by byte math, torchft/quantization.py) instead
+    of inferring it from tunnel-bound wall times."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def add(self, name: str, n: int) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+_BYTE_COUNTERS = _ByteCounters()
+
+
+def add_bytes(name: str, n: int) -> None:
+    """Accumulates ``n`` bytes under ``name`` (cheap; lock + dict add)."""
+    _BYTE_COUNTERS.add(name, n)
+
+
+def byte_stats() -> Dict[str, int]:
+    """Snapshot of per-counter byte totals accumulated so far."""
+    return _BYTE_COUNTERS.snapshot()
+
+
+def reset_byte_stats() -> None:
+    _BYTE_COUNTERS.reset()
+
+
 def _jax_annotation(name: str) -> Any:
     """TraceAnnotation ctx if jax's profiler is importable, else None."""
     try:
