@@ -536,3 +536,49 @@ def test_error_feedback_residuals_reset_on_heal():
     state_fn, load_fn = m.registered["DiLoCoFragment_0"]
     load_fn(state_fn())  # heal: reload the global state
     assert not frag._residuals
+
+
+def test_error_feedback_generation_guard_drops_stale_hook_writes():
+    """ADVICE r3: an in-flight allreduce issued pre-heal must not
+    re-insert a stale residual after _load_state_dict cleared the store.
+    The hook captures its creation-time generation; clear() bumps it,
+    so the late collective-thread write is dropped."""
+    import numpy as np
+
+    from torchft_tpu.collectives import ErrorFeedback, quantize_blockwise
+
+    ef = ErrorFeedback(bits=4)
+    flat = np.linspace(-1.0, 1.0, 64, dtype=np.float32)
+    q, s = quantize_blockwise(flat, bits=4)
+
+    # Normal path: hook created and fired in the same generation sticks.
+    ef.make_hook("b0")(flat, q, s)
+    assert ef and ef.compensate("b0", np.zeros(64, np.float32)).any()
+
+    # Heal path: hook created BEFORE clear(), fired after — dropped.
+    stale_hook = ef.make_hook("b1")
+    ef.clear()
+    stale_hook(flat, q, s)
+    assert not ef, "stale pre-heal hook write survived the clear()"
+    same_gen_hook = ef.make_hook("b1")
+    same_gen_hook(flat, q, s)
+    assert ef, "current-generation hook must still store"
+
+
+def test_error_feedback_compensate_guards_size_mismatch():
+    """A re-bucketing (e.g. replica-count change altering leaf grouping)
+    can change bucket sizes; a stored residual of the wrong size is
+    skipped rather than corrupting the payload."""
+    import numpy as np
+
+    from torchft_tpu.collectives import ErrorFeedback, quantize_blockwise
+
+    ef = ErrorFeedback(bits=8)
+    flat = np.ones(32, np.float32) * 0.3
+    q, s = quantize_blockwise(flat, bits=8)
+    ef.make_hook("k")(flat, q, s)
+    other = np.zeros(16, np.float32)
+    out = ef.compensate("k", other)
+    np.testing.assert_array_equal(out, other)  # untouched
+    ok = ef.compensate("k", np.zeros(32, np.float32))
+    assert ok.shape == (32,)

@@ -675,3 +675,36 @@ def test_wire_byte_accounting_quantized_vs_fp32(store):
 
     for g in groups:
         g.shutdown()
+
+
+def test_allreduce_quantized_int4_three_ranks_odd_size(store):
+    """int4 + odd world size + non-block-multiple length: the nibble-
+    packed payload must chunk across 3 ranks on BLOCK boundaries (bytes
+    per block = BLOCK/2) without mis-splitting a packed byte, and every
+    rank must decode the identical fp32 average."""
+    from torchft_tpu.collectives import allreduce_quantized
+    from torchft_tpu.process_group import ReduceOp
+
+    ws = 3
+    n = 2047  # not a block multiple; packed payload has a ragged tail
+    groups = _make_group(store, ws, prefix="q4x3")
+    rng = np.random.default_rng(21)
+    data = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+    expected = sum(d.copy() for d in data) / ws
+
+    def run(rank):
+        arr = data[rank].copy()
+        allreduce_quantized(
+            groups[rank], [arr], op=ReduceOp.AVG, bits=4
+        ).wait(timeout=60)
+        return arr
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+    # All ranks decode the same bytes -> bitwise-identical results.
+    np.testing.assert_array_equal(results[0], results[1])
+    np.testing.assert_array_equal(results[0], results[2])
+    # int4 tolerance: one quantize->dequantize round trip per value.
+    tol = 2 * max(np.abs(d).max() for d in data) / 7.0
+    np.testing.assert_allclose(results[0], expected, atol=tol)
+    for g in groups:
+        g.shutdown()
