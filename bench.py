@@ -370,6 +370,16 @@ def _bench() -> dict:
             del lstate
 
     # ---- FT loops (2-process replica pair) -------------------------------
+    # The DDP leg rides the quantized wire on TPU (where the device path
+    # shrinks the dominant device->host pull 4-8x) and fp32 on CPU
+    # (loopback wire moves at memcpy speed, so host quantize compute is
+    # a net loss there — r03 measured fp32 0.966 vs int 0.92).
+    # BENCH_DDP_QUANT=1/0 forces either way.
+    ddp_quant_env = os.environ.get("BENCH_DDP_QUANT")
+    ddp_quant = (
+        ddp_quant_env != "0" if ddp_quant_env is not None
+        else backend == "tpu"
+    )
     state_box = [state]
     del state  # _bench_ft owns the only TrainState reference now
     ft = _bench_ft(
@@ -387,6 +397,7 @@ def _bench() -> dict:
         diloco_syncs=diloco_syncs,
         quant_bits=quant_bits,
         timeout=timeout,
+        ddp_quant=ddp_quant,
     )
 
     # Re-measure the raw step AFTER the FT loops and keep the faster of
@@ -632,6 +643,7 @@ def _bench_ft(
     diloco_syncs: int,
     timeout: float,
     quant_bits: int = 8,
+    ddp_quant: bool = False,
 ) -> dict:
     import jax
     import numpy as np
@@ -643,11 +655,6 @@ def _bench_ft(
 
     out: dict = {}
     ddp_warmup = 1
-    # Per-step DDP grads ride the quantized wire by default (int8, or
-    # int4 with BENCH_QUANT_BITS=4 — on TPU the DEVICE path shrinks the
-    # device->host pull 4-8x too); BENCH_DDP_QUANT=0 restores the fp32
-    # wire for A/B.
-    ddp_quant = os.environ.get("BENCH_DDP_QUANT", "1") != "0"
     lighthouse = None
     manager = None
     peer = None
