@@ -470,7 +470,23 @@ def _bench() -> dict:
     tokens_per_sec = B * S / raw_dt
     mfu = (flops / raw_dt / 1e12) / (peak * n_dev) if peak else None
 
-    _partial_update(ft)
+    # Refresh the checkpoint's HEADLINE too: once the DiLoCo phase is
+    # in, a watchdog kill during the re-measure/heal/quorum tail must
+    # not print a line still claiming "raw loop measurement only".
+    ft_partial = dict(ft)
+    if ft.get("diloco_ft_ms_per_step"):
+        prov_ratio = raw_dt * 1e3 / ft["diloco_ft_ms_per_step"]
+        ft_partial.update(
+            {
+                "metric": "diloco_ft_throughput_ratio_vs_nofault",
+                "value": round(prov_ratio, 4),
+                "unit": "ratio, unclamped (bench killed before the "
+                "post-FT raw re-measure; ratio uses the pre-FT raw "
+                "window)",
+                "vs_baseline": round(prov_ratio / 0.95, 4),
+            }
+        )
+    _partial_update(ft_partial)
     _progress("heal bench start")
     heal = _bench_heal()
     _progress("quorum bench start")
@@ -890,8 +906,15 @@ def _bench_ft(
         # actual data-plane tx per sync vs the un-quantized fp32 payload
         # of one fragment — the codec's byte cut, measured not inferred.
         wire = telemetry.byte_stats()
-        # sizes = element counts of every param leaf (config block above)
-        frag_fp32_mb = sum(sizes) * 4 / (1 << 20) / max(n_fragments, 1)
+        # fp32 equivalent of the fragments ACTUALLY fired in the measured
+        # round-robin (fragments are only roughly equal-sized, and with
+        # syncs % n_fragments != 0 the mix is non-uniform — a mean-
+        # fragment denominator would bias the compression figure).
+        fired_fp32_bytes = sum(
+            sum(sizes[i] for i in fragments[k % len(fragments)]) * 4
+            for k in range(n_fragments, n_fragments + diloco_syncs)
+        )
+        frag_fp32_mb = fired_fp32_bytes / max(diloco_syncs, 1) / (1 << 20)
         tx_mb = wire.get("pg_wire_tx", 0) / max(diloco_syncs, 1) / (1 << 20)
         out["diloco_wire_tx_mb_per_sync"] = round(tx_mb, 2)
         out["diloco_wire_fp32_equiv_mb"] = round(frag_fp32_mb, 2)
@@ -1033,6 +1056,25 @@ def _supervised_run() -> int:
     )
     try:
         rc = child.wait(timeout=deadline)
+        if rc != 0:
+            # Child CRASHED rather than hung (this host's jax runtime
+            # can hard-abort, e.g. AOT cache reload) — the checkpoint on
+            # disk is still the honest partial artifact.
+            try:
+                with open(partial_path) as f:
+                    partial = json.load(f)
+            except (OSError, ValueError):
+                return rc  # no checkpoint: propagate the failure as-is
+            print(
+                f"bench: child crashed (rc={rc}); emitting last phase "
+                "checkpoint",
+                file=sys.stderr,
+                flush=True,
+            )
+            partial["partial"] = True
+            partial["child_rc"] = rc
+            print(json.dumps(partial), flush=True)
+            return 0
         return rc
     except subprocess.TimeoutExpired:
         print(
