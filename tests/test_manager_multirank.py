@@ -136,6 +136,60 @@ def test_multirank_quorum_allreduce_commit(lighthouse) -> None:
     np.testing.assert_allclose(by_rank[1][1], np.full(4, 11.5))
 
 
+def test_multirank_quantized_int4_allreduce(lighthouse) -> None:
+    """The nibble-packed quantized wire composes with multi-rank groups:
+    each rank slot runs its own cross-group quantized pipeline (alltoall
+    -> fp32 reduce -> requantize -> allgather), payloads must not mix
+    across slots, and the two groups in a slot must decode bitwise-
+    identical averages (single-owner requantize of each wire chunk)."""
+    stores = [TCPStoreServer() for _ in range(N_GROUPS)]
+    rng = np.random.default_rng(7)
+    payloads = {
+        rank: rng.standard_normal(1024).astype(np.float32)
+        for rank in range(GROUP_WS)
+    }
+
+    def run(group: int, rank: int):
+        manager = _make_manager(
+            lighthouse.address(), stores[group].address(), group, rank,
+            init_sync=False,
+        )
+        try:
+            manager.start_quorum()
+            # Same base payload per rank slot, scaled per group, so the
+            # slot average is known and slot mixing would be loud.
+            grad = payloads[rank] * float(group + 1)
+            out = manager.allreduce(
+                grad, should_quantize=True, quantize_bits=4
+            ).wait(timeout=20)[0]
+            assert manager.should_commit()
+            return np.asarray(out).copy()
+        finally:
+            manager.shutdown()
+
+    try:
+        results = _run_all(
+            [
+                (lambda g=g, r=r: run(g, r))
+                for g in range(N_GROUPS)
+                for r in range(GROUP_WS)
+            ]
+        )
+    finally:
+        for s in stores:
+            s.shutdown()
+
+    by_rank = {r: [] for r in range(GROUP_WS)}
+    for i, out in enumerate(results):
+        by_rank[i % GROUP_WS].append(out)
+    for rank in range(GROUP_WS):
+        a, b = by_rank[rank]
+        np.testing.assert_array_equal(a, b)  # bitwise across groups
+        expected = payloads[rank] * 1.5  # mean of x*1 and x*2
+        tol = 2 * np.abs(payloads[rank] * 2).max() / 7.0
+        np.testing.assert_allclose(a, expected, atol=tol)
+
+
 def test_multirank_commit_veto_is_group_local(lighthouse) -> None:
     """One rank's False vote vetoes its whole group's commit (the C++
     should_commit barrier, manager_server.cc), while the other group —
