@@ -104,6 +104,13 @@ void Lighthouse::tick() {
   last_reason_.clear();
   fprintf(stderr, "[lighthouse] quorum %lld formed with %zu members\n",
           static_cast<long long>(q.quorum_id), q.participants.size());
+  if (std::getenv("TORCHFT_LH_DEBUG") != nullptr) {
+    std::string ids;
+    for (const auto& m : q.participants) ids += m.replica_id + " ";
+    fprintf(stderr, "[lighthouse] +%lld formed gen=%lld members: %s\n",
+            static_cast<long long>(now_ms() % 1000000),
+            static_cast<long long>(quorum_gen_), ids.c_str());
+  }
   lk.unlock();
   cv_.notify_all();
 }
@@ -201,11 +208,18 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
     resp["error"] = Json::of("quorum request missing requester.replica_id");
     return resp;
   }
+  static const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
   std::unique_lock<std::mutex> lk(mu_);
   // Joining is an implicit heartbeat (lighthouse.rs:502-512).
   state_.heartbeats[me.replica_id] = now_ms();
   state_.participants[me.replica_id] = {me, now_ms()};
   int64_t my_gen = quorum_gen_;
+  if (debug) {
+    fprintf(stderr, "[lighthouse] +%lld register %s step=%lld gen=%lld pool=%zu\n",
+            static_cast<long long>(now_ms() % 1000000),
+            me.replica_id.c_str(), static_cast<long long>(me.step),
+            static_cast<long long>(my_gen), state_.participants.size());
+  }
   lk.unlock();
   // Proactive tick so a completing quorum doesn't wait for the next timer
   // tick (lighthouse.rs:516-518).
