@@ -1,0 +1,310 @@
+"""Reproducible OS-process fault drills (the HEAL_DRILL artifacts' harness).
+
+Each drill launches real trainer processes under the keep-alive runner
+against an in-proc C++ lighthouse, injects the fault, and prints ONE
+JSON line with the outcome. These are the exact harnesses behind
+``HEAL_DRILL_r04.json``:
+
+    python tools/drills.py soak          # 4 SIGKILLs, DDP int4+EF wire
+    python tools/drills.py elastic-up    # third group joins mid-run
+    python tools/drills.py elastic-down  # 3->2 permanent departure
+    python tools/drills.py model-heal --model moe|pipeline|ulysses
+
+Pacing matters on a 1-core box: the steady groups must run slow enough
+(big batch) that a joiner's ~40s jax import+compile lands mid-run —
+otherwise the steady groups finish first and the "drill" measures a
+harness race, not the framework (see docs/ROUND4.md §10).
+
+Run with TORCHFT_LH_DEBUG=1 to get lighthouse-side registration and
+formation tracing in stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
+from torchft_tpu.orchestration import (  # noqa: E402
+    ReplicaGroupRunner,
+    render_topology,
+)
+
+
+def _lighthouse(min_replicas: int = 2) -> LighthouseServer:
+    return LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=min_replicas,
+        join_timeout_ms=30000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=5000,
+    )
+
+
+def _specs(cmd, n_groups, lighthouse, extra_env=None, result_dir=None):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONUNBUFFERED": "1",  # step-mark detection reads live logs
+        "TORCHFT_QUORUM_TIMEOUT_SEC": "120",
+    }
+    env.update(extra_env or {})
+    full = list(cmd)
+    if result_dir:
+        full += ["--result-dir", result_dir]
+    return render_topology(
+        full,
+        num_replica_groups=n_groups,
+        lighthouse_addr=lighthouse.address(),
+        env=env,
+    )
+
+
+def _wait_step_mark(runner, log_dir, group, incarnation, marks, deadline_s):
+    """Polls the group's CURRENT incarnation log for a manager '- step N]'
+    line (these flush per line; trainer print() output sits in the child's
+    block buffer for many steps). Pumps the runner so relaunches happen
+    between kills."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        time.sleep(1.0)
+        runner.monitor_once()
+        pat = os.path.join(
+            log_dir, f"replica{group}_rank0.r{incarnation}.log"
+        )
+        for log in glob.glob(pat):
+            try:
+                text = open(log).read()
+            except OSError:
+                continue
+            if any(f"- step {s}]" in text for s in marks):
+                return True
+    return False
+
+
+def _read_results(result_dir, groups):
+    out = {}
+    for g in groups:
+        with open(os.path.join(result_dir, f"group{g}.json")) as f:
+            out[g] = json.load(f)
+    return out
+
+
+def drill_soak(args) -> dict:
+    """N SIGKILLs of one of two DDP groups on the int4+EF wire; every
+    relaunch heals from the survivor; both finish bitwise-identical."""
+    steps, kills = args.steps, args.kills
+    marks = [int(steps * (k + 0.6) / (kills + 1)) for k in range(kills)]
+    workdir = tempfile.mkdtemp(prefix="drill_soak_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(steps), "--batch-size", "8",
+                "--min-replicas", "2",
+                "--quantize", "--quantize-bits", "4", "--error-feedback",
+            ],
+            2, lighthouse, result_dir=result_dir,
+        ),
+        max_restarts=kills * 2,
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+    done_kills = 0
+    try:
+        for k in range(kills):
+            window = range(marks[k], marks[k] + 6)
+            assert _wait_step_mark(runner, log_dir, 1, done_kills, window, 600), (
+                f"group 1 never reached step {marks[k]}"
+            )
+            assert runner.kill_group(1), "kill failed"
+            done_kills += 1
+        ok = runner.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, (0, 1))
+    return {
+        "drill": "soak",
+        "kills": done_kills,
+        "clean_finish": bool(ok),
+        "restarts": dict(runner.restarts),
+        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
+        "bitwise_equal": res[0]["param_sha256"] == res[1]["param_sha256"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_elastic_up(args) -> dict:
+    """Two groups train; a third joins mid-run, heals the live state, and
+    all three finish bitwise-identical. batch 512 paces the steady groups
+    so the joiner's compile lands mid-run."""
+    steps = args.steps
+    workdir = tempfile.mkdtemp(prefix="drill_up_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    specs = _specs(
+        [
+            sys.executable, "train_ddp.py", "--model", "cnn",
+            "--steps", str(steps), "--batch-size", "512",
+            "--min-replicas", "2",
+            "--quantize", "--quantize-bits", "4", "--error-feedback",
+        ],
+        3, lighthouse, result_dir=result_dir,
+    )
+    runner = ReplicaGroupRunner(specs[:2], max_restarts=3, log_dir=log_dir)
+    late = ReplicaGroupRunner(specs[2:], max_restarts=3, log_dir=log_dir)
+    t0 = time.time()
+    runner.start()
+    try:
+        assert _wait_step_mark(runner, log_dir, 0, 0, range(5, 12), 600), (
+            "first groups never reached step 5"
+        )
+        late.start()
+        ok1 = runner.run_until_done(timeout=900)
+        ok2 = late.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        late.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, (0, 1, 2))
+    shas = [res[g]["param_sha256"] for g in range(3)]
+    return {
+        "drill": "elastic-up",
+        "clean_finish": bool(ok1 and ok2),
+        "final_steps": [res[g]["final_step"] for g in range(3)],
+        "bitwise_equal_all3": len(set(shas)) == 1,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_elastic_down(args) -> dict:
+    """Three groups train; one is SIGKILLed permanently (no restart
+    budget); the quorum shrinks 3->2 and the survivors finish
+    bitwise-identical."""
+    steps = args.steps
+    workdir = tempfile.mkdtemp(prefix="drill_dn_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(steps), "--batch-size", "512",
+                "--min-replicas", "2",
+                "--quantize", "--quantize-bits", "4", "--error-feedback",
+            ],
+            3, lighthouse, result_dir=result_dir,
+        ),
+        max_restarts=0,
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+    try:
+        assert _wait_step_mark(runner, log_dir, 2, 0, range(15, 25), 600), (
+            "group 2 never reached step 15"
+        )
+        assert runner.kill_group(2), "kill failed"
+        runner.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, (0, 1))
+    return {
+        "drill": "elastic-down",
+        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
+        "bitwise_equal_survivors": res[0]["param_sha256"]
+        == res[1]["param_sha256"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_model_heal(args) -> dict:
+    """HSDP kill/heal for a chosen parallelism family: moe (expert
+    parallelism over ep), pipeline (GPipe over pp), or ulysses
+    (all-to-all CP attention) — int4 outer wire + pg-sharded heal."""
+    model = args.model
+    cmd = [
+        sys.executable, "train_hsdp.py",
+        "--steps", "8", "--min-replicas", "2",
+        "--ckpt-transport", "pg-sharded",
+        "--quantize", "--quantize-bits", "4",
+    ]
+    cmd += (
+        ["--model", "debug", "--attn", "ulysses"]
+        if model == "ulysses"
+        else ["--model", model]
+    )
+    workdir = tempfile.mkdtemp(prefix=f"drill_{model}_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(
+            cmd, 2, lighthouse, result_dir=result_dir,
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
+            },
+        ),
+        max_restarts=3,
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+    try:
+        assert _wait_step_mark(runner, log_dir, 1, 0, range(2, 5), 600), (
+            "group 1 never reached step 2"
+        )
+        assert runner.kill_group(1), "kill failed"
+        ok = runner.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, (0, 1))
+    return {
+        "drill": f"model-heal:{model}",
+        "clean_finish": bool(ok),
+        "restarts": dict(runner.restarts),
+        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
+        "bitwise_equal": res[0]["param_sha256"] == res[1]["param_sha256"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> int:
+    os.chdir(REPO)
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="drill", required=True)
+    s = sub.add_parser("soak")
+    s.add_argument("--steps", type=int, default=100)
+    s.add_argument("--kills", type=int, default=4)
+    s = sub.add_parser("elastic-up")
+    s.add_argument("--steps", type=int, default=150)
+    s = sub.add_parser("elastic-down")
+    s.add_argument("--steps", type=int, default=120)
+    s = sub.add_parser("model-heal")
+    s.add_argument("--model", choices=["moe", "pipeline", "ulysses"],
+                   required=True)
+    args = p.parse_args()
+    fn = {
+        "soak": drill_soak,
+        "elastic-up": drill_elastic_up,
+        "elastic-down": drill_elastic_down,
+        "model-heal": drill_model_heal,
+    }[args.drill]
+    print(json.dumps(fn(args)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
