@@ -22,7 +22,6 @@ formation tracing in stderr.
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
@@ -73,28 +72,39 @@ def _wait_step_mark(runner, log_dir, group, incarnation, marks, deadline_s):
     block buffer for many steps). Pumps the runner so relaunches happen
     between kills."""
     deadline = time.time() + deadline_s
+    path = os.path.join(log_dir, f"replica{group}_rank0.r{incarnation}.log")
     while time.time() < deadline:
         time.sleep(1.0)
         runner.monitor_once()
-        pat = os.path.join(
-            log_dir, f"replica{group}_rank0.r{incarnation}.log"
-        )
-        for log in glob.glob(pat):
-            try:
-                text = open(log).read()
-            except OSError:
-                continue
-            if any(f"- step {s}]" in text for s in marks):
-                return True
+        try:
+            text = open(path).read()
+        except OSError:
+            continue
+        if any(f"- step {s}]" in text for s in marks):
+            return True
     return False
 
 
 def _read_results(result_dir, groups):
+    """Per-group result dicts, or None where a group never wrote one —
+    a failed drill must still emit its one-line JSON report, not a
+    traceback masking the failure."""
     out = {}
     for g in groups:
-        with open(os.path.join(result_dir, f"group{g}.json")) as f:
-            out[g] = json.load(f)
+        try:
+            with open(os.path.join(result_dir, f"group{g}.json")) as f:
+                out[g] = json.load(f)
+        except (OSError, ValueError):
+            out[g] = None
     return out
+
+
+def _sha(res):
+    return res.get("param_sha256") if res else None
+
+
+def _step(res):
+    return res.get("final_step") if res else None
 
 
 def drill_soak(args) -> dict:
@@ -139,8 +149,9 @@ def drill_soak(args) -> dict:
         "kills": done_kills,
         "clean_finish": bool(ok),
         "restarts": dict(runner.restarts),
-        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
-        "bitwise_equal": res[0]["param_sha256"] == res[1]["param_sha256"],
+        "final_steps": [_step(res[0]), _step(res[1])],
+        "bitwise_equal": _sha(res[0]) is not None
+        and _sha(res[0]) == _sha(res[1]),
         "wall_s": round(time.time() - t0, 1),
     }
 
@@ -171,19 +182,31 @@ def drill_elastic_up(args) -> dict:
             "first groups never reached step 5"
         )
         late.start()
-        ok1 = runner.run_until_done(timeout=900)
-        ok2 = late.run_until_done(timeout=900)
+        # One combined supervision loop: both runners' monitors (and so
+        # the joiner's restart budget) stay live until both finish.
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            r1 = runner.monitor_once()
+            r2 = late.monitor_once()
+            if not r1 and not r2:
+                break
+            time.sleep(1.0)
+        # Clean-vs-exhausted verdict comes from run_until_done (a bare
+        # monitor_once() False can also mean restarts ran out).
+        ok = runner.run_until_done(timeout=5) and late.run_until_done(
+            timeout=5
+        )
     finally:
         runner.stop()
         late.stop()
         lighthouse.shutdown()
     res = _read_results(result_dir, (0, 1, 2))
-    shas = [res[g]["param_sha256"] for g in range(3)]
+    shas = [_sha(res[g]) for g in range(3)]
     return {
         "drill": "elastic-up",
-        "clean_finish": bool(ok1 and ok2),
-        "final_steps": [res[g]["final_step"] for g in range(3)],
-        "bitwise_equal_all3": len(set(shas)) == 1,
+        "clean_finish": bool(ok),
+        "final_steps": [_step(res[g]) for g in range(3)],
+        "bitwise_equal_all3": None not in shas and len(set(shas)) == 1,
         "wall_s": round(time.time() - t0, 1),
     }
 
@@ -223,9 +246,9 @@ def drill_elastic_down(args) -> dict:
     res = _read_results(result_dir, (0, 1))
     return {
         "drill": "elastic-down",
-        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
-        "bitwise_equal_survivors": res[0]["param_sha256"]
-        == res[1]["param_sha256"],
+        "final_steps": [_step(res[0]), _step(res[1])],
+        "bitwise_equal_survivors": _sha(res[0]) is not None
+        and _sha(res[0]) == _sha(res[1]),
         "wall_s": round(time.time() - t0, 1),
     }
 
@@ -275,8 +298,9 @@ def drill_model_heal(args) -> dict:
         "drill": f"model-heal:{model}",
         "clean_finish": bool(ok),
         "restarts": dict(runner.restarts),
-        "final_steps": [res[0]["final_step"], res[1]["final_step"]],
-        "bitwise_equal": res[0]["param_sha256"] == res[1]["param_sha256"],
+        "final_steps": [_step(res[0]), _step(res[1])],
+        "bitwise_equal": _sha(res[0]) is not None
+        and _sha(res[0]) == _sha(res[1]),
         "wall_s": round(time.time() - t0, 1),
     }
 

@@ -208,7 +208,7 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
     resp["error"] = Json::of("quorum request missing requester.replica_id");
     return resp;
   }
-  static const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
+  const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
   std::unique_lock<std::mutex> lk(mu_);
   // Joining is an implicit heartbeat (lighthouse.rs:502-512).
   state_.heartbeats[me.replica_id] = now_ms();
