@@ -2,10 +2,10 @@
 # One-shot on-chip artifact collection for when the TPU tunnel is alive.
 # Produces, in order (each step is independent; later steps still run if
 # an earlier one fails):
-#   1. BENCH_TPU_r03.json   — full bench.py run on the real chip
+#   1. BENCH_TPU_r05.json   — full bench.py run on the real chip
 #   2. KERNELS_TPU.json     — compiled-mode Pallas kernel parity + latency
-#   3. profiles/tpu_r03/    — jax.profiler trace of the raw train step
-#   4. MFU_SWEEP_r03.jsonl  — flash-tile / remat sweep (tools/mfu_sweep.py)
+#   3. profiles/tpu_r05/    — jax.profiler trace of the raw train step
+#   4. MFU_SWEEP_r05.jsonl  — flash-tile / remat sweep (tools/mfu_sweep.py)
 # Run from the repo root:  bash tools/tpu_session.sh
 set -u
 cd "$(dirname "$0")/.."
@@ -16,12 +16,26 @@ if ! timeout 90 python -c "import jax; d=jax.devices(); print(d); assert d[0].pl
     echo "TPU not reachable — aborting (nothing written)"; exit 1
 fi
 
-echo "== 1. bench.py -> BENCH_TPU_r03.json =="
-timeout 2400 python bench.py > BENCH_TPU_r03.json.tmp 2> bench_tpu_r03.stderr \
-    && tail -1 BENCH_TPU_r03.json.tmp > BENCH_TPU_r03.json \
-    && rm -f BENCH_TPU_r03.json.tmp \
-    && echo "bench OK: $(cat BENCH_TPU_r03.json)" \
-    || echo "bench FAILED (see bench_tpu_r03.stderr)"
+echo "== 1. bench.py -> BENCH_TPU_r05.json =="
+# rc contract: 0 = clean; 3 = child crashed, partial artifact on stdout;
+# 4 = watchdog kill (hang), partial artifact on stdout.  All three carry
+# a valid JSON last line — promote it either way, but label 3/4 loudly.
+# Outer deadline must exceed bench.py's internal watchdog (BENCH_WATCHDOG_SEC,
+# default 2400): the watchdog is what produces the rc=4 partial artifact on a
+# mid-run hang — killing the supervisor first would discard it.
+timeout 2700 python bench.py > BENCH_TPU_r05.json.tmp 2> bench_tpu_r05.stderr
+bench_rc=$?
+if [ "$bench_rc" = 0 ] || [ "$bench_rc" = 3 ] || [ "$bench_rc" = 4 ]; then
+    tail -1 BENCH_TPU_r05.json.tmp > BENCH_TPU_r05.json \
+        && rm -f BENCH_TPU_r05.json.tmp
+    if [ "$bench_rc" = 0 ]; then
+        echo "bench OK: $(cat BENCH_TPU_r05.json)"
+    else
+        echo "bench PARTIAL (rc=$bench_rc — crash/watchdog; artifact kept): $(cat BENCH_TPU_r05.json)"
+    fi
+else
+    echo "bench FAILED (rc=$bench_rc, see bench_tpu_r05.stderr)"
+fi
 
 echo "== 2. kernel parity -> KERNELS_TPU.json =="
 timeout 900 python -m torchft_tpu.ops.bench_kernels > KERNELS_TPU.json.tmp \
@@ -30,8 +44,8 @@ timeout 900 python -m torchft_tpu.ops.bench_kernels > KERNELS_TPU.json.tmp \
     && echo "kernels OK: $(cat KERNELS_TPU.json)" \
     || echo "kernels FAILED"
 
-echo "== 3. profiler trace -> profiles/tpu_r03/ =="
-mkdir -p profiles/tpu_r03
+echo "== 3. profiler trace -> profiles/tpu_r05/ =="
+mkdir -p profiles/tpu_r05
 timeout 900 python - <<'PYEOF' || echo "trace FAILED"
 import time
 import jax, jax.numpy as jnp, numpy as np
@@ -54,16 +68,16 @@ batch = {
 for _ in range(3):
     state, m = step(state, batch)
 jax.block_until_ready(m["loss"])
-with jax.profiler.trace("profiles/tpu_r03"):
+with jax.profiler.trace("profiles/tpu_r05"):
     for _ in range(5):
         state, m = step(state, batch)
     jax.block_until_ready(m["loss"])
-print("trace OK: profiles/tpu_r03")
+print("trace OK: profiles/tpu_r05")
 PYEOF
 
-echo "== 4. MFU sweep -> MFU_SWEEP_r03.jsonl =="
-timeout 2400 python tools/mfu_sweep.py > MFU_SWEEP_r03.jsonl \
-    && echo "sweep OK:" && cat MFU_SWEEP_r03.jsonl \
+echo "== 4. MFU sweep -> MFU_SWEEP_r05.jsonl =="
+timeout 2400 python tools/mfu_sweep.py > MFU_SWEEP_r05.jsonl \
+    && echo "sweep OK:" && cat MFU_SWEEP_r05.jsonl \
     || echo "sweep FAILED (partial results kept)"
 
 echo "== done — review artifacts, then git add + commit them =="

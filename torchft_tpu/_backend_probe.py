@@ -85,13 +85,21 @@ def _write_cache(count: Optional[int], timed_out: bool) -> None:
 
 
 def probe_device_count(
-    timeout_s: float = _DEFAULT_TIMEOUT_S, use_cache: bool = True
+    timeout_s: float = _DEFAULT_TIMEOUT_S,
+    use_cache: bool = True,
+    distrust_timeout: bool = False,
 ) -> Optional[int]:
     """Returns the visible jax device count, or ``None`` when backend init
     fails or hangs past ``timeout_s`` (caller should fall back to CPU).
 
     ``TORCHFT_PROBE_TIMEOUT`` overrides the deadline;
     ``TORCHFT_PROBE_NO_CACHE=1`` forces a fresh probe.
+
+    ``distrust_timeout``: re-probe instead of trusting a cached TIMEOUT
+    verdict.  One 30s probe timeout on a loaded-but-healthy box would
+    otherwise pin every phase to CPU fallback for the full TTL — callers
+    about to spend minutes on a HEADLINE measurement should pay the
+    fresh probe; cheap gate phases keep the cached verdict.
     """
     env_timeout = os.environ.get("TORCHFT_PROBE_TIMEOUT")
     if env_timeout:
@@ -101,7 +109,9 @@ def probe_device_count(
 
     if use_cache:
         cached = _read_cache()
-        if cached is not None:
+        if cached is not None and not (
+            distrust_timeout and cached.get("timed_out")
+        ):
             count = cached["count"]
             return int(count) if count is not None else None
 

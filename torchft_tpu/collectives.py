@@ -317,10 +317,18 @@ def allreduce_quantized_jax(
             if total_scale != 1.0:
                 out = out * total_scale
             outs = rebuild(out)
-            if host_quant:
-                # CPU backend: materialize so errors latch inside the
-                # collective (the tests' error-injection contract).
-                jax.block_until_ready(outs)
+            # BOTH backends: leave the final device arrays async-dispatched.
+            # On CPU the dequantize itself already ran on the host above, so
+            # every real error class (wire, shape, quantize, reduce) has
+            # latched by this point; the only thing a block_until_ready here
+            # would add is latching execution faults of the trivial
+            # elementwise rebuild ops — and on a 1-core box it DRAINS THE
+            # DEVICE QUEUE through the caller's whole in-flight training
+            # window (measured: a 0.05 MB fragment's "dequant_push" span at
+            # 14.7 s in BENCH_r04, with a 3.1 s exposed tail in the
+            # caller's wait), turning the overlapped sync into a serialized
+            # one.  The r03 TPU rationale below now applies everywhere.
+            #
             # TPU: leave the dequantize async-dispatched. Its execution
             # naturally queues behind whatever window the caller has in
             # flight, and wait() returning a not-yet-executed array is
