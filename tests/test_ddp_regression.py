@@ -164,6 +164,66 @@ def test_device_and_host_bucket_layouts_identical() -> None:
     assert len(bucketize(leaves, cap)) >= 3  # the cap actually split
 
 
+@pytest.mark.parametrize("bits", [8, 4])
+def test_device_and_host_wire_payloads_identical(monkeypatch, bits) -> None:
+    """ADVICE r4 #4: wire symmetry between a device-path (TPU) replica
+    and a host-path (CPU) peer rests on the device path's per-bucket
+    payload matching ``quantize_blockwise`` of the concatenated host
+    flat BYTE-FOR-BYTE — layout equality alone
+    (test_device_and_host_bucket_layouts_identical) can't catch a
+    mismatched scale layout, pad handling, or nibble packing.  Drive
+    ``allreduce_quantized_jax`` down the device path (Pallas interpreter
+    via TORCHFT_FORCE_DEVICE_QUANT) for a multi-leaf bucket with odd
+    sizes (tail-block padding) and capture what reaches the wire."""
+    import jax.numpy as jnp
+
+    from torchft_tpu import collectives as C
+
+    rng = np.random.default_rng(7)
+    leaves = [
+        jnp.asarray(rng.standard_normal((37, 5)), jnp.float32),
+        jnp.asarray(rng.standard_normal((300,)), jnp.float32),
+        jnp.asarray(rng.standard_normal((641,)), jnp.float32),  # odd tail
+    ]
+
+    captured = {}
+
+    def fake_pipeline(pg, q_host, s_host, n, b):
+        captured["wire"] = (
+            np.array(q_host, copy=True),
+            np.array(s_host, copy=True),
+            int(n),
+            int(b),
+        )
+        # Tiny-payload contract: return the full fp32 local sum (peer
+        # contributes zeros), as the real pipeline does for small n.
+        return C.dequantize_blockwise(q_host, s_host, n, b)
+
+    class _PG:
+        def size(self):
+            return 2
+
+    monkeypatch.setenv("TORCHFT_FORCE_DEVICE_QUANT", "1")
+    monkeypatch.setattr(C, "_quantized_wire_pipeline", fake_pipeline)
+    work = C.allreduce_quantized_jax(_PG(), leaves, bits=bits)
+    outs = work.wait(timeout=120)
+    assert len(outs) == len(leaves)
+
+    flat_host = np.concatenate(
+        [np.asarray(x).reshape(-1).astype(np.float32) for x in leaves]
+    )
+    q_host, s_host = C.quantize_blockwise(flat_host, bits)
+    q_dev, s_dev, n_dev, bits_dev = captured["wire"]
+    assert bits_dev == bits
+    assert n_dev == flat_host.size
+    np.testing.assert_array_equal(
+        q_dev, q_host,
+        err_msg="device-path wire bytes != host quantize_blockwise "
+        "(heterogeneous TPU/CPU replica pairs would desync)",
+    )
+    np.testing.assert_allclose(s_dev, s_host, rtol=1e-6, atol=0.0)
+
+
 def test_error_feedback_width_pinned_at_construction() -> None:
     """A per-call quantize_bits that diverges from the ctor width would
     make the EF hook mis-decode its own wire payload — rejected loudly."""

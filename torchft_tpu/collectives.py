@@ -15,6 +15,7 @@ quantizing on-device before the device->host pull.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, List, Sequence, Tuple
 
@@ -62,7 +63,6 @@ def _pool():
     with _host_pool_lock:
         if _host_pool is None:
             import concurrent.futures
-            import os
 
             _host_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(8, os.cpu_count() or 4),
@@ -263,8 +263,14 @@ def allreduce_quantized_jax(
     # through the Pallas INTERPRETER — a test shim, seconds per MB — so
     # the compiled-CPU deployment path is the vectorized host quantizer
     # (same wire format bit-for-bit; the bench peer already uses it for
-    # exactly this reason).
-    host_quant = jax.default_backend() != "tpu"
+    # exactly this reason).  TORCHFT_FORCE_DEVICE_QUANT forces the
+    # device path anyway (Pallas interpreter off-TPU; a no-op on TPU,
+    # where the device path is already taken): the cross-path
+    # wire-equality test drives it.
+    force_device = os.environ.get(
+        "TORCHFT_FORCE_DEVICE_QUANT", ""
+    ).lower() in ("1", "true", "yes")
+    host_quant = jax.default_backend() != "tpu" and not force_device
 
     # Device path: dispatch the quantize kernels NOW, on the caller's
     # thread. Async dispatch returns immediately, but enqueues the kernels
