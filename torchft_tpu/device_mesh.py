@@ -22,12 +22,141 @@ mesh-relative code without touching the Manager directly.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 from jax.sharding import Mesh
 
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.manager import Manager
+
+
+class MeshView:
+    """A named-axis selection (or flattening) of a :class:`ManagedMesh` —
+    the jax translation of the reference's sub-mesh objects
+    (``ManagedDeviceMesh.__getitem__`` / ``_FlattenDeviceMesh``,
+    reference device_mesh.py:92-236).
+
+    XLA needs no sub-mesh to RUN collectives (axis names in a
+    ``PartitionSpec``/``shard_map`` are enough), so a view answers the
+    questions trainers hold a torch submesh for — sizes, coordinates,
+    composite rank — and, when the managed replica axis is part of the
+    selection, carries the outer ``allreduce_grads``.  Views are cheap,
+    immutable, and never copies of device arrays."""
+
+    def __init__(
+        self,
+        parent: "ManagedMesh",
+        names: Tuple[str, ...],
+        flat_name: Optional[str] = None,
+    ) -> None:
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis in view selection {names}")
+        for n in names:
+            if n != ManagedMesh.REPLICA_AXIS and n not in parent.mesh.shape:
+                raise KeyError(
+                    f"axis {n!r} not in {parent.axis_names} "
+                    "(flattened names resolve via mesh[name], not views)"
+                )
+        self._parent = parent
+        self.names = tuple(names)
+        self.flat_name = flat_name
+
+    # -- shape ------------------------------------------------------------
+
+    @property
+    def has_replica(self) -> bool:
+        return ManagedMesh.REPLICA_AXIS in self.names
+
+    def _axis_size(self, name: str) -> int:
+        if name == ManagedMesh.REPLICA_AXIS:
+            return self._parent.replica_size()
+        return self._parent.mesh.shape[name]
+
+    def size(self, axis: Optional[str] = None) -> int:
+        """Product over the view's axes (or one axis's extent).  A
+        flattened view's total is exactly this product — the reference's
+        ``_FlattenDeviceMesh.size`` contract."""
+        if axis is not None:
+            if axis not in self.names:
+                raise KeyError(f"axis {axis!r} not in view {self.names}")
+            return self._axis_size(axis)
+        n = 1
+        for name in self.names:
+            n *= self._axis_size(name)
+        return n
+
+    def shape(self) -> Dict[str, int]:
+        return {n: self._axis_size(n) for n in self.names}
+
+    # -- coordinates ------------------------------------------------------
+
+    def coordinate(self, device: Any = None) -> Dict[str, Optional[int]]:
+        """Per-axis coordinate: the replica axis reads the manager's live
+        participating rank (None while healing/spare); inner axes read
+        ``device``'s position in the mesh (default: this process's first
+        local device in the mesh)."""
+        coords: Dict[str, Optional[int]] = {}
+        inner = [n for n in self.names if n != ManagedMesh.REPLICA_AXIS]
+        inner_coords = (
+            self._parent.device_coordinate(device) if inner else {}
+        )
+        for n in self.names:
+            if n == ManagedMesh.REPLICA_AXIS:
+                coords[n] = self._parent.replica_rank()
+            else:
+                coords[n] = inner_coords[n]
+        return coords
+
+    def rank(self, device: Any = None) -> Optional[int]:
+        """Row-major composite rank over the view's axes (replica axis
+        included when selected — with names ``(replica, *inner)`` this is
+        the reference's ``get_local_rank(None)`` formula
+        ``inner_size * replica_rank + inner_rank``).  None while this
+        group is healing/spare (no replica rank yet)."""
+        coords = self.coordinate(device)
+        rank = 0
+        for n in self.names:
+            c = coords[n]
+            if c is None:
+                return None
+            rank = rank * self._axis_size(n) + int(c)
+        return rank
+
+    # -- jax-side helpers --------------------------------------------------
+
+    def partition_spec(self) -> Any:
+        """``PartitionSpec`` over the view's INNER axes in order (the
+        replica axis is never a compiled mesh axis — SURVEY hard-part #1
+        — so it never appears in a sharding)."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(
+            *[n for n in self.names if n != ManagedMesh.REPLICA_AXIS]
+        )
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce_grads(
+        self,
+        grads: Any,
+        should_quantize: bool = False,
+        quantize_bits: int = 8,
+    ) -> Any:
+        if not self.has_replica:
+            raise ValueError(
+                f"view {self.names} has no managed axis; inner-axis "
+                "reductions are XLA collectives (psum over the axis name "
+                "inside the compiled step), not manager collectives"
+            )
+        return self._parent.allreduce_grads(
+            grads,
+            should_quantize=should_quantize,
+            quantize_bits=quantize_bits,
+        )
+
+    def __repr__(self) -> str:
+        label = f" as {self.flat_name!r}" if self.flat_name else ""
+        return f"MeshView({self.names}{label}, shape={self.shape()})"
 
 
 class ManagedMesh:
@@ -49,6 +178,8 @@ class ManagedMesh:
         self.manager = manager
         self.mesh = mesh
         self._ddp = DistributedDataParallel(manager, bucket_cap_mb=bucket_cap_mb)
+        self._flattened: Dict[str, MeshView] = {}
+        self._coord_cache: Dict[Any, Dict[str, int]] = {}
 
     # -- shape ------------------------------------------------------------
 
@@ -78,6 +209,55 @@ class ManagedMesh:
         out.update(self.mesh.shape)
         return out
 
+    @property
+    def ndim(self) -> int:
+        """Inner axes + the managed replica axis (reference: ndim)."""
+        return len(self.mesh.axis_names) + 1
+
+    # -- selection / flattening (reference device_mesh.py:92-236) ---------
+
+    def __getitem__(
+        self, names: Union[str, Tuple[str, ...]]
+    ) -> MeshView:
+        """Sub-mesh selection by axis name(s), including the replica axis
+        and names registered by :meth:`flatten` — the reference's
+        ``ManagedDeviceMesh.__getitem__``."""
+        if isinstance(names, str):
+            if names in self._flattened:
+                return self._flattened[names]
+            names = (names,)
+        return MeshView(self, tuple(names))
+
+    def flatten(
+        self,
+        names: Optional[Sequence[str]] = None,
+        *,
+        name: str,
+    ) -> MeshView:
+        """Registers (and returns) a flattened view over ``names``
+        (default: every axis, replica first) addressable as
+        ``mesh[name]`` — the reference's ``_flatten``.  The flattened
+        size is the axes' product; the flattened rank is the row-major
+        composite (dynamic on the replica axis)."""
+        if names is None:
+            names = self.axis_names
+        if name in self.axis_names:
+            raise ValueError(
+                f"flatten name {name!r} would shadow a real axis "
+                f"({self.axis_names}) in __getitem__"
+            )
+        prior = self._flattened.get(name)
+        if prior is not None:
+            if prior.names == tuple(names):
+                return prior  # idempotent re-register
+            raise ValueError(
+                f"flatten name {name!r} already registered over "
+                f"{prior.names}; pick a distinct name"
+            )
+        view = MeshView(self, tuple(names), flat_name=name)
+        self._flattened[name] = view
+        return view
+
     # -- coordinates ------------------------------------------------------
 
     def replica_rank(self) -> Optional[int]:
@@ -85,10 +265,47 @@ class ManagedMesh:
         reference: participating_rank)."""
         return self.manager.participating_rank()
 
-    def coordinate(self) -> Dict[str, Any]:
-        return {self.REPLICA_AXIS: self.replica_rank(), **{
-            a: None for a in self.mesh.axis_names
-        }}
+    def device_coordinate(self, device: Any = None) -> Dict[str, int]:
+        """``device``'s per-axis position in the inner mesh (default:
+        this process's first local device that is in the mesh — an
+        error, not a fabricated (0,...), when none is: a silent
+        fallback would collide composite ranks across hosts).  The
+        inner-axis half of the reference's ``get_coordinate``.
+        Memoized: a device's mesh position is static."""
+        cached = self._coord_cache.get(device)
+        if cached is not None:
+            return dict(cached)
+        import numpy as np
+
+        devs = self.mesh.devices
+        key = device
+        if device is None:
+            import jax
+
+            local = set(jax.local_devices())
+            device = next((d for d in devs.flat if d in local), None)
+            if device is None:
+                raise ValueError(
+                    "none of this process's local devices are in the "
+                    f"mesh {self.mesh}; pass the device explicitly"
+                )
+        pos = np.argwhere(devs == device)
+        if len(pos) != 1:
+            raise ValueError(f"device {device} not in mesh {self.mesh}")
+        coords = {
+            a: int(i) for a, i in zip(self.mesh.axis_names, pos[0])
+        }
+        self._coord_cache[key] = coords
+        return dict(coords)
+
+    def coordinate(self, device: Any = None) -> Dict[str, Any]:
+        """Full per-axis coordinate: live replica rank (None while
+        healing/spare) + the device's inner-mesh position (reference:
+        get_coordinate, device_mesh.py:219-233)."""
+        return {
+            self.REPLICA_AXIS: self.replica_rank(),
+            **self.device_coordinate(device),
+        }
 
     # -- collectives ------------------------------------------------------
 

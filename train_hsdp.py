@@ -208,7 +208,19 @@ def main() -> int:
         max_retries=20,
     )
     mm = ft_init_device_mesh(manager, mesh=mesh)
-    logging.info("managed mesh: %r", mm)
+    # Mesh-relative views (reference ManagedDeviceMesh surface): the
+    # HSDP selection pairs the dynamic replica dim with the fsdp shard
+    # axis; "world" flattens every axis for a composite rank/size.
+    hsdp_view = mm[("replica", "fsdp")]
+    world = mm.flatten(name="world")
+    logging.info(
+        "managed mesh: %r; hsdp view %s (size %d); world size %d rank %s",
+        mm,
+        hsdp_view.shape(),
+        hsdp_view.size(),
+        world.size(),
+        world.rank(),
+    )
 
     from torchft_tpu import telemetry
 
