@@ -1,0 +1,172 @@
+"""Chip-free MFU candidate ranking: compile the flagship step at bench
+shapes on the VIRTUAL backend and rank the tuning candidates by
+HLO-level evidence (XLA cost analysis + memory analysis), so scarce
+live-tunnel minutes are spent MEASURING the top candidate instead of
+exploring (VERDICT r4 next #3).
+
+What is and is not knowable off-chip:
+
+- ``TORCHFT_LOSS_CHUNK`` and ``remat``: fully XLA-visible.  The chunked
+  vocab-loss scan and rematerialization change REAL flops (recompute)
+  and transient memory; ``Compiled.cost_analysis()`` /
+  ``memory_analysis()`` expose both.  Dense attention is used for these
+  candidates so the whole program is XLA HLO (the flash Pallas call is
+  an opaque custom call to cost analysis, and on CPU it would lower
+  through the interpreter anyway).
+- Flash tile sizes (``flash_block_q/k``): NOT XLA-visible off-chip —
+  tile choice changes the Pallas grid schedule and VMEM residency, not
+  the HLO flop/byte totals.  They are ranked analytically (documented
+  in docs/MFU_NOTES.md): per-tile VMEM ~ (bq*d + 2*bk*d + bq*bk)*2
+  bytes must sit well under ~16 MB VMEM, and fewer K-passes win until
+  the accumulator tile spills.
+
+Run (CPU, ~minutes — each candidate is a full flagship compile):
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/mfu_cost_rank.py > MFU_COST_RANK.jsonl
+
+Prints one JSON line per candidate plus a final ``ranking`` line; the
+ranked order feeds tools/mfu_sweep.py's default grid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cost(compiled) -> dict:
+    """flops/bytes from XLA cost analysis + temp bytes from memory
+    analysis, tolerant of backends that return lists or partial keys."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out["flops"] = float(ca.get("flops", 0.0))
+        out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception as e:  # noqa: BLE001 - record, don't die
+        out["cost_error"] = str(e)[:120]
+    try:
+        ma = compiled.memory_analysis()
+        out["temp_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0))
+        out["argument_bytes"] = int(
+            getattr(ma, "argument_size_in_bytes", 0)
+        )
+        out["output_bytes"] = int(getattr(ma, "output_size_in_bytes", 0))
+    except Exception as e:  # noqa: BLE001
+        out["memory_error"] = str(e)[:120]
+    return out
+
+
+def run_candidate(loss_chunk: int, remat: bool, B: int, S: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu.models import llama_small
+    from torchft_tpu.parallel import auto_mesh
+    from torchft_tpu.parallel import train as train_mod
+
+    saved = train_mod._LOSS_CHUNK
+    if loss_chunk:
+        train_mod._LOSS_CHUNK = loss_chunk
+    try:
+        # Dense attention: keeps the whole program XLA-visible (see
+        # module docstring); the flash-vs-dense choice itself is a
+        # separate, on-chip-only axis.
+        cfg = llama_small(remat=remat, attn_impl="dense")
+        mesh = auto_mesh(1)
+        model = train_mod.build_model(cfg, mesh)
+        state, shardings = train_mod.init_train_state(
+            model, mesh, jax.random.PRNGKey(0), (B, S)
+        )
+        step = train_mod.make_train_step(model, mesh, shardings)
+        rng = np.random.default_rng(0)
+        batch = {
+            "inputs": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32
+            ),
+            "targets": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32
+            ),
+            "mask": jnp.ones((B, S), jnp.int32),
+        }
+        t0 = time.perf_counter()
+        lowered = jax.jit(step, donate_argnums=(0,)).lower(state, batch)
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        rec = {
+            "loss_chunk": loss_chunk or train_mod._LOSS_CHUNK,
+            "remat": remat,
+            "B": B,
+            "S": S,
+            "compile_s": round(compile_s, 1),
+        }
+        rec.update(_cost(compiled))
+        return rec
+    finally:
+        train_mod._LOSS_CHUNK = saved
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument(
+        "--chunks", type=str, default="128,256,512",
+        help="comma-separated TORCHFT_LOSS_CHUNK candidates",
+    )
+    args = p.parse_args()
+
+    chunks = [int(c) for c in args.chunks.split(",") if c]
+    records = []
+    for remat in (False, True):
+        for chunk in chunks:
+            try:
+                rec = run_candidate(chunk, remat, args.batch, args.seq)
+            except Exception as e:  # noqa: BLE001 - rank what compiled
+                rec = {
+                    "loss_chunk": chunk,
+                    "remat": remat,
+                    "error": str(e)[:200],
+                }
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    # Rank: fewest flops first (recompute is pure overhead on a
+    # flop-bound step), then smallest bytes accessed (HBM pressure),
+    # temp bytes reported for the fits-in-HBM check the on-chip run
+    # makes.  Errors sink to the bottom.
+    def key(r):
+        return (
+            "error" in r,
+            r.get("flops", float("inf")),
+            r.get("bytes_accessed", float("inf")),
+        )
+
+    ranked = sorted(records, key=key)
+    print(
+        json.dumps(
+            {
+                "ranking": [
+                    {
+                        "loss_chunk": r.get("loss_chunk"),
+                        "remat": r.get("remat"),
+                        "flops": r.get("flops"),
+                        "bytes_accessed": r.get("bytes_accessed"),
+                        "temp_bytes": r.get("temp_bytes"),
+                    }
+                    for r in ranked
+                ]
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
