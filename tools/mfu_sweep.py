@@ -120,9 +120,16 @@ def main() -> int:
     p.add_argument(
         "--configs",
         nargs="*",
-        default=["512x512x0", "256x512x0", "512x1024x0", "256x1024x0",
-                 "1024x512x0", "512x512x1"],
-        help="BQxBKxREMAT triples",
+        # Order = the chip-free ranking (tools/mfu_cost_rank.py +
+        # docs/MFU_NOTES.md, r05): larger flash tiles first (fewer
+        # K-passes; the analytic VMEM budget admits them at S=1024),
+        # current default as the baseline draw, remat=1 last (scan-
+        # corrected cost analysis prices it +4.8% flops for -54% bytes
+        # — only wins if the step profiles bandwidth-bound).  Scarce
+        # tunnel minutes measure candidates top-down.
+        default=["512x1024x0", "1024x512x0", "1024x1024x0", "512x512x0",
+                 "256x1024x0", "512x512x1"],
+        help="BQxBKxREMAT triples, best-candidate-first",
     )
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=1024)
@@ -131,9 +138,11 @@ def main() -> int:
     p.add_argument("--model", choices=["small", "debug"], default="small",
                    help="debug = tiny config for CPU smoke of the sweep "
                    "harness itself")
-    p.add_argument("--loss-chunks", nargs="*", type=int, default=[],
-                   help="additionally sweep TORCHFT_LOSS_CHUNK values "
-                   "(128 is the default chunk) at the best flash config")
+    p.add_argument("--loss-chunks", nargs="*", type=int, default=[256],
+                   help="additionally sweep TORCHFT_LOSS_CHUNK values at "
+                   "the best flash config (default: one draw at 256 — "
+                   "the r05 ranked attack order's item 3; 128 is the "
+                   "built-in chunk)")
     args = p.parse_args()
 
     sys.path.insert(0, ".")
@@ -161,9 +170,11 @@ def main() -> int:
             best, {"block_q": bq, "block_k": bk, "remat": bool(rm)},
             bq=bq, bk=bk, rm=bool(rm),
         )
-    # Loss-chunk sweep at the best (or default) flash config. Chunk size
-    # changes the checkpointed head-scan granularity — the r02 profile
-    # lead (docs/MFU_NOTES.md suspect #1).
+    # Loss-chunk sweep at the best (or default) flash config.  DEMOTED
+    # from r3's suspect #1: scan-corrected cost analysis (r05,
+    # tools/mfu_cost_rank.py) shows total flops are chunk-INDEPENDENT —
+    # only scan-iteration overhead vs transient bytes distinguish
+    # chunks, a <=1-2% lever.  Worth one draw (256), not a grid.
     for lc in args.loss_chunks:
         bq = best["block_q"] if best else 512
         bk = best["block_k"] if best else 512
