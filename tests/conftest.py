@@ -16,6 +16,24 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The timeout-engine watchdog os._exit(1)s a process whose asyncio
+# timeout loop is starved past this budget (futures.py:_watchdog_loop).
+# In PRODUCTION trainers that suicide is the last line of defense; in
+# the PYTEST process — which builds in-process Managers, arming the
+# watchdog — a 30s budget is lethal under suite load: the r5 stamp-1
+# run died with a truncated report (rc=1, no summary) when the resnet
+# integ test's two compiling children starved the loop thread past 30s
+# on the 1-core box.  300s still catches a genuinely wedged loop in
+# long integ tests without turning box load into suite suicide.
+os.environ.setdefault("TORCHFT_WATCHDOG_TIMEOUT_SEC", "300")
+
+# The runner's best-effort pdeathsig preexec hook forces fork() in the
+# jax-threaded pytest process (a small deadlock risk Python 3.12 warns
+# about) and this container doesn't deliver pdeathsig anyway; the
+# suite's orphan defense is the SIGTERM unwind below + explicit
+# runner.stop() calls in the integ tests' finally blocks.
+os.environ.setdefault("TORCHFT_RUNNER_PDEATHSIG", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -43,6 +61,19 @@ jax.config.update("jax_platforms", "cpu")
 import signal  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+# A SIGTERM (outer `timeout`, driver deadline) must unwind fixtures and
+# test finally-blocks — the integ tests' spawned trainer processes are
+# only reaped by runner.stop() calls in those blocks (pdeathsig is not
+# delivered in this container; orphaned trainers spin on quorum retries
+# and degrade every later run — observed r5).  KeyboardInterrupt is the
+# exception pytest already unwinds cleanly on.
+def _sigterm_to_interrupt(_signum, _frame):
+    raise KeyboardInterrupt("SIGTERM")
+
+
+signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
 
 _DEFAULT_TIMEOUT_S = 120
 _SLOW_TIMEOUT_S = 600

@@ -352,6 +352,18 @@ def drill_model_heal(args) -> dict:
 
 def main() -> int:
     os.chdir(REPO)
+    # `timeout`/driver kills send SIGTERM, which by default dies WITHOUT
+    # running the drills' finally blocks — the spawned trainers then
+    # spin on quorum retries as orphans, stealing the 1-core box for
+    # hours (observed r5; pdeathsig is not delivered in this container,
+    # so cleanup MUST run in-process).  Convert to SystemExit so every
+    # runner.stop()/lighthouse.shutdown() in the finally blocks runs.
+    import signal as _signal
+
+    def _term(_signum, _frame):
+        raise SystemExit(143)
+
+    _signal.signal(_signal.SIGTERM, _term)
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="drill", required=True)
     s = sub.add_parser("soak")

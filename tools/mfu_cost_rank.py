@@ -134,11 +134,16 @@ def run_candidate(loss_chunk: int, remat: bool, B: int, S: int) -> dict:
         # whole-program (buffer assignment, not per-body) and ARE
         # sound: rank remat by temp_bytes/bytes_accessed + the analytic
         # flop cost, never by the raw flop delta.
-        if "flops" in rec:
+        C_eff = min(C, S)  # _loss_fn clamps the same way (train.py:161)
+        rec["loss_chunk"] = C_eff
+        if "flops" in rec and S % C_eff == 0:
+            # Same condition as _loss_fn: a non-divisor chunk takes the
+            # plain full-logits path (no scan) — correcting it would ADD
+            # bogus flops.
             H = cfg.hidden_size
             V = cfg.vocab_size
-            n_chunks = max(S // C, 1)
-            body = 8.0 * B * C * H * V
+            n_chunks = max(S // C_eff, 1)
+            body = 8.0 * B * C_eff * H * V
             rec["loss_scan_body_flops"] = body
             rec["flops_scan_corrected"] = rec["flops"] + body * (
                 n_chunks - 1

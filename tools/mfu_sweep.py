@@ -123,9 +123,11 @@ def main() -> int:
         # Order = the chip-free ranking (tools/mfu_cost_rank.py +
         # docs/MFU_NOTES.md, r05): larger flash tiles first (fewer
         # K-passes; the analytic VMEM budget admits them at S=1024),
-        # current default as the baseline draw, remat=1 last (scan-
-        # corrected cost analysis prices it +4.8% flops for -54% bytes
-        # — only wins if the step profiles bandwidth-bound).  Scarce
+        # current default as the baseline draw, remat=1 last (priced
+        # analytically at ~+1 fwd pass ~= +33% flops for -54% bytes
+        # accessed / -87% transient — only wins if the step profiles
+        # memory/bandwidth-bound; never read remat's cost from the raw
+        # cost-analysis delta, which is body-once-invalid).  Scarce
         # tunnel minutes measure candidates top-down.
         default=["512x1024x0", "1024x512x0", "1024x1024x0", "512x512x0",
                  "256x1024x0", "512x512x1"],

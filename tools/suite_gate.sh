@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# The commit gate: the FULL test suite (258 tests), run as two lanes.
+#
+# Why two invocations instead of one `pytest tests/`: on the 1-core box
+# a single combined run interleaves the heavyweight OS-process integ
+# tests (each spawning 2-3 compiling children) into the long tail of
+# accumulated in-process state and runs ~2x slower than the same tests
+# split by tier (measured r5: combined >58 min and flaky vs 8m15s fast
+# + 25m00s slow, both green). Same tests, same assertions, stable wall
+# time — lane order: fast first (fails fast on logic regressions), slow
+# integ second.
+#
+# Usage: bash tools/suite_gate.sh   # exits nonzero if EITHER lane fails
+set -u
+cd "$(dirname "$0")/.."
+
+t0=$(date +%s)
+echo "== lane 1/2: fast (pytest -m 'not slow') =="
+timeout 1800 python -m pytest tests/ -m "not slow" -q -rf
+fast_rc=$?
+echo "== lane 2/2: slow integ (pytest -m slow) =="
+timeout 5000 python -m pytest tests/ -m slow -q -rf
+slow_rc=$?
+t1=$(date +%s)
+echo "== suite gate: fast_rc=$fast_rc slow_rc=$slow_rc wall=$((t1 - t0))s =="
+[ "$fast_rc" = 0 ] && [ "$slow_rc" = 0 ]

@@ -30,6 +30,34 @@ from torchft_tpu.orchestration.launcher import ProcessSpec, render_topology
 logger = logging.getLogger(__name__)
 
 
+# libc handle resolved in the PARENT at import time: preexec_fn runs in
+# the forked child before exec, where importing/loading modules can
+# deadlock or fail silently (verified: a ctypes.CDLL inside the hook
+# left the child without its pdeathsig).
+try:
+    import ctypes as _ctypes
+
+    _LIBC = _ctypes.CDLL(None, use_errno=True)
+    _LIBC.prctl  # resolve the symbol now
+except Exception:  # noqa: BLE001 - non-linux fallback
+    _LIBC = None
+
+_PR_SET_PDEATHSIG = 1
+
+
+def _pdeathsig_preexec() -> None:
+    """Child-side (post-fork, pre-exec): request SIGKILL when the parent
+    (the runner) dies.  Linux-only (prctl); elsewhere a no-op — the C++
+    servers' own parent-death watchdog (net.hpp) still covers the next
+    tier down.  Only async-signal-safe-ish work here: the libc handle
+    was resolved in the parent."""
+    if _LIBC is not None:
+        try:
+            _LIBC.prctl(_PR_SET_PDEATHSIG, int(signal.SIGKILL), 0, 0, 0)
+        except Exception:  # noqa: BLE001 - supervision hint only
+            pass
+
+
 class ReplicaGroupRunner:
     def __init__(
         self,
@@ -71,6 +99,28 @@ class ReplicaGroupRunner:
             env=env,
             stdout=stdout,
             stderr=subprocess.STDOUT if stdout else None,
+            # Die with the supervisor: a runner killed without reaching
+            # stop() leaves orphaned trainers spinning on quorum retries,
+            # stealing the box's one core for hours (observed r5: two
+            # strays + their manager servers degraded every later suite
+            # run ~2x and flaked quorum-timing tests).  BEST-EFFORT:
+            # pdeathsig delivery is not honored in every container
+            # (verified undelivered on this sandboxed box despite
+            # PR_GET_PDEATHSIG reading back 9), so the primary defense
+            # is the SIGTERM->clean-unwind handler in the harness entry
+            # points (tools/drills.py, tests/conftest.py), which runs
+            # stop() and reaps the tree; the C++ servers' own
+            # getppid-polling watchdog (net.hpp) covers the tier below.
+            # TORCHFT_RUNNER_PDEATHSIG=0 disables the hook: preexec_fn
+            # forces fork-not-posix_spawn, which in a jax-threaded
+            # parent carries a small fork-lock deadlock risk (Python
+            # 3.12 warns) — the test suite opts out (conftest) since
+            # delivery doesn't work in its container anyway.
+            preexec_fn=(
+                _pdeathsig_preexec
+                if os.environ.get("TORCHFT_RUNNER_PDEATHSIG", "1") != "0"
+                else None
+            ),
         )
         if stdout is not None:
             stdout.close()  # the child owns the fd now
