@@ -3,11 +3,13 @@
 Each drill launches real trainer processes under the keep-alive runner
 against an in-proc C++ lighthouse, injects the fault, and prints ONE
 JSON line with the outcome. These are the exact harnesses behind
-``HEAL_DRILL_r04.json``:
+``HEAL_DRILL_r05.json``:
 
     python tools/drills.py soak          # 4 SIGKILLs, DDP int4+EF wire
     python tools/drills.py elastic-up    # third group joins mid-run
     python tools/drills.py elastic-down  # 3->2 permanent departure
+    python tools/drills.py heal-storm    # SIGKILL aimed at the heal
+                                         # machinery (join + transfer)
     python tools/drills.py model-heal --model moe|pipeline|ulysses
 
 elastic-up runs UNPACED (batch 8, full step rate): instead of slowing
@@ -70,23 +72,43 @@ def _specs(cmd, n_groups, lighthouse, extra_env=None, result_dir=None):
     )
 
 
-def _wait_step_mark(runner, log_dir, group, incarnation, marks, deadline_s):
-    """Polls the group's CURRENT incarnation log for a manager '- step N]'
-    line (these flush per line; trainer print() output sits in the child's
-    block buffer for many steps). Pumps the runner so relaunches happen
-    between kills."""
+def _wait_log_marker(
+    runner, log_dir, group, incarnation, markers, deadline_s,
+    poll_s: float = 1.0,
+):
+    """Polls one incarnation's log for any of ``markers``; pumps the
+    runner so relaunches happen between kills.  Manager log lines flush
+    per line (trainer print() output sits in the child's block buffer
+    for many steps).  Returns the marker found, or None on deadline —
+    never a silent fallback: a drill that couldn't land its kill in the
+    intended phase must FAIL, not quietly degrade into a different
+    drill."""
     deadline = time.time() + deadline_s
-    path = os.path.join(log_dir, f"replica{group}_rank0.r{incarnation}.log")
+    path = os.path.join(
+        log_dir, f"replica{group}_rank0.r{incarnation}.log"
+    )
     while time.time() < deadline:
-        time.sleep(1.0)
         runner.monitor_once()
         try:
             text = open(path).read()
         except OSError:
+            time.sleep(poll_s)
             continue
-        if any(f"- step {s}]" in text for s in marks):
-            return True
-    return False
+        for m in markers:
+            if m in text:
+                return m
+        time.sleep(poll_s)
+    return None
+
+
+def _wait_step_mark(runner, log_dir, group, incarnation, marks, deadline_s):
+    return (
+        _wait_log_marker(
+            runner, log_dir, group, incarnation,
+            [f"- step {s}]" for s in marks], deadline_s,
+        )
+        is not None
+    )
 
 
 def _read_results(result_dir, groups):
@@ -297,6 +319,99 @@ def drill_elastic_down(args) -> dict:
     }
 
 
+def drill_heal_storm(args) -> dict:
+    """Kill the HEALER, not just the runner: after a steady-state
+    SIGKILL, the victim's next incarnations are killed AGAIN as soon as
+    they reach the dangerous phases — one on 'reconfiguring pg' (quorum
+    join in flight) and one on 'healing from' (checkpoint transfer /
+    commit fence in flight) — a crash-looping replica.  The survivor
+    must ride through every storm kill with zero restarts of its own,
+    and the final incarnation heals and finishes bitwise-identical.
+    This is a strictly harder class than the soak (which kills healthy
+    steady-state incarnations at step marks): it aims SIGKILL at the
+    heal machinery itself."""
+    steps = args.steps
+    workdir = tempfile.mkdtemp(prefix="drill_storm_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(steps), "--batch-size", "8",
+                "--min-replicas", "2",
+                "--quantize", "--quantize-bits", "4", "--error-feedback",
+            ],
+            2, lighthouse, result_dir=result_dir,
+        ),
+        max_restarts=6,
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+    storm_hits = []
+    try:
+        # Kill 1: steady state, mid-run (the soak's class).
+        mark = int(steps * 0.3)
+        assert _wait_step_mark(
+            runner, log_dir, 1, 0, range(mark, mark + 8), 600
+        ), f"group 1 never reached step {mark}"
+        assert runner.kill_group(1), "kill 1 failed"
+        # Kills 2..3: aimed at the relaunch's join and heal phases.  The
+        # live incarnation is re-read from runner.restarts each round: a
+        # self-death while waiting (e.g. quorum timeout) relaunches the
+        # group, and killing/polling a stale incarnation would mislabel
+        # the storm phases (stale logs can even contain old markers).
+        kills_done = 1
+        for markers in (("reconfiguring pg",), ("healing from",)):
+            # After k kills the live incarnation index is k (restarts
+            # counts relaunches); wait for THAT relaunch to land before
+            # resolving the log path, or the waiter would poll the dead
+            # incarnation's frozen log.
+            t_r = time.time()
+            while (
+                runner.restarts[1] < kills_done
+                and time.time() - t_r < 180
+            ):
+                runner.monitor_once()
+                time.sleep(0.2)
+            inc = runner.restarts[1]
+            assert inc == kills_done, (
+                f"relaunch {kills_done} never landed (restarts={inc})"
+            )
+            hit = _wait_log_marker(
+                runner, log_dir, 1, inc, markers, 600, poll_s=0.2
+            )
+            live_inc = runner.restarts[1]
+            assert hit is not None, (
+                f"incarnation {inc} never reached {markers}"
+            )
+            assert live_inc == inc, (
+                f"incarnation churned {inc}->{live_inc} while waiting "
+                f"for {markers} (self-death?) — phase label unreliable"
+            )
+            storm_hits.append(hit)
+            assert runner.kill_group(1), f"storm kill (inc {inc}) failed"
+            kills_done += 1
+        ok = runner.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, (0, 1))
+    return {
+        "drill": "heal-storm",
+        "kills": 1 + len(storm_hits),
+        "storm_kill_phases": storm_hits,
+        "clean_finish": bool(ok),
+        "restarts": dict(runner.restarts),
+        "survivor_restarts": runner.restarts.get(0, 0),
+        "final_steps": [_step(res[0]), _step(res[1])],
+        "bitwise_equal": _sha(res[0]) is not None
+        and _sha(res[0]) == _sha(res[1]),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
 def drill_model_heal(args) -> dict:
     """HSDP kill/heal for a chosen parallelism family: moe (expert
     parallelism over ep), pipeline (GPipe over pp), or ulysses
@@ -375,6 +490,8 @@ def main() -> int:
     s.add_argument("--steps", type=int, default=1200)
     s = sub.add_parser("elastic-down")
     s.add_argument("--steps", type=int, default=120)
+    s = sub.add_parser("heal-storm")
+    s.add_argument("--steps", type=int, default=100)
     s = sub.add_parser("model-heal")
     s.add_argument("--model", choices=["moe", "pipeline", "ulysses"],
                    required=True)
@@ -388,6 +505,7 @@ def main() -> int:
         "soak": drill_soak,
         "elastic-up": drill_elastic_up,
         "elastic-down": drill_elastic_down,
+        "heal-storm": drill_heal_storm,
         "model-heal": drill_model_heal,
     }[args.drill]
     print(json.dumps(fn(args)), flush=True)
