@@ -10,6 +10,7 @@ JSON line with the outcome. These are the exact harnesses behind
     python tools/drills.py elastic-down  # 3->2 permanent departure
     python tools/drills.py heal-storm    # SIGKILL aimed at the heal
                                          # machinery (join + transfer)
+    python tools/drills.py spare-failover  # hot spare promotes, no heal
     python tools/drills.py model-heal --model moe|pipeline|ulysses
 
 elastic-up runs UNPACED (batch 8, full step rate): instead of slowing
@@ -412,6 +413,124 @@ def drill_heal_storm(args) -> dict:
     }
 
 
+def drill_spare_failover(args) -> dict:
+    """Hot-spare failover (WorldSizeMode.FIXED_WITH_SPARES, the
+    reference's spare story, drilled at OS-process level for the first
+    time): three groups, effective world size PINNED at 2 — the third
+    runs as a spare (contributes zeros, applies the same averaged
+    update, stays in bitwise lockstep).  An ACTIVE group is SIGKILLed
+    mid-run; the spare must promote INSTANTLY — no heal, it was never
+    behind — while the relaunched victim heals and becomes the new
+    spare.  All three finish bitwise-identical."""
+    steps = args.steps
+    FIXED = 2  # effective world size; drives spec args and regexes below
+    n_groups = FIXED + 1  # one hot spare
+    workdir = tempfile.mkdtemp(prefix="drill_spare_")
+    result_dir, log_dir = workdir + "/results", workdir + "/logs"
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(steps), "--batch-size", "8",
+                "--min-replicas", str(FIXED),
+                "--world-size-mode", "fixed_with_spares",
+                "--quantize", "--quantize-bits", "4", "--error-feedback",
+            ],
+            n_groups, lighthouse, result_dir=result_dir,
+        ),
+        max_restarts=3,
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+
+    def _spare_log_path(group):
+        return os.path.join(
+            log_dir,
+            f"replica{group}_rank0.r{runner.restarts[group]}.log",
+        )
+
+    def _latest_rank(group):
+        """The group's most recent quorum rank from its reconfigure
+        lines (manager.py: 'reconfiguring pg: quorum N, rank R/W')."""
+        try:
+            text = open(_spare_log_path(group)).read()
+        except OSError:
+            return None
+        m = re.findall(r"reconfiguring pg: quorum \d+, rank (\d+)/(\d+)", text)
+        return (int(m[-1][0]), int(m[-1][1])) if m else None
+
+    spare_group = victim = None
+    spare_kill_offset = 0
+    try:
+        mark = int(steps * 0.3)
+        assert _wait_step_mark(
+            runner, log_dir, 0, 0, range(mark, mark + 8), 600
+        ), f"group 0 never reached step {mark}"
+        # Identify the spare (quorum rank >= FIXED).  Poll until all
+        # groups report a full n_groups-member quorum: a single
+        # unsynchronized snapshot can straddle quorum epochs (a lagging
+        # reconfigure line) and spuriously show zero or two spares.
+        ranks = {}
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            runner.monitor_once()
+            ranks = {g: _latest_rank(g) for g in range(n_groups)}
+            if all(r and r[1] == n_groups for r in ranks.values()):
+                break
+            time.sleep(0.5)
+        spares = [g for g, r in ranks.items() if r and r[0] >= FIXED]
+        assert len(spares) == 1, f"expected exactly one spare, ranks={ranks}"
+        spare_group = spares[0]
+        victim = next(g for g in range(n_groups) if g != spare_group)
+        # Anchor the positional promotion check at KILL time: the
+        # promotion reconfigure and any disqualifying heal must appear
+        # AFTER this offset (a 'rank 0/FIXED' line can also occur at
+        # startup, before the third group registered).
+        try:
+            spare_kill_offset = len(open(_spare_log_path(spare_group)).read())
+        except OSError:
+            spare_kill_offset = 0
+        assert runner.kill_group(victim), "kill failed"
+        ok = runner.run_until_done(timeout=900)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res = _read_results(result_dir, tuple(range(n_groups)))
+    shas = [_sha(res[g]) for g in range(n_groups)]
+    # The promoted spare must have ridden through WITHOUT a heal (it
+    # was in lockstep) and re-ranked into the active set.  Only the
+    # POST-KILL tail of its current incarnation's log counts: joining
+    # the job may legitimately heal (a group registering a beat late
+    # heals to the actives' current step), but the promotion must not.
+    post_kill = ""
+    try:
+        post_kill = open(_spare_log_path(spare_group)).read()[
+            spare_kill_offset:
+        ]
+    except OSError:
+        pass
+    promoted = bool(
+        re.search(
+            rf"reconfiguring pg: quorum \d+, rank \d+/{FIXED}\b",
+            post_kill,
+        )
+    )
+    promoted_no_heal = promoted and "healing from" not in post_kill
+    return {
+        "drill": "spare-failover",
+        "spare_group": spare_group,
+        "victim_group": victim,
+        "clean_finish": bool(ok),
+        "restarts": dict(runner.restarts),
+        "spare_promoted_no_heal": promoted_no_heal,
+        "final_steps": [_step(res[g]) for g in range(3)],
+        "bitwise_equal_all3": None not in shas and len(set(shas)) == 1,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
 def drill_model_heal(args) -> dict:
     """HSDP kill/heal for a chosen parallelism family: moe (expert
     parallelism over ep), pipeline (GPipe over pp), or ulysses
@@ -492,6 +611,11 @@ def main() -> int:
     s.add_argument("--steps", type=int, default=120)
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)
+    s = sub.add_parser("spare-failover")
+    # 1200 like elastic-up: the killed ACTIVE's relaunch must rejoin (as
+    # the new spare) while the run is still live, and its ~35s pre-warm
+    # needs a full-speed runway.
+    s.add_argument("--steps", type=int, default=1200)
     s = sub.add_parser("model-heal")
     s.add_argument("--model", choices=["moe", "pipeline", "ulysses"],
                    required=True)
@@ -506,6 +630,7 @@ def main() -> int:
         "elastic-up": drill_elastic_up,
         "elastic-down": drill_elastic_down,
         "heal-storm": drill_heal_storm,
+        "spare-failover": drill_spare_failover,
         "model-heal": drill_model_heal,
     }[args.drill]
     print(json.dumps(fn(args)), flush=True)

@@ -36,7 +36,7 @@ from flax import linen as nn
 
 from torchft_tpu import telemetry
 from torchft_tpu.ddp import DistributedDataParallel
-from torchft_tpu.manager import Manager
+from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.process_group import ProcessGroupSocket
 
@@ -101,6 +101,16 @@ def main() -> int:
         help="carry per-bucket quantization residuals into the next step "
         "(recommended with --quantize-bits 4)",
     )
+    parser.add_argument(
+        "--world-size-mode",
+        choices=("dynamic", "fixed_with_spares"),
+        default="dynamic",
+        help="fixed_with_spares: the effective participant count is "
+        "pinned at --min-replicas; extra replica groups run as hot "
+        "SPARES (contribute zeros, apply the same averaged update, stay "
+        "in bitwise lockstep) and promote instantly - no heal - when an "
+        "active group dies (reference: WorldSizeMode, manager.py:146)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -160,12 +170,14 @@ def main() -> int:
     wx, wy = synthetic_batch(jax.random.PRNGKey(1), args.batch_size, S_img, n_cls)
     jax.block_until_ready(loss_and_grads(params, batch_stats[0], wx, wy))
 
+
     manager = Manager(
         pg=ProcessGroupSocket(timeout=30.0),
         min_replica_size=args.min_replicas,
         replica_id=f"train_ddp_{replica_group}",
         group_rank=0,
         group_world_size=1,
+        world_size_mode=WorldSizeMode(args.world_size_mode),
     )
     opt = OptimizerWrapper(manager, optax.adam(args.lr), params)
     ddp = DistributedDataParallel(
