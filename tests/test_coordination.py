@@ -151,6 +151,105 @@ def test_manager_quorum_and_heal(lighthouse) -> None:
     mgr_b.shutdown()
 
 
+def test_lighthouse_leave_shrinks_quorum_fast() -> None:
+    """A graceful leave removes the member immediately: the survivor
+    re-quorums at tick speed instead of waiting out the heartbeat timeout
+    (set to 60 s here so only the leave can explain a fast shrink). No
+    reference analog — its only exits are Kill -> exit(1) and silent death,
+    both of which cost survivors the heartbeat stall."""
+    import time
+
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=2000,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=60000,
+    )
+    client = LighthouseClient(server.address())
+    try:
+        # Pre-heartbeat both so the straggler wait holds the first quorum
+        # open for both registrants (min_replicas=1).
+        client.heartbeat("stay")
+        client.heartbeat("goer")
+        results = {}
+
+        def join(name: str) -> None:
+            c = LighthouseClient(server.address())
+            results[name] = c.quorum(replica_id=name, step=1, timeout=10.0)
+            c.close()
+
+        threads = [
+            threading.Thread(target=join, args=(n,)) for n in ("stay", "goer")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert len(results["stay"].participants) == 2
+
+        client.leave("goer")
+        status = client.status()
+        assert "goer" not in status["heartbeat_ages_ms"]
+        assert status["left"] == ["goer"]
+
+        # A heartbeat already in flight when the leave landed must not
+        # resurrect the entry (the tombstone).
+        client.heartbeat("goer")
+        assert "goer" not in client.status()["heartbeat_ages_ms"]
+
+        t0 = time.monotonic()
+        shrunk = client.quorum(replica_id="stay", step=2, timeout=10.0)
+        elapsed = time.monotonic() - t0
+        assert [m.replica_id for m in shrunk.participants] == ["stay"]
+        assert shrunk.quorum_id > results["stay"].quorum_id
+        assert elapsed < 2.0, f"shrink took {elapsed:.1f}s (tick speed expected)"
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_manager_client_leave_stops_heartbeats() -> None:
+    """ManagerClient.leave(): the manager server stops its heartbeat loop
+    and forwards the leave, so the lighthouse drops the group even while
+    the manager process stays alive."""
+    import time
+
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=2000,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=60000,
+    )
+    mgr = ManagerServer(
+        replica_id="drainer",
+        lighthouse_addr=server.address(),
+        store_address="store:1",
+        world_size=1,
+        heartbeat_interval_ms=50,
+    )
+    lh_client = LighthouseClient(server.address())
+    mgr_client = ManagerClient(mgr.address())
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if "drainer" in lh_client.status()["heartbeat_ages_ms"]:
+                break
+            time.sleep(0.05)
+        assert "drainer" in lh_client.status()["heartbeat_ages_ms"]
+
+        assert mgr_client.leave() is True
+        # The manager is still alive, but drained: a few heartbeat
+        # intervals later the entry must still be gone.
+        time.sleep(0.3)
+        assert mgr.is_alive()
+        assert "drainer" not in lh_client.status()["heartbeat_ages_ms"]
+    finally:
+        lh_client.close()
+        mgr_client.close()
+        mgr.shutdown()
+        server.shutdown()
+
+
 def test_manager_should_commit_barrier(lighthouse) -> None:
     mgr = ManagerServer(
         replica_id="solo",

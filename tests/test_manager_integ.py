@@ -264,6 +264,92 @@ def test_three_replicas_one_crash(lighthouse) -> None:
     assert_params_equal(results)
 
 
+def test_graceful_drain_leave() -> None:
+    """Replica 1 drains mid-run via manager.leave() (the TPU
+    maintenance-event / preemption path): replica 0 finishes solo WITHOUT
+    waiting out replica 1's heartbeat — the lighthouse's heartbeat timeout
+    is set to 30 s here while the managers' quorum timeout is 20 s, so if
+    the leave did not remove the member immediately, replica 0's
+    post-departure quorum would time out and fail the test. Also pins that
+    a drained manager refuses to rejoin (start_quorum raises)."""
+    import time
+
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=1000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=30000,
+    )
+    total_steps = 6
+    drain_after_commits = 2  # drain once replica 1 itself committed 2 steps
+    results: Dict[int, Dict[str, np.ndarray]] = {}
+
+    def run(replica: int) -> None:
+        params = {
+            "w": np.zeros((4, 3), dtype=np.float32),
+            "b": np.zeros(3, dtype=np.float32),
+        }
+
+        def load_state(state):
+            for k, v in state.items():
+                params[k][...] = v
+
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=5.0),
+            state_dict=lambda: {k: v.copy() for k, v in params.items()},
+            load_state_dict=load_state,
+            min_replica_size=1,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            connect_timeout=10.0,
+            replica_id=f"drain{replica}",
+            lighthouse_addr=server.address(),
+            group_rank=0,
+            group_world_size=1,
+        )
+        my_commits = 0
+        try:
+            while manager.current_step() < total_steps:
+                step = manager.current_step()
+                if replica == 1 and my_commits >= drain_after_commits:
+                    assert manager.leave() is True
+                    with pytest.raises(RuntimeError, match="drained"):
+                        manager.start_quorum()
+                    break
+                manager.start_quorum()
+                grads = [
+                    np.full((4, 3), 1.0 + step, dtype=np.float32),
+                    np.full(3, 0.5 * (step + 1), dtype=np.float32),
+                ]
+                works = [manager.allreduce(g) for g in grads]
+                reduced = [w.wait(timeout=30)[0] for w in works]
+                with manager.fenced_state_dict():
+                    if manager.should_commit():
+                        _sgd_step(params, reduced, lr=0.1)
+                        my_commits += 1
+            results[replica] = {k: v.copy() for k, v in params.items()}
+        finally:
+            manager.shutdown()
+
+    t0 = time.monotonic()
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        futs = [pool.submit(run, r) for r in range(2)]
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+        server.shutdown()
+    elapsed = time.monotonic() - t0
+    # Replica 0 ran all steps; the drained replica committed real work
+    # before leaving.
+    assert not np.allclose(results[0]["w"], 0)
+    assert not np.allclose(results[1]["w"], 0)
+    # Well under the 30 s heartbeat timeout a non-graceful departure
+    # would have cost (plus margin for the loaded 1-core box).
+    assert elapsed < 60, f"drain path took {elapsed:.1f}s"
+
+
 def test_manager_quantized_jax_allreduce(lighthouse) -> None:
     """manager.allreduce(jax_arrays, should_quantize=True) takes the
     device-quantized path end-to-end across two live replica groups:

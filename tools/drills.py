@@ -8,6 +8,8 @@ JSON line with the outcome. These are the exact harnesses behind
     python tools/drills.py soak          # 4 SIGKILLs, DDP int4+EF wire
     python tools/drills.py elastic-up    # third group joins mid-run
     python tools/drills.py elastic-down  # 3->2 permanent departure
+    python tools/drills.py drain         # SIGTERM graceful drain vs
+                                         # SIGKILL survivor-stall control
     python tools/drills.py heal-storm    # SIGKILL aimed at the heal
                                          # machinery (join + transfer)
     python tools/drills.py spare-failover  # hot spare promotes, no heal
@@ -275,6 +277,139 @@ def drill_elastic_up(args) -> dict:
         ),
         "unpaced": True,
         "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def _step_times(log_path):
+    """(step, unix_time) pairs from a trainer log's ``step=N ... t=T``
+    lines (train_ddp stamps each step print for exactly this)."""
+    try:
+        text = open(log_path).read()
+    except OSError:
+        return []
+    return [
+        (int(m.group(1)), float(m.group(2)))
+        for m in re.finditer(r"step=(\d+) .*?t=([0-9.]+)", text)
+    ]
+
+
+def _stall_after(times, t_signal, window_s=45.0):
+    """Largest inter-step gap a survivor saw in the window after the
+    signal landed (the departure stall), plus its pre-signal median step
+    time for context."""
+    ts = [t for (_, t) in times]
+    before = [b - a for a, b in zip(ts, ts[1:]) if b < t_signal]
+    gaps = [
+        b - a
+        for a, b in zip(ts, ts[1:])
+        if b >= t_signal - 0.5 and a <= t_signal + window_s
+    ]
+    median_before = sorted(before)[len(before) // 2] if before else None
+    return (max(gaps) if gaps else None), median_before
+
+
+def drill_drain(args) -> dict:
+    """Graceful-drain vs SIGKILL departure, measured from the survivors'
+    own step cadence.
+
+    Two identical 3-group runs (min_replicas=2, no restarts); group 2 is
+    removed mid-run — leg A with SIGTERM (train_ddp drains: finishes the
+    step, manager.leave(), exit 0), leg B with SIGKILL (the control).
+    The survivors' largest inter-step gap right after the departure is
+    the cost of losing the peer: the drain leg pays ~one step (the leave
+    removes the member at tick speed, and no in-flight collective ever
+    includes the leaver), while the kill leg's stall is dominated by the
+    survivors' wedged in-flight allreduce — the dead peer's tag wait
+    runs to the ProcessGroupSocket timeout (30 s in train_ddp), which
+    dwarfs even the 5 s heartbeat expiry. The reference has no
+    graceful-leave path, so every departure there pays the kill leg's
+    price."""
+    steps = args.steps
+
+    def leg(sig_name):
+        import signal as _sig
+
+        sig = _sig.SIGTERM if sig_name == "drain" else _sig.SIGKILL
+        workdir = tempfile.mkdtemp(prefix=f"drill_drain_{sig_name}_")
+        result_dir, log_dir = workdir + "/results", workdir + "/logs"
+        lighthouse = _lighthouse()
+        runner = ReplicaGroupRunner(
+            _specs(
+                [
+                    sys.executable, "train_ddp.py", "--model", "cnn",
+                    "--steps", str(steps), "--batch-size", "512",
+                    "--min-replicas", "2",
+                ],
+                3, lighthouse, result_dir=result_dir,
+            ),
+            max_restarts=0,
+            log_dir=log_dir,
+        )
+        t0 = time.time()
+        runner.start()
+        try:
+            assert _wait_step_mark(runner, log_dir, 2, 0, range(12, 20), 600), (
+                "group 2 never reached step 12"
+            )
+            t_signal = time.time()
+            assert runner.kill_group(2, sig), "signal failed"
+            runner.run_until_done(timeout=900)
+        finally:
+            runner.stop()
+            lighthouse.shutdown()
+        res = _read_results(result_dir, (0, 1, 2))
+        stall_s, step_s = _stall_after(
+            _step_times(os.path.join(log_dir, "replica0_rank0.r0.log")),
+            t_signal,
+        )
+        victim_log = ""
+        try:
+            victim_log = open(
+                os.path.join(log_dir, "replica2_rank0.r0.log")
+            ).read()
+        except OSError:
+            pass
+        return {
+            "survivor_final_steps": [_step(res[0]), _step(res[1])],
+            "bitwise_equal_survivors": _sha(res[0]) is not None
+            and _sha(res[0]) == _sha(res[1]),
+            "victim_exit_clean": runner.clean_exit(2),
+            "victim_drain_logged": "draining at step" in victim_log
+            and "left the quorum" in victim_log,
+            "survivor_stall_s": round(stall_s, 2) if stall_s else None,
+            "survivor_step_s_median": (
+                round(step_s, 2) if step_s else None
+            ),
+            "wall_s": round(time.time() - t0, 1),
+        }
+
+    drain = leg("drain")
+    kill = leg("sigkill")
+    assert drain["victim_exit_clean"], "drained trainer did not exit 0"
+    assert drain["victim_drain_logged"], "drain markers missing from log"
+    assert drain["bitwise_equal_survivors"], "drain-leg survivors diverged"
+    assert kill["bitwise_equal_survivors"], "kill-leg survivors diverged"
+    assert drain["survivor_stall_s"] is not None
+    assert kill["survivor_stall_s"] is not None
+    # The point of the feature: drain stall ~ one step; kill stall is
+    # bound by the survivors' wedged in-flight collective (the 30 s
+    # ProcessGroupSocket timeout — see the docstring), far above the
+    # drain ceiling asserted here.
+    assert drain["survivor_stall_s"] < kill["survivor_stall_s"], (
+        f"drain stall {drain['survivor_stall_s']}s not better than "
+        f"SIGKILL stall {kill['survivor_stall_s']}s"
+    )
+    assert drain["survivor_stall_s"] < 3.5, (
+        f"drain stall {drain['survivor_stall_s']}s should be ~one step, "
+        "not heartbeat-timeout-bound"
+    )
+    return {
+        "drill": "drain",
+        "graceful_drain": drain,
+        "sigkill_control": kill,
+        "stall_cut_ratio": round(
+            kill["survivor_stall_s"] / drain["survivor_stall_s"], 2
+        ),
     }
 
 
@@ -609,6 +744,10 @@ def main() -> int:
     s.add_argument("--steps", type=int, default=1200)
     s = sub.add_parser("elastic-down")
     s.add_argument("--steps", type=int, default=120)
+    s = sub.add_parser("drain")
+    # Long enough that the departure at ~step 15 leaves the survivors a
+    # post-stall runway for the cadence measurement.
+    s.add_argument("--steps", type=int, default=60)
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)
     s = sub.add_parser("spare-failover")
@@ -629,6 +768,7 @@ def main() -> int:
         "soak": drill_soak,
         "elastic-up": drill_elastic_up,
         "elastic-down": drill_elastic_down,
+        "drain": drill_drain,
         "heal-storm": drill_heal_storm,
         "spare-failover": drill_spare_failover,
         "model-heal": drill_model_heal,

@@ -313,6 +313,199 @@ static void test_lighthouse_e2e() {
   lh.stop();
 }
 
+static void test_lighthouse_leave() {
+  // Graceful drain: a "leave" removes the member immediately, so survivors
+  // re-quorum at tick speed instead of waiting for heartbeat expiry (set
+  // deliberately huge here so only the leave can explain a fast shrink).
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 2000;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 60000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  auto quorum_req = [&](const std::string& id, int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["timeout_ms"] = Json::of(int64_t(8000));
+    req["requester"] = mk_member(id, step).to_json();
+    return lighthouse_call(addr, req, 9000);
+  };
+  auto heartbeat = [&](const std::string& id) {
+    Json req = Json::object();
+    req["type"] = Json::of("heartbeat");
+    req["replica_id"] = Json::of(id);
+    return lighthouse_call(addr, req, 2000);
+  };
+
+  // Pre-heartbeat all three so the straggler wait holds the quorum open for
+  // every member (min_replicas=1 would otherwise let the first registrant
+  // form a singleton quorum before the other threads arrive).
+  CHECK(heartbeat("repA").get("ok").as_bool());
+  CHECK(heartbeat("repB").get("ok").as_bool());
+  CHECK(heartbeat("repC").get("ok").as_bool());
+  Json ra, rb, rc;
+  std::thread ta([&] { ra = quorum_req("repA", 1); });
+  std::thread tb([&] { rb = quorum_req("repB", 1); });
+  std::thread tc([&] { rc = quorum_req("repC", 1); });
+  ta.join();
+  tb.join();
+  tc.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("participants").arr.size(), size_t(3));
+  int64_t qid = ra.get("quorum").get("quorum_id").as_int();
+
+  Json lreq = Json::object();
+  lreq["type"] = Json::of("leave");
+  lreq["replica_id"] = Json::of(std::string("repC"));
+  Json lresp = lighthouse_call(addr, lreq, 2000);
+  CHECK(lresp.get("ok").as_bool());
+
+  // State after leave: no heartbeat for repC, tombstone recorded.
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s = lighthouse_call(addr, sreq, 2000);
+  CHECK(!s.get("status").get("heartbeat_ages_ms").obj.count("repC"));
+  CHECK_EQ(s.get("status").get("left").arr.size(), size_t(1));
+
+  // A heartbeat already in flight when the leave landed must not resurrect
+  // the entry (would stall survivors on heartbeat expiry again).
+  Json hreq = Json::object();
+  hreq["type"] = Json::of("heartbeat");
+  hreq["replica_id"] = Json::of(std::string("repC"));
+  CHECK(lighthouse_call(addr, hreq, 2000).get("ok").as_bool());
+  s = lighthouse_call(addr, sreq, 2000);
+  CHECK(!s.get("status").get("heartbeat_ages_ms").obj.count("repC"));
+
+  // Survivors re-quorum at tick speed: far below both the 60 s heartbeat
+  // timeout and the 2 s join window a SIGKILLed member would cost them.
+  int64_t t0 = now_ms();
+  std::thread t2a([&] { ra = quorum_req("repA", 2); });
+  std::thread t2b([&] { rb = quorum_req("repB", 2); });
+  t2a.join();
+  t2b.join();
+  int64_t shrink_ms = now_ms() - t0;
+  CHECK(ra.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("participants").arr.size(), size_t(2));
+  CHECK(ra.get("quorum").get("quorum_id").as_int() > qid);
+  CHECK(shrink_ms < 1000);
+
+  // A relaunched drained replica rejoins via the normal quorum path (its
+  // registration clears the tombstone — a tombstoned HEARTBEAT stays ignored
+  // by design, else the stale-heartbeat race would reopen). Register repC
+  // first so the survivors' round waits for it instead of forming a 2-quorum
+  // underneath it.
+  std::thread t3c([&] { rc = quorum_req("repC", 0); });
+  // Wait until repC's registration has actually landed (a bare sleep could
+  // lose the race under load, letting repA/repB form a 2-quorum underneath
+  // the rejoiner and strand its RPC until timeout).
+  for (int i = 0; i < 100; i++) {
+    Json st = lighthouse_call(addr, sreq, 2000).get("status");
+    bool registered = false;
+    for (const auto& p : st.get("participants").arr)
+      if (p.get("replica_id").as_str() == "repC") registered = true;
+    if (registered) break;
+    sleep_ms(50);
+  }
+  std::thread t3a([&] { ra = quorum_req("repA", 2); });
+  std::thread t3b([&] { rb = quorum_req("repB", 2); });
+  t3a.join();
+  t3b.join();
+  t3c.join();
+  CHECK(rc.get("ok").as_bool());
+  CHECK_EQ(rc.get("quorum").get("participants").arr.size(), size_t(3));
+  s = lighthouse_call(addr, sreq, 2000);
+  CHECK_EQ(s.get("status").get("left").arr.size(), size_t(0));
+  lh.stop();
+}
+
+static void test_manager_leave() {
+  // Manager-level drain: "leave" stops the manager's heartbeat loop and
+  // forwards the leave to the lighthouse, so the drained group ages out
+  // instantly instead of looking healthy until heartbeat expiry.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 2000;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 60000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+
+  auto mk_opts = [&](const std::string& id) {
+    ManagerOpts mo;
+    mo.replica_id = id;
+    mo.lighthouse_addr = lh.address();
+    mo.store_address = "store-" + id;
+    mo.world_size = 1;
+    mo.heartbeat_interval_ms = 50;
+    return mo;
+  };
+  ManagerServer mA(mk_opts("groupA"));
+  ManagerServer mB(mk_opts("groupB"));
+  CHECK(mA.start());
+  CHECK(mB.start());
+  // Let both heartbeat loops reach the lighthouse before the first quorum:
+  // with min_replicas=1 an early registrant would otherwise form a singleton
+  // quorum underneath the slower group.
+  sleep_ms(300);
+
+  auto quorum_req = [&](ManagerServer& m, int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["group_rank"] = Json::of(int64_t(0));
+    req["step"] = Json::of(step);
+    req["checkpoint_metadata"] = Json::of(std::string("meta"));
+    req["init_sync"] = Json::of(false);
+    req["timeout_ms"] = Json::of(int64_t(8000));
+    return lighthouse_call(m.address(), req, 9000);
+  };
+
+  Json a, b;
+  std::thread t0([&] { a = quorum_req(mA, 1); });
+  std::thread t1([&] { b = quorum_req(mB, 1); });
+  t0.join();
+  t1.join();
+  CHECK(a.get("ok").as_bool());
+  CHECK_EQ(a.get("result").get("replica_world_size").as_int(), 2);
+
+  Json lreq = Json::object();
+  lreq["type"] = Json::of("leave");
+  Json lresp = lighthouse_call(mB.address(), lreq, 3000);
+  CHECK(lresp.get("ok").as_bool());
+  CHECK(lresp.get("sent").as_bool());
+
+  // mB's heartbeat loop is still running but drained: give it a few
+  // intervals to prove no fresh heartbeat resurrects the entry.
+  sleep_ms(200);
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s = lighthouse_call(lh.address(), sreq, 2000);
+  CHECK(!s.get("status").get("heartbeat_ages_ms").obj.count("groupB"));
+
+  // The survivor re-quorums alone at tick speed.
+  int64_t t = now_ms();
+  a = quorum_req(mA, 2);
+  int64_t shrink_ms = now_ms() - t;
+  CHECK(a.get("ok").as_bool());
+  CHECK_EQ(a.get("result").get("replica_world_size").as_int(), 1);
+  CHECK(shrink_ms < 1000);
+
+  // A drained manager refuses quorum registrations (a late rank or stray
+  // client must not clear the lighthouse tombstone while heartbeats stay
+  // stopped), and fails FAST — no deadline wait.
+  t = now_ms();
+  b = quorum_req(mB, 2);
+  CHECK(!b.get("ok").as_bool());
+  CHECK(b.get("error").as_str().find("draining") != std::string::npos);
+  CHECK(now_ms() - t < 1000);
+
+  mA.stop();
+  mB.stop();
+  lh.stop();
+}
+
 static void test_lighthouse_quorum_timeout() {
   LighthouseOpts opt;
   opt.min_replicas = 2;
@@ -460,6 +653,8 @@ int main() {
   test_force_recover_on_init();
   test_commit_failures_propagate();
   test_lighthouse_e2e();
+  test_lighthouse_leave();
+  test_manager_leave();
   test_lighthouse_quorum_timeout();
   test_manager_e2e();
   fprintf(stderr, "%d checks, %d failures\n", g_checks, g_failures);

@@ -154,7 +154,33 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   Json resp = Json::object();
   if (type == "heartbeat") {
     std::lock_guard<std::mutex> lk(mu_);
-    state_.heartbeats[req.get("replica_id").as_str()] = now_ms();
+    const std::string replica_id = req.get("replica_id").as_str();
+    // A drained replica's manager may have one heartbeat in flight when its
+    // leave lands; the tombstone keeps it from resurrecting the entry (which
+    // would stall the survivors' next quorum until heartbeat expiry).
+    if (!state_.left.count(replica_id))
+      state_.heartbeats[replica_id] = now_ms();
+    resp["ok"] = Json::of(true);
+    return resp;
+  }
+  if (type == "leave") {
+    // Graceful drain (no reference analog; the reference only has Kill →
+    // exit(1), so survivors always pay the heartbeat-expiry stall). Removing
+    // the member's heartbeat + registration lets the very next tick form the
+    // shrunken quorum: ~quorum_tick_ms of stall instead of
+    // ~heartbeat_timeout_ms.
+    const std::string replica_id = req.get("replica_id").as_str();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      state_.heartbeats.erase(replica_id);
+      state_.participants.erase(replica_id);
+      state_.left.insert(replica_id);
+    }
+    fprintf(stderr, "[lighthouse] replica %s left gracefully\n",
+            replica_id.c_str());
+    // Proactive tick: survivors already blocked in a quorum RPC see the
+    // shrunken membership now, not at the next timer tick.
+    tick();
     resp["ok"] = Json::of(true);
     return resp;
   }
@@ -210,7 +236,9 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
   }
   const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
   std::unique_lock<std::mutex> lk(mu_);
-  // Joining is an implicit heartbeat (lighthouse.rs:502-512).
+  // Joining is an implicit heartbeat (lighthouse.rs:502-512) and clears any
+  // graceful-leave tombstone (a drained replica relaunching to rejoin).
+  state_.left.erase(me.replica_id);
   state_.heartbeats[me.replica_id] = now_ms();
   state_.participants[me.replica_id] = {me, now_ms()};
   int64_t my_gen = quorum_gen_;
@@ -253,6 +281,7 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
       }
       // Delivered quorum doesn't include us (we joined too late): rejoin and
       // wait for the next one (lighthouse.rs:523-544).
+      state_.left.erase(me.replica_id);
       state_.heartbeats[me.replica_id] = now_ms();
       state_.participants[me.replica_id] = {me, now_ms()};
     }
@@ -277,6 +306,9 @@ Json Lighthouse::status_json() {
   s["participants"] = parts;
   s["prev_quorum"] =
       state_.prev_quorum ? state_.prev_quorum->to_json() : Json::null();
+  Json left = Json::array();
+  for (const auto& id : state_.left) left.push(Json::of(id));
+  s["left"] = left;
   s["reason"] = Json::of(last_reason_);
   return s;
 }

@@ -70,6 +70,12 @@ void ManagerServer::heartbeat_loop() {
   }
   int fd = -1;
   while (running_) {
+    if (draining_) {
+      // Graceful drain in progress: no more heartbeats (a fresh heartbeat
+      // would make the lighthouse wait for us after we announced our leave).
+      sleep_ms(opts_.heartbeat_interval_ms);
+      continue;
+    }
     if (fd < 0) fd = tcp_connect(host, port, opts_.connect_timeout_ms);
     if (fd >= 0) {
       Json req = Json::object();
@@ -133,6 +139,32 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     // (reference kills the whole process too, manager.rs:481-486).
     _exit(1);
   }
+  if (type == "leave") {
+    // Graceful drain: stop our lighthouse heartbeats FIRST so a racing ping
+    // can't resurrect the entry, then tell the lighthouse to drop us (its
+    // tombstone covers the one heartbeat that may already be in flight).
+    draining_ = true;
+    bool sent = false;
+    std::string host;
+    int port = 0;
+    if (split_host_port(opts_.lighthouse_addr, &host, &port)) {
+      int fd = tcp_connect(host, port, opts_.connect_timeout_ms);
+      if (fd >= 0) {
+        Json lv = Json::object();
+        lv["type"] = Json::of("leave");
+        lv["replica_id"] = Json::of(opts_.replica_id);
+        Json lresp;
+        int64_t budget = std::max<int64_t>(500, deadline_ms - now_ms());
+        sent = call_json(fd, lv, &lresp, budget) && lresp.get("ok").as_bool();
+        close(fd);
+      }
+    }
+    fprintf(stderr, "[manager %s] leaving quorum (graceful drain, sent=%d)\n",
+            opts_.replica_id.c_str(), sent ? 1 : 0);
+    resp["ok"] = Json::of(true);
+    resp["sent"] = Json::of(sent);
+    return resp;
+  }
   if (type == "info") {
     resp["ok"] = Json::of(true);
     resp["replica_id"] = Json::of(opts_.replica_id);
@@ -182,6 +214,17 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
   int64_t rank = req.get("group_rank").as_int();
   bool init_sync = req.get("init_sync").as_bool(true);
   Json resp = Json::object();
+  if (draining_) {
+    // A post-leave quorum registration would clear our lighthouse tombstone
+    // while our heartbeats stay stopped — recreating the heartbeat-expiry
+    // stall the drain exists to remove. All ranks and clients share this
+    // layer, so the refusal is enforced here, not just in the Python
+    // Manager's _drained flag (which is per-object).
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of(
+        "manager is draining (leave() called); relaunch the process to rejoin");
+    return resp;
+  }
   if (rank < 0 || rank >= opts_.world_size) {
     resp["ok"] = Json::of(false);
     resp["error"] = Json::of("group_rank " + std::to_string(rank) +

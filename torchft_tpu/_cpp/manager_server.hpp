@@ -18,6 +18,7 @@
 //    "timeout_ms":N}
 //   {"type":"checkpoint_metadata","rank":r}
 //   {"type":"kill","msg":...}
+//   {"type":"leave"}   (graceful drain: stop heartbeats, tell the lighthouse)
 //   {"type":"info"}
 #pragma once
 
@@ -76,6 +77,9 @@ class ManagerServer {
   int port_ = 0;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
+  // Set by a "leave" request: the heartbeat loop stops pinging the lighthouse
+  // so the drained replica ages out instead of looking healthy forever.
+  std::atomic<bool> draining_{false};
   std::thread accept_thread_;
   std::thread heartbeat_thread_;
   ConnTracker conns_;

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <map>
 #include <optional>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -57,6 +58,11 @@ struct LighthouseState {
   std::map<std::string, std::pair<QuorumMember, int64_t>> participants;
   // replica_id -> last heartbeat ms
   std::map<std::string, int64_t> heartbeats;
+  // Replicas that drained via a graceful "leave": a tombstone so a heartbeat
+  // already in flight when the leave landed can't resurrect the entry and
+  // stall the survivors' next quorum on heartbeat expiry. Cleared when the
+  // replica re-registers through a quorum request (a relaunch rejoining).
+  std::set<std::string> left;
   std::optional<Quorum> prev_quorum;
   int64_t quorum_id = 0;
 };

@@ -409,6 +409,18 @@ class LighthouseClient:
             timeout,
         )
 
+    def leave(self, replica_id: str, timeout: float = 5.0) -> None:
+        """Graceful drain: removes the replica from the lighthouse's
+        heartbeat/participant maps immediately (with a tombstone against
+        in-flight heartbeats), so the survivors' next quorum forms at tick
+        speed instead of waiting out the heartbeat timeout. No reference
+        analog — the reference only has Kill → exit(1)."""
+        self._client.call(
+            {"type": "leave", "replica_id": replica_id,
+             "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+
     def close(self) -> None:
         self._client.close()
 
@@ -532,6 +544,17 @@ class ManagerClient:
             self._client.call({"type": "kill", "msg": msg, "timeout_ms": 2000}, 2.0)
         except (RuntimeError, TimeoutError):
             pass  # the victim exits without replying
+
+    def leave(self, timeout: float = 5.0) -> bool:
+        """Graceful drain of this replica group: the manager server stops
+        its lighthouse heartbeats and forwards a leave, so peers re-quorum
+        without us at tick speed. Returns whether the lighthouse confirmed
+        the leave (False = best-effort: heartbeats stopped, peers will age
+        us out on the heartbeat timeout instead)."""
+        resp = self._client.call(
+            {"type": "leave", "timeout_ms": int(timeout * 1000)}, timeout
+        )
+        return bool(resp.get("sent", False))
 
     def close(self) -> None:
         self._client.close()

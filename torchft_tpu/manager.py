@@ -156,6 +156,7 @@ class Manager:
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._quorum_id = -1
+        self._drained = False
 
         # Goodput accounting (no reference counterpart; the TPU-ecosystem
         # analog is the goodput library's productive-vs-lost split):
@@ -362,6 +363,11 @@ class Manager:
         """Begins the (possibly async) quorum for this step (reference:
         manager.py:517-573). Call at the top of the step (e.g. from
         OptimizerWrapper.zero_grad)."""
+        if self._drained:
+            raise RuntimeError(
+                "start_quorum after leave(): a drained manager must not "
+                "rejoin the quorum (relaunch the process to rejoin)"
+            )
         self._errored = None
         self._healing = False
         self._quorum_future = self._executor.submit(
@@ -828,6 +834,41 @@ class Manager:
 
     def replica_id(self) -> str:
         return self._replica_id
+
+    def leave(self, timeout: float = 5.0) -> bool:
+        """Gracefully drains this replica group out of the quorum (e.g. on a
+        TPU maintenance-event / preemption SIGTERM): the manager server stops
+        heartbeating and the lighthouse drops us immediately, so the
+        survivors' next quorum forms at tick speed (~quorum_tick_ms) instead
+        of stalling until our heartbeat expires (~heartbeat_timeout_ms, 5 s
+        default). Call at a step boundary after the last commit; after this
+        the manager cannot rejoin — relaunch the process to rejoin. Returns
+        whether the lighthouse confirmed (False = heartbeats stopped anyway;
+        peers age us out on the heartbeat timeout). With
+        ``group_world_size > 1`` every local rank must drain at the SAME
+        step boundary (the drain signal is per-process): the shared manager
+        server refuses quorum registrations once draining, so a straggler
+        rank fails fast instead of wedging, but coordinated shutdown is the
+        trainer's job. No reference analog: the reference's only exit paths
+        are Kill → exit(1) and silent death, both of which cost survivors
+        the heartbeat stall."""
+        if self._drained:
+            return True
+        # Let an in-flight async quorum settle first so its registration
+        # cannot land after (and undo) the leave.
+        if self._quorum_future is not None:
+            try:
+                self._quorum_future.result()
+            except Exception:  # noqa: BLE001 - drain proceeds regardless
+                pass
+        self._drained = True
+        try:
+            sent = self._client.leave(timeout=timeout)
+        except (RuntimeError, TimeoutError) as e:
+            self._logger.warn(f"graceful leave failed (peers will age us out): {e}")
+            return False
+        self._logger.info("left the quorum (graceful drain)")
+        return sent
 
     # ------------------------------------------------------------------
 

@@ -213,6 +213,11 @@ class ReplicaGroupRunner:
         proc.send_signal(sig)
         return True
 
+    def clean_exit(self, idx: int) -> bool:
+        """Whether spec ``idx`` has exited with rc 0 (False while running,
+        crashed, or restart-exhausted)."""
+        return bool(self._clean_exit.get(idx))
+
     @property
     def restarts(self) -> Dict[int, int]:
         return dict(self._restarts)

@@ -21,7 +21,9 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
+import time
 
 import jax
 
@@ -100,6 +102,14 @@ def main() -> int:
         "--error-feedback", action="store_true",
         help="carry per-bucket quantization residuals into the next step "
         "(recommended with --quantize-bits 4)",
+    )
+    parser.add_argument(
+        "--drain-on-sigterm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="on SIGTERM (TPU maintenance event / preemption notice), finish "
+        "the current step, gracefully leave the quorum so peers re-form at "
+        "tick speed (no heartbeat-timeout stall), and exit 0",
     )
     parser.add_argument(
         "--world-size-mode",
@@ -201,8 +211,33 @@ def main() -> int:
     # batches its first incarnation already committed.
     data_base = jax.random.PRNGKey(group_data_seed(replica_group))
 
+    # Preemption-aware graceful drain (TPU maintenance events deliver
+    # SIGTERM with a grace period): the handler only sets a flag; the loop
+    # drains at the next step boundary so the last commit stays clean.
+    drain_requested = [False]
+    if args.drain_on_sigterm:
+
+        def _on_sigterm(_signum, _frame):
+            drain_requested[0] = True
+            # Escalation: the first SIGTERM drains at the next step
+            # boundary; a second one (trainer wedged in a collective that
+            # never reaches a boundary) gets default kill semantics.
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+
+    drained = False
     metrics = telemetry.get_metrics_logger()
     while manager.current_step() < args.steps:
+        if drain_requested[0]:
+            print(
+                f"[group {replica_group}] draining at step "
+                f"{manager.current_step()} (SIGTERM)",
+                flush=True,
+            )
+            manager.leave()
+            drained = True
+            break
         step = manager.current_step()
         # Scheduled profiler window (TORCHFT_TRACE_DIR; reference:
         # train_ddp.py:169-174 torch.profiler schedule).
@@ -229,7 +264,8 @@ def main() -> int:
 
         print(
             f"[group {replica_group}] step={step} loss={float(loss):.4f} "
-            f"participants={manager.num_participants()} committed={committed}",
+            f"participants={manager.num_participants()} committed={committed} "
+            f"t={time.time():.3f}",
             flush=True,
         )
         if metrics is not None:
@@ -261,6 +297,7 @@ def main() -> int:
                     "group": replica_group,
                     "final_step": manager.current_step(),
                     "param_sha256": digest,
+                    "drained": drained,
                 },
                 f,
             )
