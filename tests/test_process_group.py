@@ -176,6 +176,114 @@ def test_send_recv(store):
         g.shutdown()
 
 
+def test_peer_conn_recv_fails_fast_after_peer_death():
+    """A recv issued AFTER the peer connection died must fail immediately,
+    not wait out the full per-tag timeout: the reader thread's death
+    broadcast only reaches queues that already exist, and the send side
+    already failed fast on self.dead — the asymmetry cost an abrupt-kill
+    survivor two consecutive 30s timeout rounds (HEAL_DRILL_r05
+    sigkill_control) while its peer detected the death in under a second.
+    A message delivered before the death must still be consumable."""
+    import socket as socket_mod
+    import time
+
+    from torchft_tpu import _net
+    from torchft_tpu.process_group import _PeerConn
+
+    a, b = socket_mod.socketpair()
+    conn = _PeerConn(a, peer=1)
+    try:
+        # Deliver one message, then kill the peer side.
+        arr = np.arange(8, dtype=np.float32)
+        _net.send_json(b, {"tag": "pre", "dtype": "float32", "shape": [8]})
+        _net.send_frame(b, arr.tobytes())
+        deadline = time.monotonic() + 5
+        while conn.dead is None and "pre" not in conn._queues:
+            if time.monotonic() > deadline:
+                raise AssertionError("message never arrived")
+            time.sleep(0.01)
+        b.close()
+        # Wait for the reader to observe the death.
+        while conn.dead is None:
+            if time.monotonic() > deadline:
+                raise AssertionError("reader never observed peer death")
+            time.sleep(0.01)
+
+        # Buffered pre-death message is still consumable.
+        np.testing.assert_array_equal(conn.recv("pre", timeout=5.0), arr)
+
+        # A recv for a tag that never arrived must fail FAST (RuntimeError,
+        # not a 30s TimeoutError).
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="died"):
+            conn.recv("never-sent", timeout=30.0)
+        assert time.monotonic() - t0 < 1.0
+
+        # A recv already PENDING when the death lands is covered by the
+        # death broadcast (pre-existing behavior, pinned here): simulate
+        # with a second pair.
+        a2, b2 = socket_mod.socketpair()
+        conn2 = _PeerConn(a2, peer=2)
+        try:
+            errs = []
+
+            def waiter():
+                t = time.monotonic()
+                try:
+                    conn2.recv("pending", timeout=30.0)
+                except RuntimeError:
+                    errs.append(time.monotonic() - t)
+
+            th = threading.Thread(target=waiter)
+            th.start()
+            time.sleep(0.2)  # let the recv register its queue
+            b2.close()
+            th.join(timeout=5)
+            assert not th.is_alive()
+            assert errs and errs[0] < 2.0
+        finally:
+            conn2.close()
+    finally:
+        conn.close()
+        try:
+            b.close()
+        except OSError:
+            pass
+
+
+def test_collective_abort_propagates_to_live_peers(store):
+    """A rank that abandons a collective (its own leg failed) must unblock
+    the OTHER ranks' pending waits on that collective immediately — one
+    wedged tag wait otherwise holds the whole group's next quorum hostage
+    for the full socket timeout. Rank 2's alltoall dies instantly on a
+    local ValueError; ranks 0/1 are mid-allreduce on the same collective
+    sequence number and must fail fast via the abort broadcast (including
+    transitively: rank 1 first blocks on healthy rank 0, whose own abort
+    re-broadcast is what unblocks it)."""
+    import time
+
+    groups = _make_group(store, 3, timeout=30.0)
+    t0 = time.monotonic()
+
+    def survivor(r):
+        work = groups[r].allreduce(np.ones(64, dtype=np.float32))
+        with pytest.raises(Exception, match="aborted|died"):
+            work.wait(timeout=60)
+
+    def failer():
+        # Wrong input count: fails locally before any wire traffic.
+        work = groups[2].alltoall([np.ones(4, dtype=np.float32)])
+        with pytest.raises(ValueError):
+            work.wait(timeout=60)
+
+    _run_parallel([lambda: survivor(0), lambda: survivor(1), failer])
+    elapsed = time.monotonic() - t0
+    # Without abort propagation the survivors wait out the 30s tag timeout.
+    assert elapsed < 10, f"abort took {elapsed:.1f}s to propagate"
+    for g in groups:
+        g.shutdown()
+
+
 def test_crash_and_reconfigure(store):
     """The resiliency scenario (reference: process_group_test.py:961-1020):
     kill the last rank mid-life, survivors' collectives raise, then a

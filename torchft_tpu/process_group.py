@@ -125,6 +125,12 @@ class ProcessGroup:
 _LEN = struct.Struct(">I")
 
 
+class _CollectiveAborted(RuntimeError):
+    """A peer abandoned this collective (its own leg failed) and told us —
+    waiting out the tag timeout would wedge the whole group's control plane
+    behind one rank's data-plane stall."""
+
+
 class _PeerConn:
     """One TCP connection to a peer rank with a tag-routing reader thread."""
 
@@ -139,6 +145,9 @@ class _PeerConn:
         self.send_lock = threading.Lock()
         self._queues: Dict[str, queue_mod.Queue] = {}
         self._queues_lock = threading.Lock()
+        # Collective-tag prefixes this peer told us it abandoned (dies with
+        # the connection at reconfigure; bounded by aborts per generation).
+        self._aborted: Dict[str, str] = {}
         self.dead: Optional[Exception] = None
         self._reader = threading.Thread(
             target=self._read_loop, name=f"pg-peer-{peer}", daemon=True
@@ -162,6 +171,20 @@ class _PeerConn:
                 # strand a message in an unlinked queue.
                 with self._queues_lock:
                     tag = header["tag"]
+                    if header.get("abort"):
+                        # The peer abandoned collective `tag`: fail every
+                        # pending wait under it, and remember the prefix so
+                        # recvs issued later fail too (same GIL ordering
+                        # argument as self.dead in recv()).
+                        err = _CollectiveAborted(
+                            f"collective {tag!r} aborted by rank "
+                            f"{self.peer}: {header.get('error', '')}"
+                        )
+                        self._aborted[tag] = header.get("error", "")
+                        for t, q in self._queues.items():
+                            if t == tag or t.startswith(tag + "."):
+                                q.put(err)
+                        continue
                     q = self._queues.get(tag)
                     if q is None:
                         q = self._queues[tag] = queue_mod.Queue()
@@ -193,17 +216,63 @@ class _PeerConn:
         # measurable on any backend (telemetry.byte_stats).
         add_bytes("pg_wire_tx", data.nbytes)
 
-    def recv(self, tag: str, timeout: float) -> np.ndarray:
+    def send_abort(self, tag: str, msg: str) -> None:
+        """Best-effort: tell the peer we abandoned collective ``tag`` so its
+        pending/future waits under it fail now instead of timing out (one
+        rank's wedged tag wait otherwise holds the whole group's next
+        quorum hostage — the peer can't re-register until it unblocks)."""
         try:
-            item = self._queue(tag).get(timeout=timeout)
+            with self.send_lock:
+                _net.send_json(
+                    self.sock, {"tag": tag, "abort": True, "error": msg}
+                )
+                _net.send_frame(self.sock, b"")
+        except (OSError, RuntimeError):
+            pass  # dead/closing conn: its reader death already fails waits
+
+    def recv(self, tag: str, timeout: float) -> np.ndarray:
+        q = self._queue(tag)
+        try:
+            # A message the peer delivered before dying must still be
+            # consumable (FIFO: data sits ahead of any death marker).
+            item = q.get_nowait()
         except queue_mod.Empty:
-            raise TimeoutError(
-                f"timed out after {timeout}s waiting for tag {tag!r} from rank "
-                f"{self.peer}"
-            ) from None
+            # Dead-check AFTER creating the queue: the reader's death
+            # broadcast only reaches queues that exist when it runs, so a
+            # recv issued after the peer died would otherwise wait out the
+            # full timeout on a queue nobody will ever fail (measured: a
+            # SIGKILLed peer cost survivors two consecutive 30s timeout
+            # rounds — the send side fails fast on self.dead, the recv side
+            # silently waited). Ordering is airtight under the GIL: the
+            # reader sets self.dead BEFORE its push loop takes
+            # _queues_lock, and _queue() takes the same lock — either our
+            # queue existed during the push (exception delivered) or it was
+            # created after, in which case self.dead is already visible
+            # here.
+            if self.dead is not None:
+                raise RuntimeError(
+                    f"connection to rank {self.peer} died"
+                ) from self.dead
+            with self._queues_lock:  # reader inserts under the same lock
+                aborted = list(self._aborted.items())
+            for prefix, msg in aborted:
+                if tag == prefix or tag.startswith(prefix + "."):
+                    raise _CollectiveAborted(
+                        f"collective {prefix!r} aborted by rank "
+                        f"{self.peer}: {msg}"
+                    )
+            try:
+                item = q.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"timed out after {timeout}s waiting for tag {tag!r} "
+                    f"from rank {self.peer}"
+                ) from None
         if isinstance(item, Exception):
             # Re-queue so other waiters see it too.
             self._queue(tag).put(item)
+            if isinstance(item, _CollectiveAborted):
+                raise item
             raise RuntimeError(f"connection to rank {self.peer} died") from item
         header, payload = item
         # Tags are single-use per message: drop the drained queue so a long
@@ -373,7 +442,11 @@ class ProcessGroupSocket(ProcessGroup):
             return f"c{self._seq}"
 
     def _submit(
-        self, fn: Callable[[], Any], op: str = "op", nbytes: int = 0
+        self,
+        fn: Callable[[], Any],
+        op: str = "op",
+        nbytes: int = 0,
+        tag: Optional[str] = None,
     ) -> Work:
         executor = self._executor
         if executor is None or self._errored is not None:
@@ -389,6 +462,18 @@ class ProcessGroupSocket(ProcessGroup):
                 result = fn()
             except Exception as e:
                 flight_recorder.complete(seq, error=str(e))
+                # Tell live peers we abandoned this collective so their
+                # pending tag waits fail NOW: one rank wedged on a dead
+                # peer's tag holds everyone else's next quorum hostage
+                # (survivors can't re-register while blocked), which turned
+                # one SIGKILL into back-to-back 30s timeout rounds before
+                # this (HEAL_DRILL_r05 sigkill_control). TimeoutError is
+                # exempt: a per-tag timeout can be a handled, retryable
+                # event (the parameter server's idle keepalive recv), not
+                # proof the collective is doomed — the peers' own timeouts
+                # still bound them.
+                if tag is not None and not isinstance(e, TimeoutError):
+                    self._broadcast_abort(tag, e)
                 if self._errored is None:
                     self._errored = e
                 raise
@@ -401,6 +486,11 @@ class ProcessGroupSocket(ProcessGroup):
             flight_recorder.complete(seq, error=f"never ran: {e}")
             return ErrorWork(e)
 
+    def _broadcast_abort(self, tag: str, exc: Exception) -> None:
+        """Best-effort abort fan-out to every live peer connection."""
+        for conn in list(self._peers.values()):
+            conn.send_abort(tag, str(exc))
+
     # -- collectives -------------------------------------------------------
 
     def allreduce(self, tensors: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
@@ -410,6 +500,7 @@ class ProcessGroupSocket(ProcessGroup):
             lambda: self._allreduce(arrays, op, tag),
             op="allreduce",
             nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
         )
 
     def _allreduce(
@@ -466,7 +557,7 @@ class ProcessGroupSocket(ProcessGroup):
                 ]
             return out  # type: ignore[return-value]
 
-        return self._submit(run, op="allgather")
+        return self._submit(run, op="allgather", tag=tag)
 
     def broadcast(self, tensors: Any, root: int = 0) -> Work:
         arrays = _as_list(tensors)
@@ -484,7 +575,7 @@ class ProcessGroupSocket(ProcessGroup):
                 np.copyto(a, received.reshape(a.shape).astype(a.dtype, copy=False))
             return arrays
 
-        return self._submit(run, op="broadcast")
+        return self._submit(run, op="broadcast", tag=tag)
 
     def reduce_scatter(
         self, inputs: Sequence[Any], op: ReduceOp = ReduceOp.SUM
@@ -507,7 +598,7 @@ class ProcessGroupSocket(ProcessGroup):
                 acc /= self._world
             return acc
 
-        return self._submit(run, op="reduce_scatter")
+        return self._submit(run, op="reduce_scatter", tag=tag)
 
     def alltoall(self, inputs: Sequence[Any]) -> Work:
         arrays = _as_list(inputs)
@@ -527,7 +618,7 @@ class ProcessGroupSocket(ProcessGroup):
                 out[peer] = conn.recv(tag, self._timeout)
             return out  # type: ignore[return-value]
 
-        return self._submit(run, op="alltoall")
+        return self._submit(run, op="alltoall", tag=tag)
 
     def barrier(self) -> Work:
         token = np.zeros(1, dtype=np.int32)
@@ -542,7 +633,7 @@ class ProcessGroupSocket(ProcessGroup):
             for i, a in enumerate(arrays):
                 conn.send(f"p2p.{base}.{i}", a)
 
-        return self._submit(run, op="send")
+        return self._submit(run, op="send", tag=f"p2p.{base}")
 
     def recv(self, src: int, tag: str = "", num_tensors: int = 1) -> Work:
         base = tag or self._next_tag()
@@ -554,7 +645,7 @@ class ProcessGroupSocket(ProcessGroup):
                 for i in range(num_tensors)
             ]
 
-        return self._submit(run, op="recv")
+        return self._submit(run, op="recv", tag=f"p2p.{base}")
 
 
 # ---------------------------------------------------------------------------
