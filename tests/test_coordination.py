@@ -382,6 +382,79 @@ def test_manager_server_dies_with_parent():
         lh.shutdown()
 
 
+def test_manager_leaves_lighthouse_when_parent_dies():
+    """SIGKILL of the trainer: its manager server's parent-death watchdog
+    sends a leave on the trainer's behalf before exiting, so survivors
+    shrink at watchdog-poll speed (~0.5 s) instead of heartbeat expiry —
+    the lighthouse here uses a 60 s heartbeat timeout, so only the leave
+    can explain the entry vanishing within seconds."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=1,
+        heartbeat_timeout_ms=60000,
+    )
+    client = LighthouseClient(lh.address())
+    child = None
+    try:
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                (
+                    "import sys, time; sys.path.insert(0, %r); "
+                    "from torchft_tpu.coordination import ManagerServer; "
+                    "ms = ManagerServer(replica_id='crasher:x', "
+                    "lighthouse_addr=%r, store_address='127.0.0.1:1/x', "
+                    "world_size=1, heartbeat_interval_ms=50); "
+                    "print('READY', flush=True); time.sleep(60)"
+                )
+                % (
+                    str(__import__("pathlib").Path(__file__).parent.parent),
+                    lh.address(),
+                ),
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        import select
+
+        ready, _, _ = select.select([child.stdout], [], [], 30)
+        assert ready and child.stdout.readline().startswith("READY")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "crasher:x" in client.status()["heartbeat_ages_ms"]:
+                break
+            time.sleep(0.05)
+        assert "crasher:x" in client.status()["heartbeat_ages_ms"]
+
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+        # Entry must vanish via the watchdog's leave, far before the 60 s
+        # heartbeat timeout (watchdog poll 500 ms + leave RPC + margin for
+        # the loaded 1-core box).
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if "crasher:x" not in client.status()["heartbeat_ages_ms"]:
+                break
+            time.sleep(0.1)
+        status = client.status()
+        assert "crasher:x" not in status["heartbeat_ages_ms"]
+        assert "crasher:x" in status["left"]
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+        client.close()
+        lh.shutdown()
+
+
 def test_parse_addr_accepts_reference_url_forms():
     """TORCHFT_LIGHTHOUSE in the reference is a full URL (http://host:port,
     manager.py:76-80); both spellings must resolve identically."""

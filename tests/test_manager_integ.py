@@ -849,7 +849,10 @@ def test_allreduce_reduce_op_sum(lighthouse) -> None:
 
     def run(replica: int):
         manager = Manager(
-            pg=ProcessGroupSocket(timeout=10.0),
+            # 30s, matching the wait budget below: a 10s inner tag timeout
+            # occasionally fired under full-suite load (passes in
+            # isolation), failing the commit vote with no retry.
+            pg=ProcessGroupSocket(timeout=30.0),
             min_replica_size=2,
             use_async_quorum=False,
             timeout=20.0,

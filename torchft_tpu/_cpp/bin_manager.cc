@@ -20,6 +20,7 @@ static const char* kUsage =
 
 int main(int argc, char** argv) {
   tft::ManagerOpts opts;
+  int64_t parent_pid = 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> std::string {
@@ -50,7 +51,7 @@ int main(int argc, char** argv) {
     } else if (a == "--quorum-retries") {
       opts.quorum_retries = std::stoll(next());
     } else if (a == "--parent-pid") {
-      tft::watch_parent(std::stoll(next()));
+      parent_pid = std::stoll(next());
     } else {
       fprintf(stderr, "unknown flag '%s'\n%s", a.c_str(), kUsage);
       return 2;
@@ -68,6 +69,21 @@ int main(int argc, char** argv) {
   }
   printf("LISTENING %d\n", server.port());
   fflush(stdout);
+  if (parent_pid > 0) {
+    // Armed after start() so the on-death hook has a live server. If the
+    // trainer already died during startup, the first poll fires at once.
+    // Leaving on the trainer's behalf cuts the survivors' stall for an
+    // abrupt trainer death from heartbeat expiry (~5 s) to one watchdog
+    // poll (~0.5 s); heartbeat expiry remains the backstop for
+    // whole-machine loss, where nobody is left to send the leave.
+    // Small budget: if the lighthouse is unreachable too (machine or
+    // partition loss — where the leave is moot and heartbeat expiry is
+    // the designed backstop), the orphan must still exit within ~1.5 s,
+    // not hang out the full connect timeout holding its port.
+    tft::watch_parent(parent_pid, [&server] {
+      server.leave("trainer died", /*budget_ms=*/1500);
+    });
+  }
   while (true) tft::sleep_ms(1000);
   return 0;
 }

@@ -140,27 +140,8 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     _exit(1);
   }
   if (type == "leave") {
-    // Graceful drain: stop our lighthouse heartbeats FIRST so a racing ping
-    // can't resurrect the entry, then tell the lighthouse to drop us (its
-    // tombstone covers the one heartbeat that may already be in flight).
-    draining_ = true;
-    bool sent = false;
-    std::string host;
-    int port = 0;
-    if (split_host_port(opts_.lighthouse_addr, &host, &port)) {
-      int fd = tcp_connect(host, port, opts_.connect_timeout_ms);
-      if (fd >= 0) {
-        Json lv = Json::object();
-        lv["type"] = Json::of("leave");
-        lv["replica_id"] = Json::of(opts_.replica_id);
-        Json lresp;
-        int64_t budget = std::max<int64_t>(500, deadline_ms - now_ms());
-        sent = call_json(fd, lv, &lresp, budget) && lresp.get("ok").as_bool();
-        close(fd);
-      }
-    }
-    fprintf(stderr, "[manager %s] leaving quorum (graceful drain, sent=%d)\n",
-            opts_.replica_id.c_str(), sent ? 1 : 0);
+    bool sent = leave("graceful drain",
+                      std::max<int64_t>(500, deadline_ms - now_ms()));
     resp["ok"] = Json::of(true);
     resp["sent"] = Json::of(sent);
     return resp;
@@ -208,6 +189,43 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(const QuorumMember& me,
     if (a + 1 < attempts) sleep_ms(std::min<int64_t>(100, deadline_ms - now_ms()));
   }
   return std::nullopt;
+}
+
+bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
+  // Stop our lighthouse heartbeats FIRST so a racing ping can't resurrect
+  // the entry, then tell the lighthouse to drop us (its tombstone covers
+  // the one heartbeat that may already be in flight). A repeat call (e.g.
+  // a second local rank's leave RPC, or the RPC racing the parent-death
+  // watchdog) short-circuits only once the lighthouse has CONFIRMED —
+  // otherwise it retries the send, so a transient connect failure on the
+  // first attempt can't latch a false "sent" while survivors stall out
+  // the heartbeat expiry. Concurrent duplicate sends are harmless (the
+  // lighthouse leave is idempotent).
+  draining_ = true;
+  if (left_sent_) return true;
+  bool sent = false;
+  std::string host;
+  int port = 0;
+  if (split_host_port(opts_.lighthouse_addr, &host, &port)) {
+    // Connect capped by the caller's budget: the parent-death watchdog
+    // passes a small budget so an unreachable lighthouse (whole-machine /
+    // partition loss, where the leave is moot anyway) can't hold the
+    // orphaned binary alive for the full connect timeout.
+    int fd = tcp_connect(host, port,
+                         std::min<int64_t>(budget_ms, opts_.connect_timeout_ms));
+    if (fd >= 0) {
+      Json lv = Json::object();
+      lv["type"] = Json::of("leave");
+      lv["replica_id"] = Json::of(opts_.replica_id);
+      Json lresp;
+      sent = call_json(fd, lv, &lresp, budget_ms) && lresp.get("ok").as_bool();
+      close(fd);
+    }
+  }
+  if (sent) left_sent_ = true;
+  fprintf(stderr, "[manager %s] leaving quorum (%s, sent=%d)\n",
+          opts_.replica_id.c_str(), reason.c_str(), sent ? 1 : 0);
+  return sent;
 }
 
 Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {

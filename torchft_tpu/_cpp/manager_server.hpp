@@ -62,6 +62,13 @@ class ManagerServer {
     return opts_.advertise_host + ":" + std::to_string(port_);
   }
 
+  // Graceful drain: stop heartbeating, tell the lighthouse to drop this
+  // replica. Idempotent; returns whether the lighthouse confirmed. Called
+  // by the "leave" RPC (trainer-initiated drain) and by the parent-death
+  // watchdog (trainer crashed — leave on its behalf so survivors shrink at
+  // watchdog-poll speed instead of heartbeat expiry).
+  bool leave(const std::string& reason, int64_t budget_ms = 5000);
+
  private:
   void accept_loop();
   void heartbeat_loop();
@@ -80,6 +87,10 @@ class ManagerServer {
   // Set by a "leave" request: the heartbeat loop stops pinging the lighthouse
   // so the drained replica ages out instead of looking healthy forever.
   std::atomic<bool> draining_{false};
+  // Whether the lighthouse actually confirmed our leave: a repeat leave()
+  // call retries the send if the first attempt failed (a false "sent" would
+  // hide that survivors are stuck waiting out the heartbeat expiry).
+  std::atomic<bool> left_sent_{false};
   std::thread accept_thread_;
   std::thread heartbeat_thread_;
   ConnTracker conns_;

@@ -269,12 +269,13 @@ int peek_bytes(int fd, char* buf, int n, int timeout_ms) {
   return static_cast<int>(recv(fd, buf, n, MSG_PEEK));
 }
 
-void watch_parent(int64_t parent_pid) {
-  std::thread([parent_pid] {
+void watch_parent(int64_t parent_pid, std::function<void()> on_death) {
+  std::thread([parent_pid, on_death = std::move(on_death)] {
     while (true) {
       if (static_cast<int64_t>(getppid()) != parent_pid) {
         fprintf(stderr, "parent %lld died; exiting\n",
                 static_cast<long long>(parent_pid));
+        if (on_death) on_death();
         _exit(2);
       }
       sleep_ms(500);

@@ -7,6 +7,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <string>
 
 #include "json.hpp"
@@ -24,8 +25,11 @@ int64_t now_ms();
 // guard. Polling the ppid is immune to the PR_SET_PDEATHSIG pitfalls
 // (fires on spawning-*thread* exit; exec-window race under subreapers) —
 // if the parent died before this call, getppid() already differs and the
-// first poll exits.
-void watch_parent(int64_t parent_pid);
+// first poll exits. `on_death` (optional) runs before the exit — the
+// manager binary uses it to send a lighthouse leave on behalf of its dead
+// trainer, cutting the survivors' stall from heartbeat expiry (~5 s) to
+// one watchdog poll (~0.5 s).
+void watch_parent(int64_t parent_pid, std::function<void()> on_death = nullptr);
 
 // Sleep helper.
 void sleep_ms(int64_t ms);
