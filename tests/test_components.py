@@ -257,6 +257,39 @@ def test_parameter_server_sessions():
         server.shutdown()
 
 
+def test_parameter_server_idle_longer_than_timeout():
+    """A session idle past the server's timeout must still serve the next
+    request: the inner recv timeout used to latch pg.errored(), turning
+    the 'except TimeoutError: continue' keepalive into a busy-spin that
+    never issued a real recv again (the session looked open but was
+    dead). The server now polls one pending recv in timeout slices."""
+    import time
+
+    class Echo(ParameterServer):
+        def forward(self, session_id, request):
+            return request + 1.0
+
+    # 3.0, not something tighter: the server's timeout knob also bounds
+    # the session RENDEZVOUS (store ops + accept), which needs headroom
+    # under full-suite load on the 1-core box — the idle property only
+    # requires gap > timeout, not a tiny timeout.
+    server = Echo(timeout=3.0)
+    try:
+        client = ParameterServerClient(server.address(), timeout=15.0)
+        try:
+            np.testing.assert_allclose(
+                client.call(np.zeros(3, np.float32)), np.ones(3)
+            )
+            time.sleep(6.5)  # idle > 2x the server timeout
+            np.testing.assert_allclose(
+                client.call(np.full(3, 5.0, np.float32)), np.full(3, 6.0)
+            )
+        finally:
+            client.close()
+    finally:
+        server.shutdown()
+
+
 def test_sampler_tiny_dataset_large_world():
     # pad > dataset_len: every rank still gets exactly len(self) indices
     for rank in range(8):

@@ -600,7 +600,12 @@ def drill_spare_failover(args) -> dict:
     spare_group = victim = None
     spare_kill_offset = 0
     try:
-        mark = int(steps * 0.3)
+        # Kill EARLY (15% in, not 30%): abrupt-kill recovery is now
+        # step-speed (watchdog leave + abort propagation), so survivors no
+        # longer stall ~60s after the kill — the runway that lets the
+        # victim's ~35-45s relaunch pre-warm land mid-run must come from
+        # the run itself, exactly like elastic-up's sizing.
+        mark = int(steps * 0.15)
         assert _wait_step_mark(
             runner, log_dir, 0, 0, range(mark, mark + 8), 600
         ), f"group 0 never reached step {mark}"
@@ -752,10 +757,12 @@ def main() -> int:
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)
     s = sub.add_parser("spare-failover")
-    # 1200 like elastic-up: the killed ACTIVE's relaunch must rejoin (as
-    # the new spare) while the run is still live, and its ~35s pre-warm
-    # needs a full-speed runway.
-    s.add_argument("--steps", type=int, default=1200)
+    # 2000, up from elastic-up's 1200: the killed ACTIVE's relaunch must
+    # rejoin (as the new spare) while the run is still live. Survivors
+    # now recover from the kill at step speed (no masking stall), so the
+    # post-kill runway must genuinely outlive the relaunch's ~35-45s
+    # import+compile pre-warm under 3-trainer contention.
+    s.add_argument("--steps", type=int, default=2000)
     s = sub.add_parser("model-heal")
     s.add_argument("--model", choices=["moe", "pipeline", "ulysses"],
                    required=True)

@@ -207,18 +207,21 @@ bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
   std::string host;
   int port = 0;
   if (split_host_port(opts_.lighthouse_addr, &host, &port)) {
-    // Connect capped by the caller's budget: the parent-death watchdog
-    // passes a small budget so an unreachable lighthouse (whole-machine /
-    // partition loss, where the leave is moot anyway) can't hold the
-    // orphaned binary alive for the full connect timeout.
+    // One budget for the WHOLE attempt (connect + RPC): the parent-death
+    // watchdog passes a small budget so an unreachable lighthouse
+    // (whole-machine / partition loss, where the leave is moot anyway)
+    // can't hold the orphaned binary alive — a slow connect must not let
+    // the RPC wait spend the full budget again on top.
+    int64_t deadline = now_ms() + budget_ms;
     int fd = tcp_connect(host, port,
                          std::min<int64_t>(budget_ms, opts_.connect_timeout_ms));
     if (fd >= 0) {
+      int64_t remaining = std::max<int64_t>(200, deadline - now_ms());
       Json lv = Json::object();
       lv["type"] = Json::of("leave");
       lv["replica_id"] = Json::of(opts_.replica_id);
       Json lresp;
-      sent = call_json(fd, lv, &lresp, budget_ms) && lresp.get("ok").as_bool();
+      sent = call_json(fd, lv, &lresp, remaining) && lresp.get("ok").as_bool();
       close(fd);
     }
   }

@@ -12,6 +12,7 @@ implement :meth:`forward`.
 
 from __future__ import annotations
 
+import concurrent.futures as _futures
 import json
 import threading
 import uuid
@@ -85,13 +86,29 @@ class ParameterServer:
         pg = ProcessGroupSocket(timeout=self._timeout)
         try:
             pg.configure(self._session_store(session_id), rank=0, world_size=2)
+            # An idle-but-live session must not trip the per-tag collective
+            # timeout: the first INNER recv timeout would latch
+            # pg.errored(), after which every re-issued recv fails
+            # instantly — a busy-spin that never serves the client's next
+            # request. Keep the short timeout for the rendezvous above,
+            # then widen it and poll the SAME pending recv in _timeout
+            # slices; a dead client's connection EOF fails that recv
+            # promptly via the peer-death fast path, ending the session.
+            pg.set_timeout(365 * 86400.0)
             while True:
-                try:
-                    (request,) = pg.recv(src=1, tag="ps.req").wait(self._timeout)
-                except TimeoutError:
-                    continue  # idle-but-live client: keep the session open
-                except Exception:  # connection closed/aborted: session over
-                    return
+                work = pg.recv(src=1, tag="ps.req")
+                while True:
+                    try:
+                        (request,) = work.wait(self._timeout)
+                        break
+                    # concurrent.futures.TimeoutError spelled explicitly:
+                    # it only became an alias of the builtin in 3.11, and
+                    # this package supports 3.10 — the bare builtin would
+                    # fall through to the session-over branch there.
+                    except (TimeoutError, _futures.TimeoutError):
+                        continue  # idle-but-live: keep the session open
+                    except Exception:  # connection closed: session over
+                        return
                 response = self.forward(session_id, request)
                 pg.send([np.asarray(response)], dst=1, tag="ps.resp").wait(
                     self._timeout
