@@ -23,6 +23,29 @@ def maybe_pin_cpu() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def drain_signal(enabled: bool = True):
+    """Installs the preemption-drain SIGTERM handler and returns a
+    zero-arg callable reading the flag.
+
+    TPU maintenance events / preemptions deliver SIGTERM with a grace
+    period: the handler only sets a flag; the training loop drains at its
+    next step boundary (finish the step, ``manager.leave()``, exit 0) so
+    the last commit stays clean. A second SIGTERM escalates to default
+    kill semantics — a trainer wedged in a collective that never reaches
+    a boundary must stay killable."""
+    import signal
+
+    flag = [False]
+    if enabled:
+
+        def _on_sigterm(_signum, _frame):
+            flag[0] = True
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    return lambda: flag[0]
+
+
 def group_data_seed(replica_group: str) -> int:
     """Deterministic data-shard seed for a replica group id: stable
     ACROSS process incarnations (``hash()`` is per-process randomized,

@@ -66,11 +66,18 @@ def test_lighthouse_heartbeat_and_status(lighthouse) -> None:
 def test_lighthouse_http_dashboard(lighthouse) -> None:
     import urllib.request
 
+    client = LighthouseClient(lighthouse.address())
+    client.heartbeat("dash-replica")
+    client.close()
     with urllib.request.urlopen(
         f"http://{lighthouse.address()}/status", timeout=5
     ) as resp:
         body = resp.read().decode()
     assert "torchft-tpu lighthouse" in body
+    # Per-replica action buttons: kill (reference parity) AND drain
+    # (graceful leave; no reference analog).
+    assert "/replica/dash-replica/kill" in body
+    assert "/replica/dash-replica/drain" in body
     with urllib.request.urlopen(
         f"http://{lighthouse.address()}/status.json", timeout=5
     ) as resp:

@@ -350,6 +350,91 @@ def test_graceful_drain_leave() -> None:
     assert elapsed < 60, f"drain path took {elapsed:.1f}s"
 
 
+def test_operator_requested_drain() -> None:
+    """Operator-initiated drain: a lighthouse ``drain`` RPC (the dashboard
+    drain button) sets a flag the trainer sees via
+    ``manager.drain_requested()`` on its next quorum; it then drains
+    exactly like a preemption SIGTERM. No reference analog (the reference
+    dashboard only kills)."""
+    import time
+
+    from torchft_tpu.coordination import LighthouseClient
+
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=1000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=30000,
+    )
+    total_steps = 300
+    outcome: Dict[int, Dict[str, Any]] = {}
+    managers: Dict[int, Manager] = {}
+    target_training = threading.Event()
+
+    def run(replica: int) -> None:
+        params = {"w": np.zeros(4, dtype=np.float32)}
+
+        def load_state(state):
+            params["w"][...] = state["w"]
+
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            state_dict=lambda: {"w": params["w"].copy()},
+            load_state_dict=load_state,
+            min_replica_size=1,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            replica_id=f"opdrain{replica}",
+            lighthouse_addr=server.address(),
+            group_rank=0,
+            group_world_size=1,
+        )
+        managers[replica] = manager
+        drained = False
+        try:
+            while manager.current_step() < total_steps:
+                if replica == 1 and manager.drain_requested():
+                    assert manager.leave() is True
+                    drained = True
+                    break
+                manager.start_quorum()
+                step = manager.current_step()
+                if replica == 1 and step >= 2:
+                    target_training.set()
+                work = manager.allreduce(
+                    np.full(4, 1.0 + step, dtype=np.float32)
+                )
+                (g,) = work.wait(timeout=30)
+                with manager.fenced_state_dict():
+                    if manager.should_commit():
+                        params["w"] -= 0.01 * g
+            outcome[replica] = {
+                "drained": drained,
+                "final_step": manager.current_step(),
+            }
+        finally:
+            manager.shutdown()
+
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        futs = [pool.submit(run, r) for r in range(2)]
+        assert target_training.wait(timeout=60), "replica 1 never trained"
+        client = LighthouseClient(server.address())
+        client.request_drain(managers[1].replica_id())
+        client.close()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+        server.shutdown()
+
+    assert outcome[1]["drained"], outcome
+    assert 0 < outcome[1]["final_step"] < total_steps, outcome
+    # Replica 0 was never asked to drain and runs to completion.
+    assert not outcome[0]["drained"]
+    assert outcome[0]["final_step"] == total_steps
+
+
 def test_manager_quantized_jax_allreduce(lighthouse) -> None:
     """manager.allreduce(jax_arrays, should_quantize=True) takes the
     device-quantized path end-to-end across two live replica groups:

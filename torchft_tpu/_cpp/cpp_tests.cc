@@ -506,6 +506,59 @@ static void test_manager_leave() {
   lh.stop();
 }
 
+static void test_operator_drain_request() {
+  // Operator-initiated drain: the lighthouse "drain" RPC forwards a
+  // request_drain to the member's manager; the flag rides every later
+  // quorum response so the TRAINER can drain at a safe step boundary.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 2000;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 60000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+
+  ManagerOpts mo;
+  mo.replica_id = "drainee";
+  mo.lighthouse_addr = lh.address();
+  mo.store_address = "store-x";
+  mo.world_size = 1;
+  mo.heartbeat_interval_ms = 50;
+  ManagerServer m(mo);
+  CHECK(m.start());
+  sleep_ms(200);  // let the heartbeat register at the lighthouse
+
+  auto quorum_req = [&](int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["group_rank"] = Json::of(int64_t(0));
+    req["step"] = Json::of(step);
+    req["checkpoint_metadata"] = Json::of(std::string("meta"));
+    req["init_sync"] = Json::of(false);
+    req["timeout_ms"] = Json::of(int64_t(8000));
+    return lighthouse_call(m.address(), req, 9000);
+  };
+
+  Json a = quorum_req(1);
+  CHECK(a.get("ok").as_bool());
+  CHECK(!a.get("drain_requested").as_bool());
+
+  // Operator drains via the lighthouse (the dashboard button's RPC).
+  Json dreq = Json::object();
+  dreq["type"] = Json::of("drain");
+  dreq["replica_id"] = Json::of(std::string("drainee"));
+  Json dresp = lighthouse_call(lh.address(), dreq, 3000);
+  CHECK(dresp.get("ok").as_bool());
+  CHECK(dresp.get("sent").as_bool());
+
+  a = quorum_req(2);
+  CHECK(a.get("ok").as_bool());
+  CHECK(a.get("drain_requested").as_bool());
+
+  m.stop();
+  lh.stop();
+}
+
 static void test_lighthouse_quorum_timeout() {
   LighthouseOpts opt;
   opt.min_replicas = 2;
@@ -655,6 +708,7 @@ int main() {
   test_lighthouse_e2e();
   test_lighthouse_leave();
   test_manager_leave();
+  test_operator_drain_request();
   test_lighthouse_quorum_timeout();
   test_manager_e2e();
   fprintf(stderr, "%d checks, %d failures\n", g_checks, g_failures);

@@ -192,8 +192,10 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     resp["status"] = status_json();
     return resp;
   }
-  if (type == "kill") {
-    // Forward a kill to the member's manager address (lighthouse.rs:454-479).
+  if (type == "kill" || type == "drain") {
+    // Forward to the member's manager address (kill: lighthouse.rs:454-479;
+    // drain: no reference analog — asks the trainer to leave gracefully at
+    // its next step boundary instead of exit(1)).
     std::string replica_id = req.get("replica_id").as_str();
     std::string addr;
     {
@@ -210,13 +212,17 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
       resp["error"] = Json::of("unknown replica " + replica_id);
       return resp;
     }
-    Json kill = Json::object();
-    kill["type"] = Json::of("kill");
-    kill["msg"] = Json::of("killed via lighthouse");
+    Json fwd = Json::object();
+    if (type == "kill") {
+      fwd["type"] = Json::of("kill");
+      fwd["msg"] = Json::of("killed via lighthouse");
+    } else {
+      fwd["type"] = Json::of("request_drain");
+    }
     Json ignored;
-    bool ok = call_json_addr(addr, kill, &ignored, 5000);
-    // The victim exits without replying; treat connection-level failure after
-    // send as success-ish.
+    bool ok = call_json_addr(addr, fwd, &ignored, 5000);
+    // A kill victim exits without replying; treat connection-level failure
+    // after send as success-ish.
     resp["ok"] = Json::of(true);
     resp["sent"] = Json::of(ok);
     return resp;
@@ -326,7 +332,10 @@ std::string Lighthouse::render_status_html() {
   for (const auto& kv : s.get("heartbeat_ages_ms").obj) {
     html << "<tr><td>" << kv.first << "</td><td>" << kv.second.as_int()
          << "</td><td><form method=post action=\"/replica/" << kv.first
-         << "/kill\"><button>kill</button></form></td></tr>";
+         << "/kill\" style=\"display:inline\"><button>kill</button></form> "
+         << "<form method=post action=\"/replica/" << kv.first
+         << "/drain\" style=\"display:inline\"><button>drain</button></form>"
+         << "</td></tr>";
   }
   html << "</table><h2>previous quorum</h2><table><tr><th>replica</th>"
        << "<th>address</th><th>step</th><th>world</th></tr>";
@@ -420,12 +429,14 @@ void Lighthouse::handle_http(int fd) {
   } else if (path == "/metrics") {
     body = render_metrics();
     ctype = "text/plain; version=0.0.4";
-  } else if (path.rfind("/replica/", 0) == 0 &&
-             path.size() > 14 &&
-             path.compare(path.size() - 5, 5, "/kill") == 0) {
-    std::string replica_id = path.substr(9, path.size() - 9 - 5);
+  } else if (path.rfind("/replica/", 0) == 0 && path.size() > 14 &&
+             (path.compare(path.size() - 5, 5, "/kill") == 0 ||
+              path.compare(path.size() - 6, 6, "/drain") == 0)) {
+    bool is_kill = path.compare(path.size() - 5, 5, "/kill") == 0;
+    size_t suffix = is_kill ? 5 : 6;
+    std::string replica_id = path.substr(9, path.size() - 9 - suffix);
     Json kreq = Json::object();
-    kreq["type"] = Json::of("kill");
+    kreq["type"] = Json::of(is_kill ? "kill" : "drain");
     kreq["replica_id"] = Json::of(replica_id);
     Json kresp = handle_request(kreq, now_ms() + 5000);
     body = kresp.dump();

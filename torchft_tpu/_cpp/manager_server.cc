@@ -146,6 +146,15 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     resp["sent"] = Json::of(sent);
     return resp;
   }
+  if (type == "request_drain") {
+    // Only sets the flag — the trainer sees it on its next quorum
+    // response and drains at a step boundary it knows is safe.
+    drain_requested_ = true;
+    fprintf(stderr, "[manager %s] drain requested (operator)\n",
+            opts_.replica_id.c_str());
+    resp["ok"] = Json::of(true);
+    return resp;
+  }
   if (type == "info") {
     resp["ok"] = Json::of(true);
     resp["replica_id"] = Json::of(opts_.replica_id);
@@ -326,6 +335,7 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
   resp["ok"] = Json::of(true);
   resp["result"] = result->to_json();
   resp["quorum"] = current_quorum_->to_json();
+  resp["drain_requested"] = Json::of(drain_requested_.load());
   return resp;
 }
 

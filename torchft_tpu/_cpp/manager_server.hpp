@@ -19,6 +19,9 @@
 //   {"type":"checkpoint_metadata","rank":r}
 //   {"type":"kill","msg":...}
 //   {"type":"leave"}   (graceful drain: stop heartbeats, tell the lighthouse)
+//   {"type":"request_drain"}   (operator asks the TRAINER to drain: sets a
+//       flag piggybacked on every quorum response as "drain_requested";
+//       the trainer drains at its next step boundary via "leave")
 //   {"type":"info"}
 #pragma once
 
@@ -91,6 +94,10 @@ class ManagerServer {
   // call retries the send if the first attempt failed (a false "sent" would
   // hide that survivors are stuck waiting out the heartbeat expiry).
   std::atomic<bool> left_sent_{false};
+  // Operator-requested drain (dashboard/RPC): surfaced to the trainer on
+  // every quorum response; the trainer owns the actual drain (finish the
+  // step, leave, exit) because only it knows a safe boundary.
+  std::atomic<bool> drain_requested_{false};
   std::thread accept_thread_;
   std::thread heartbeat_thread_;
   ConnTracker conns_;

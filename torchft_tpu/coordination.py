@@ -158,6 +158,11 @@ class QuorumResult:
     heal: bool
     commit_failures: int
     quorum: Optional[Quorum] = None
+    # Operator asked this replica group to drain (dashboard drain button /
+    # lighthouse "drain" RPC): the trainer should finish its step, call
+    # Manager.leave(), and exit 0. Piggybacked on the quorum response — no
+    # extra RPC per step.
+    drain_requested: bool = False
 
     @staticmethod
     def from_json(j: Dict[str, Any], quorum: Optional[Quorum] = None) -> "QuorumResult":
@@ -421,6 +426,18 @@ class LighthouseClient:
             timeout,
         )
 
+    def request_drain(self, replica_id: str, timeout: float = 5.0) -> None:
+        """Operator-initiated drain (the dashboard drain button's RPC):
+        forwards a request_drain to the replica's manager; the trainer sees
+        ``Manager.drain_requested()`` on its next quorum and drains at a
+        step boundary it knows is safe. No reference analog — the
+        reference dashboard only has a kill button."""
+        self._client.call(
+            {"type": "drain", "replica_id": replica_id,
+             "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+
     def close(self) -> None:
         self._client.close()
 
@@ -513,7 +530,9 @@ class ManagerClient:
             timeout + 5.0,
         )
         quorum = Quorum.from_json(resp["quorum"]) if "quorum" in resp else None
-        return QuorumResult.from_json(resp["result"], quorum)
+        result = QuorumResult.from_json(resp["result"], quorum)
+        result.drain_requested = bool(resp.get("drain_requested", False))
+        return result
 
     def _checkpoint_metadata(self, rank: int, timeout: float = 10.0) -> str:
         resp = self._client.call(

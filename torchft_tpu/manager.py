@@ -157,6 +157,7 @@ class Manager:
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._quorum_id = -1
         self._drained = False
+        self._drain_requested = False
 
         # Goodput accounting (no reference counterpart; the TPU-ecosystem
         # analog is the goodput library's productive-vs-lost split):
@@ -408,6 +409,11 @@ class Manager:
 
         quorum_id_changed = result.quorum_id != self._quorum_id
         heal = result.heal and allow_heal
+        # Operator-initiated drain flag (latched: a one-shot observation
+        # must not be lost if a later quorum response races the trainer's
+        # loop-top check).
+        if getattr(result, "drain_requested", False):
+            self._drain_requested = True
 
         # Participation (reference: manager.py:621-640). Async quorums train
         # with the max-step group only (healing ranks rejoin next step);
@@ -834,6 +840,13 @@ class Manager:
 
     def replica_id(self) -> str:
         return self._replica_id
+
+    def drain_requested(self) -> bool:
+        """True once an operator asked this replica group to drain (the
+        lighthouse dashboard's drain button / ``drain`` RPC). The trainer
+        should finish the current step, call :meth:`leave`, and exit 0 —
+        the same flow as a preemption SIGTERM."""
+        return self._drain_requested
 
     def leave(self, timeout: float = 5.0) -> bool:
         """Gracefully drains this replica group out of the quorum (e.g. on a

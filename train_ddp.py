@@ -21,13 +21,12 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import signal
 import sys
 import time
 
 import jax
 
-from _train_common import group_data_seed, maybe_pin_cpu
+from _train_common import drain_signal, group_data_seed, maybe_pin_cpu
 
 maybe_pin_cpu()  # before any backend initializes or package import
 
@@ -211,28 +210,20 @@ def main() -> int:
     # batches its first incarnation already committed.
     data_base = jax.random.PRNGKey(group_data_seed(replica_group))
 
-    # Preemption-aware graceful drain (TPU maintenance events deliver
-    # SIGTERM with a grace period): the handler only sets a flag; the loop
-    # drains at the next step boundary so the last commit stays clean.
-    drain_requested = [False]
-    if args.drain_on_sigterm:
-
-        def _on_sigterm(_signum, _frame):
-            drain_requested[0] = True
-            # Escalation: the first SIGTERM drains at the next step
-            # boundary; a second one (trainer wedged in a collective that
-            # never reaches a boundary) gets default kill semantics.
-            signal.signal(signal.SIGTERM, signal.SIG_DFL)
-
-        signal.signal(signal.SIGTERM, _on_sigterm)
+    # Preemption-aware graceful drain (SIGTERM) + operator-initiated
+    # drain (lighthouse dashboard drain button, surfaced via the quorum
+    # response): either way the loop drains at the next step boundary so
+    # the last commit stays clean.
+    sigterm_drain = drain_signal(args.drain_on_sigterm)
 
     drained = False
     metrics = telemetry.get_metrics_logger()
     while manager.current_step() < args.steps:
-        if drain_requested[0]:
+        if sigterm_drain() or manager.drain_requested():
+            why = "SIGTERM" if sigterm_drain() else "operator request"
             print(
                 f"[group {replica_group}] draining at step "
-                f"{manager.current_step()} (SIGTERM)",
+                f"{manager.current_step()} ({why})",
                 flush=True,
             )
             manager.leave()

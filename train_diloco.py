@@ -25,7 +25,7 @@ import sys
 
 import jax
 
-from _train_common import group_data_seed, maybe_pin_cpu
+from _train_common import drain_signal, group_data_seed, maybe_pin_cpu
 
 maybe_pin_cpu()  # before any backend initializes or package import
 
@@ -76,10 +76,19 @@ def main() -> int:
         help="carry quantization residuals into the next sync "
         "(recommended with --quantize-bits 4)",
     )
+    parser.add_argument(
+        "--drain-on-sigterm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="on SIGTERM (TPU maintenance event / preemption), finish the "
+        "inner step, gracefully leave the quorum at an outer boundary, "
+        "exit 0",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     replica_group = os.environ.get("REPLICA_GROUP_ID", "0")
+    sigterm_drain = drain_signal(args.drain_on_sigterm)
 
     cfg = llama_debug()
     model = Transformer(cfg)
@@ -163,7 +172,12 @@ def main() -> int:
         else:
             yield from range(args.steps)
 
+    drained = False
     for inner in inner_iter():
+        # Drain at an outer-sync boundary (see check after diloco.step):
+        # between a completed perform_sync and the next fragment's
+        # prepare, no outer allreduce is in flight, so the leave never
+        # abandons a collective peers are counting on.
         telemetry.trace_window(inner)
         kx = jax.random.fold_in(data_base, inner)
         x = jax.random.randint(
@@ -191,6 +205,16 @@ def main() -> int:
                     committed=float(committed),
                     inner_step=inner,
                 )
+            if sigterm_drain() or manager.drain_requested():
+                print(
+                    f"[group {replica_group}] draining at outer step "
+                    f"{manager.current_step()} "
+                    f"({'SIGTERM' if sigterm_drain() else 'operator request'})",
+                    flush=True,
+                )
+                manager.leave()
+                drained = True
+                break
 
     final_outer = manager.current_step()
     if args.result_dir:
@@ -213,7 +237,11 @@ def main() -> int:
             os.path.join(args.result_dir, f"group{replica_group}.json"), "w"
         ) as f:
             _json.dump(
-                {"final_outer_step": final_outer, "global_sha": h.hexdigest()},
+                {
+                    "final_outer_step": final_outer,
+                    "global_sha": h.hexdigest(),
+                    "drained": drained,
+                },
                 f,
             )
     manager.shutdown()

@@ -66,9 +66,19 @@ def main() -> int:
         "path; checkpointing/sharded.py)",
     )
     parser.add_argument("--result-dir", type=str, default=None)
+    parser.add_argument(
+        "--drain-on-sigterm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="on SIGTERM (TPU maintenance event / preemption), finish the "
+        "step, gracefully leave the quorum, exit 0",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     _maybe_pin_cpu()
+    from _train_common import drain_signal
+
+    sigterm_drain = drain_signal(args.drain_on_sigterm)
 
     import jax
     import jax.numpy as jnp
@@ -226,9 +236,18 @@ def main() -> int:
 
     metrics = telemetry.get_metrics_logger()
     losses = []
+    drained = False
     try:
         while manager.current_step() < args.steps:
             step = manager.current_step()
+            if sigterm_drain() or manager.drain_requested():
+                logging.info(
+                    "[group %s] draining at step %d (%s)", group, step,
+                    "SIGTERM" if sigterm_drain() else "operator request",
+                )
+                manager.leave()
+                drained = True
+                break
             telemetry.trace_window(step)
             manager.start_quorum()
             # Deterministic batch per step: every group that commits step k
@@ -287,6 +306,7 @@ def main() -> int:
                             )
                         ).hexdigest(),
                         "losses": losses[-5:],
+                        "drained": drained,
                     },
                     f,
                 )
