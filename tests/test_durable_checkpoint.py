@@ -33,6 +33,25 @@ def test_save_restore_roundtrip(tmp_path):
     ckpt.close()
 
 
+def test_maybe_save_state_factory_called_only_on_cadence(tmp_path):
+    """A callable state is built only when a save happens — off-cadence
+    steps must not pay the device->host materialization."""
+    ckpt = DurableCheckpointer(str(tmp_path), every=10, keep=2)
+    calls = []
+
+    def factory():
+        calls.append(True)
+        return {"w": jnp.zeros(4)}
+
+    assert not ckpt.maybe_save(7, factory)
+    assert calls == []
+    assert ckpt.maybe_save(20, factory)
+    assert calls == [True]
+    ckpt.wait()
+    assert ckpt.latest_step() == 20
+    ckpt.close()
+
+
 def test_retention_keeps_latest(tmp_path):
     ckpt = DurableCheckpointer(str(tmp_path), every=1, keep=2)
     for step in (1, 2, 3):

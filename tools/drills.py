@@ -10,6 +10,9 @@ JSON line with the outcome. These are the exact harnesses behind
     python tools/drills.py elastic-down  # 3->2 permanent departure
     python tools/drills.py drain         # SIGTERM graceful drain vs
                                          # SIGKILL survivor-stall control
+    python tools/drills.py preempt-all   # SIGTERM every group; full
+                                         # relaunch resumes from durable
+                                         # snapshots (total job loss)
     python tools/drills.py heal-storm    # SIGKILL aimed at the heal
                                          # machinery (join + transfer)
     python tools/drills.py spare-failover  # hot spare promotes, no heal
@@ -414,6 +417,100 @@ def drill_drain(args) -> dict:
     }
 
 
+def drill_preempt_all(args) -> dict:
+    """Full-job preemption: SIGTERM EVERY replica group at once (the TPU
+    maintenance-event shape for a whole pod), then relaunch the whole job
+    from scratch — including a FRESH lighthouse, i.e. total control-plane
+    loss. Live heal cannot cover this (no peer survives); the groups
+    drain gracefully with a final durable snapshot and the relaunch
+    resumes from those snapshots, finishing bitwise-identical. Groups may
+    snapshot one step apart (each drains at its own boundary); the behind
+    group live-heals forward at the first post-resume quorum."""
+    import signal as _sig
+
+    steps = args.steps
+    workdir = tempfile.mkdtemp(prefix="drill_preempt_")
+    result_dir = workdir + "/results"
+    log_dir1, log_dir2 = workdir + "/logs1", workdir + "/logs2"
+    cmd = [
+        sys.executable, "train_ddp.py", "--model", "cnn",
+        "--steps", str(steps), "--batch-size", "512",
+        "--min-replicas", "2",
+        "--durable-dir", workdir + "/durable", "--durable-every", "10",
+    ]
+    t0 = time.time()
+
+    lighthouse = _lighthouse()
+    runner = ReplicaGroupRunner(
+        _specs(cmd, 2, lighthouse, result_dir=result_dir),
+        max_restarts=0,
+        log_dir=log_dir1,
+    )
+    runner.start()
+    try:
+        assert _wait_step_mark(runner, log_dir1, 1, 0, range(12, 20), 600), (
+            "group 1 never reached step 12"
+        )
+        for g in (0, 1):
+            assert runner.kill_group(g, _sig.SIGTERM), f"SIGTERM {g} failed"
+        ok1 = runner.run_until_done(timeout=300)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    res1 = _read_results(result_dir, (0, 1))
+    all_drained = all(r and r.get("drained") for r in res1.values())
+    drained_steps = [_step(res1[0]), _step(res1[1])]
+    assert all_drained, f"not every group drained cleanly: {res1}"
+    assert ok1, "phase-1 drain did not exit cleanly everywhere"
+
+    # Total restart: fresh lighthouse, fresh processes; only the durable
+    # snapshots connect the two phases.
+    lighthouse2 = _lighthouse()
+    runner2 = ReplicaGroupRunner(
+        _specs(cmd, 2, lighthouse2, result_dir=result_dir),
+        max_restarts=0,
+        log_dir=log_dir2,
+    )
+    try:
+        runner2.start()
+        ok2 = runner2.run_until_done(timeout=600)
+    finally:
+        runner2.stop()
+        lighthouse2.shutdown()
+    res2 = _read_results(result_dir, (0, 1))
+    resumed = []
+    for g in (0, 1):
+        try:
+            text = open(
+                os.path.join(log_dir2, f"replica{g}_rank0.r0.log")
+            ).read()
+        except OSError:
+            text = ""
+        m = re.search(r"resumed from durable step (\d+)", text)
+        resumed.append(int(m.group(1)) if m else None)
+
+    assert ok2, "relaunched job did not finish cleanly"
+    # Resume must come from the DRAIN-time snapshot, not merely any
+    # periodic one — otherwise a broken save-on-drain path would still
+    # pass (the relaunch would silently fall back to the last cadence
+    # snapshot and converge bitwise anyway).
+    assert resumed == drained_steps, (
+        f"relaunch did not resume from the drain snapshots: "
+        f"resumed={resumed} drained={drained_steps}"
+    )
+    assert _sha(res2[0]) is not None and _sha(res2[0]) == _sha(res2[1]), (
+        "post-resume groups diverged"
+    )
+    return {
+        "drill": "preempt-all",
+        "drained_steps": drained_steps,
+        "resumed_from_steps": resumed,
+        "final_steps": [_step(res2[0]), _step(res2[1])],
+        "bitwise_equal": True,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
 def drill_elastic_down(args) -> dict:
     """Three groups train; one is SIGKILLed permanently (no restart
     budget); the quorum shrinks 3->2 and the survivors finish
@@ -754,6 +851,8 @@ def main() -> int:
     # Long enough that the departure at ~step 15 leaves the survivors a
     # post-stall runway for the cadence measurement.
     s.add_argument("--steps", type=int, default=60)
+    s = sub.add_parser("preempt-all")
+    s.add_argument("--steps", type=int, default=60)
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)
     s = sub.add_parser("spare-failover")
@@ -777,6 +876,7 @@ def main() -> int:
         "elastic-up": drill_elastic_up,
         "elastic-down": drill_elastic_down,
         "drain": drill_drain,
+        "preempt-all": drill_preempt_all,
         "heal-storm": drill_heal_storm,
         "spare-failover": drill_spare_failover,
         "model-heal": drill_model_heal,

@@ -60,10 +60,16 @@ class DurableCheckpointer:
         )
 
     def maybe_save(self, step: int, state: Any) -> bool:
-        """Saves iff ``step`` is on the cadence. Returns whether it saved."""
+        """Saves iff ``step`` is on the cadence. Returns whether it saved.
+
+        ``state`` may be a zero-arg callable, invoked only on cadence
+        steps — so callers whose state construction is expensive (full
+        device->host materialization every committed step) build it only
+        when a save actually happens.
+        """
         if step % self._every != 0:
             return False
-        self.save(step, state)
+        self.save(step, state() if callable(state) else state)
         return True
 
     def save(self, step: int, state: Any) -> None:

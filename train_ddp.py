@@ -111,6 +111,16 @@ def main() -> int:
         "tick speed (no heartbeat-timeout stall), and exit 0",
     )
     parser.add_argument(
+        "--durable-dir", type=str, default=None,
+        help="orbax durable-checkpoint directory (per-group subdir is "
+        "added): periodic snapshots on the --durable-every cadence, a "
+        "final snapshot on drain, and automatic resume from the latest "
+        "snapshot at startup — what survives a FULL-job preemption, "
+        "where every replica drains and there is no live peer left to "
+        "heal from",
+    )
+    parser.add_argument("--durable-every", type=int, default=50)
+    parser.add_argument(
         "--world-size-mode",
         choices=("dynamic", "fixed_with_spares"),
         default="dynamic",
@@ -210,6 +220,45 @@ def main() -> int:
     # batches its first incarnation already committed.
     data_base = jax.random.PRNGKey(group_data_seed(replica_group))
 
+    # Durable regime (composes with live heal; checkpointing/durable.py):
+    # snapshots are host-numpy state dicts, so restore reuses the exact
+    # heal-path loaders. Groups may snapshot one step apart (each drains
+    # at its own boundary); the behind group live-heals forward at the
+    # first post-resume quorum.
+    ckpt = None
+
+    def durable_state():
+        state = {
+            "optimizer": opt.state_dict(),
+            "manager": manager.state_dict(),
+        }
+        if batch_stats[0] is not None:
+            state["batch_stats"] = jax.tree_util.tree_map(
+                np.asarray, batch_stats[0]
+            )
+        return state
+
+    if args.durable_dir:
+        from torchft_tpu.checkpointing import DurableCheckpointer
+
+        ckpt = DurableCheckpointer(
+            os.path.join(args.durable_dir, f"group{replica_group}"),
+            every=args.durable_every,
+        )
+        if ckpt.latest_step() is not None:
+            snap = ckpt.restore()
+            opt.load_state_dict(snap["optimizer"])
+            if snap.get("batch_stats") is not None:
+                batch_stats[0] = snap["batch_stats"]
+            manager.load_state_dict(
+                {k: int(v) for k, v in snap["manager"].items()}
+            )
+            print(
+                f"[group {replica_group}] resumed from durable step "
+                f"{manager.current_step()}",
+                flush=True,
+            )
+
     # Preemption-aware graceful drain (SIGTERM) + operator-initiated
     # drain (lighthouse dashboard drain button, surfaced via the quorum
     # response): either way the loop drains at the next step boundary so
@@ -226,7 +275,15 @@ def main() -> int:
                 f"{manager.current_step()} ({why})",
                 flush=True,
             )
-            manager.leave()
+            manager.leave()  # unblock peers first; the save is local
+            if ckpt is not None and ckpt.latest_step() != manager.current_step():
+                ckpt.save(manager.current_step(), durable_state())
+                ckpt.wait()
+                print(
+                    f"[group {replica_group}] durable snapshot at step "
+                    f"{manager.current_step()}",
+                    flush=True,
+                )
             drained = True
             break
         step = manager.current_step()
@@ -266,7 +323,13 @@ def main() -> int:
                 num_participants=manager.num_participants(),
                 committed=float(committed),
             )
+        if committed and ckpt is not None:
+            # Pass the factory, not the state: durable_state() is a full
+            # device->host materialization, built only on cadence steps.
+            ckpt.maybe_save(manager.current_step(), durable_state)
 
+    if ckpt is not None:
+        ckpt.close()
     if args.result_dir:
         import hashlib
         import json
