@@ -23,7 +23,7 @@ def maybe_pin_cpu() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
-def drain_signal(enabled: bool = True):
+def drain_signal(enabled: bool = True, on_signal=None):
     """Installs the preemption-drain SIGTERM handler and returns a
     zero-arg callable reading the flag.
 
@@ -32,7 +32,14 @@ def drain_signal(enabled: bool = True):
     next step boundary (finish the step, ``manager.leave()``, exit 0) so
     the last commit stays clean. A second SIGTERM escalates to default
     kill semantics — a trainer wedged in a collective that never reaches
-    a boundary must stay killable."""
+    a boundary must stay killable.
+
+    ``on_signal``: optional zero-arg callable run inside the handler
+    (must be signal-safe — flags and socket shutdowns only). The
+    trainers pass ``manager.abort_pending_quorum`` through a late-bound
+    holder so a trainer blocked in a quorum wait when the SIGTERM lands
+    drains immediately instead of waiting out a quorum that may never
+    form again (every peer is draining too)."""
     import signal
 
     flag = [False]
@@ -41,9 +48,84 @@ def drain_signal(enabled: bool = True):
         def _on_sigterm(_signum, _frame):
             flag[0] = True
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            if on_signal is not None:
+                try:
+                    on_signal()
+                except Exception:  # noqa: BLE001 - never die in a handler
+                    pass
 
         signal.signal(signal.SIGTERM, _on_sigterm)
     return lambda: flag[0]
+
+
+class DurableRegime:
+    """The durable-snapshot wiring shared by the train scripts: periodic
+    orbax snapshots on a committed-step cadence, a final snapshot on
+    drain, restore-at-boot. Composes with live heal — snapshots are the
+    same host-numpy state dicts the heal path ships, so restore reuses
+    the heal loaders; what durable adds is survival of a FULL-job
+    preemption (every replica drains; no live peer left to heal from).
+
+    ``state_factory`` must return the snapshot pytree; it is called only
+    when a save actually happens (off-cadence steps pay nothing).
+    """
+
+    def __init__(self, directory, replica_group: str, every: int):
+        from torchft_tpu.checkpointing import DurableCheckpointer
+
+        self._ckpt = DurableCheckpointer(
+            os.path.join(directory, f"group{replica_group}"), every=every
+        )
+        self._group = replica_group
+
+    def restore_if_any(self):
+        """Latest snapshot as a host pytree, or None on a fresh boot."""
+        if self._ckpt.latest_step() is None:
+            return None
+        return self._ckpt.restore()
+
+    @staticmethod
+    def rehang_like(cur, saved):
+        """See ``DurableCheckpointer.rehang_like``: re-hangs ``saved``'s
+        leaves on ``cur``'s live tree structure (serialization flattens
+        optax NamedTuples and may drift leaf dtypes)."""
+        from torchft_tpu.checkpointing.durable import DurableCheckpointer
+
+        return DurableCheckpointer.rehang_like(cur, saved)
+
+    @staticmethod
+    def restore_manager(manager, snap) -> None:
+        """Loads the manager scalars from a snapshot (orbax returns them
+        as numpy 0-d arrays; the Manager stores plain ints)."""
+        manager.load_state_dict(
+            {k: int(v) for k, v in snap["manager"].items()}
+        )
+
+    def log_resumed(self, step: int) -> None:
+        # Exact phrase is load-bearing: tools/drills.py preempt-all greps
+        # "resumed from durable step N" to prove the resume source.
+        print(
+            f"[group {self._group}] resumed from durable step {step}",
+            flush=True,
+        )
+
+    def on_commit(self, step: int, state_factory) -> None:
+        self._ckpt.maybe_save(step, state_factory)
+
+    def on_drain(self, step: int, state_factory) -> None:
+        """Final synchronous snapshot at the drain boundary (skipped when
+        the cadence already captured this exact step)."""
+        if self._ckpt.latest_step() == step:
+            return
+        self._ckpt.save(step, state_factory())
+        self._ckpt.wait()
+        print(
+            f"[group {self._group}] durable snapshot at step {step}",
+            flush=True,
+        )
+
+    def close(self) -> None:
+        self._ckpt.close()
 
 
 def group_data_seed(replica_group: str) -> int:

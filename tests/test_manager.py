@@ -232,6 +232,69 @@ def test_quorum_rpc_failure_is_latched():
         m.shutdown()
 
 
+def test_abort_pending_quorum_interrupts_sync_wait():
+    """A drain abort interrupts a BLOCKED sync quorum wait promptly
+    (full-job preemption: the peers this quorum is waiting for already
+    drained, so the wait could never end) — and the manager is left
+    drainable: leave() still works."""
+    import threading
+
+    from torchft_tpu.coordination import RequestAborted
+
+    m = make_manager(use_async_quorum=False)
+    client = m._test_client
+    wake = threading.Event()
+
+    def blocked_quorum(**kw):
+        wake.wait(30.0)
+        raise RequestAborted("aborted")  # what the killed socket yields
+
+    client._quorum.side_effect = blocked_quorum
+    client.abort.side_effect = wake.set
+    try:
+        errs = []
+
+        def run():
+            try:
+                m.start_quorum()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # Wait until the RPC is actually pending, then abort.
+        import time as _time
+
+        deadline = _time.time() + 5.0
+        while not m._quorum_rpc_pending:
+            _time.sleep(0.005)
+            assert _time.time() < deadline, "RPC never started"
+        assert m.abort_pending_quorum() is True
+        t.join(5.0)
+        assert not t.is_alive(), "sync quorum wait did not abort"
+        assert isinstance(errs[0], RequestAborted)
+        assert isinstance(m.errored(), RequestAborted)  # fails fast
+        client.leave.return_value = True
+        assert m.leave() is True
+    finally:
+        m.shutdown()
+
+
+def test_start_quorum_after_drain_abort_never_waits():
+    """Once a drain abort fired, any later start_quorum aborts before
+    issuing the RPC — the signal won the race to before the wait."""
+    from torchft_tpu.coordination import RequestAborted
+
+    m = make_manager(use_async_quorum=False)
+    try:
+        assert m.abort_pending_quorum() is False  # nothing in flight
+        with pytest.raises(RequestAborted):
+            m.start_quorum()
+        m._test_client._quorum.assert_not_called()
+    finally:
+        m.shutdown()
+
+
 def test_min_replica_size_gates_commit():
     m = make_manager(
         min_replica_size=3,

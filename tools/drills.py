@@ -425,32 +425,78 @@ def drill_preempt_all(args) -> dict:
     drain gracefully with a final durable snapshot and the relaunch
     resumes from those snapshots, finishing bitwise-identical. Groups may
     snapshot one step apart (each drains at its own boundary); the behind
-    group live-heals forward at the first post-resume quorum."""
+    group live-heals forward at the first post-resume quorum.
+
+    ``--family`` picks the trainer: ddp (per-step allreduce), diloco
+    (snapshots the global fragment/outer-opt state at outer boundaries),
+    or hsdp (sharded inner mesh; restore re-shards via the heal loader)."""
     import signal as _sig
 
     steps = args.steps
     workdir = tempfile.mkdtemp(prefix="drill_preempt_")
+    durable = ["--durable-dir", workdir + "/durable"]
+    # (cmd, extra_env, kill-window manager steps, sha key, step key)
+    family = {
+        "ddp": (
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(steps), "--batch-size", "512",
+                "--min-replicas", "2", "--durable-every", "10", *durable,
+            ],
+            None,
+            range(12, 20),
+            "param_sha256",
+            "final_step",
+        ),
+        "diloco": (
+            [
+                sys.executable, "train_diloco.py",
+                "--outer-steps", str(steps), "--sync-every", "4",
+                "--n-fragments", "2", "--fragment-sync-delay", "1",
+                "--min-replicas", "2",
+                "--durable-every", "2", *durable,
+            ],
+            None,
+            range(3, 6),
+            "global_sha",
+            "final_outer_step",
+        ),
+        "hsdp": (
+            [
+                sys.executable, "train_hsdp.py", "--model", "debug",
+                "--steps", str(steps), "--min-replicas", "2",
+                "--durable-every", "5", *durable,
+            ],
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+            range(4, 10),
+            "param_sha256",
+            "final_step",
+        ),
+    }
+    cmd, extra_env, kill_marks, sha_key, step_key = family[args.family]
+
+    def fsha(res):
+        return res.get(sha_key) if res else None
+
+    def fstep(res):
+        return res.get(step_key) if res else None
+
     result_dir = workdir + "/results"
     log_dir1, log_dir2 = workdir + "/logs1", workdir + "/logs2"
-    cmd = [
-        sys.executable, "train_ddp.py", "--model", "cnn",
-        "--steps", str(steps), "--batch-size", "512",
-        "--min-replicas", "2",
-        "--durable-dir", workdir + "/durable", "--durable-every", "10",
-    ]
     t0 = time.time()
 
     lighthouse = _lighthouse()
     runner = ReplicaGroupRunner(
-        _specs(cmd, 2, lighthouse, result_dir=result_dir),
+        _specs(cmd, 2, lighthouse, result_dir=result_dir,
+               extra_env=extra_env),
         max_restarts=0,
         log_dir=log_dir1,
     )
     runner.start()
     try:
-        assert _wait_step_mark(runner, log_dir1, 1, 0, range(12, 20), 600), (
-            "group 1 never reached step 12"
-        )
+        assert _wait_step_mark(
+            runner, log_dir1, 1, 0, kill_marks, 600
+        ), f"group 1 never reached the kill window {kill_marks}"
         for g in (0, 1):
             assert runner.kill_group(g, _sig.SIGTERM), f"SIGTERM {g} failed"
         ok1 = runner.run_until_done(timeout=300)
@@ -459,7 +505,7 @@ def drill_preempt_all(args) -> dict:
         lighthouse.shutdown()
     res1 = _read_results(result_dir, (0, 1))
     all_drained = all(r and r.get("drained") for r in res1.values())
-    drained_steps = [_step(res1[0]), _step(res1[1])]
+    drained_steps = [fstep(res1[0]), fstep(res1[1])]
     assert all_drained, f"not every group drained cleanly: {res1}"
     assert ok1, "phase-1 drain did not exit cleanly everywhere"
 
@@ -467,7 +513,8 @@ def drill_preempt_all(args) -> dict:
     # snapshots connect the two phases.
     lighthouse2 = _lighthouse()
     runner2 = ReplicaGroupRunner(
-        _specs(cmd, 2, lighthouse2, result_dir=result_dir),
+        _specs(cmd, 2, lighthouse2, result_dir=result_dir,
+               extra_env=extra_env),
         max_restarts=0,
         log_dir=log_dir2,
     )
@@ -498,14 +545,14 @@ def drill_preempt_all(args) -> dict:
         f"relaunch did not resume from the drain snapshots: "
         f"resumed={resumed} drained={drained_steps}"
     )
-    assert _sha(res2[0]) is not None and _sha(res2[0]) == _sha(res2[1]), (
+    assert fsha(res2[0]) is not None and fsha(res2[0]) == fsha(res2[1]), (
         "post-resume groups diverged"
     )
     return {
-        "drill": "preempt-all",
+        "drill": f"preempt-all:{args.family}",
         "drained_steps": drained_steps,
         "resumed_from_steps": resumed,
-        "final_steps": [_step(res2[0]), _step(res2[1])],
+        "final_steps": [fstep(res2[0]), fstep(res2[1])],
         "bitwise_equal": True,
         "wall_s": round(time.time() - t0, 1),
     }
@@ -853,6 +900,9 @@ def main() -> int:
     s.add_argument("--steps", type=int, default=60)
     s = sub.add_parser("preempt-all")
     s.add_argument("--steps", type=int, default=60)
+    s.add_argument(
+        "--family", choices=("ddp", "diloco", "hsdp"), default="ddp"
+    )
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)
     s = sub.add_parser("spare-failover")

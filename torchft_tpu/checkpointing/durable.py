@@ -59,6 +59,33 @@ class DurableCheckpointer:
             ),
         )
 
+    @staticmethod
+    def rehang_like(cur: Any, saved: Any) -> Any:
+        """Re-hangs ``saved``'s leaves on ``cur``'s tree structure by
+        flattened-leaf order, casting each leaf to the live leaf's dtype.
+
+        Serialization round-trips (orbax) return optax NamedTuple chains
+        as plain containers and may drift leaf dtypes; every restore
+        site re-hangs through this one helper so the tolerance (and the
+        cast) can't diverge between them."""
+        import jax
+        import numpy as np
+
+        cur_leaves, treedef = jax.tree_util.tree_flatten(cur)
+        new_leaves = jax.tree_util.tree_leaves(saved)
+        if len(cur_leaves) != len(new_leaves):
+            raise ValueError(
+                f"state leaf count mismatch: live {len(cur_leaves)} vs "
+                f"saved {len(new_leaves)}"
+            )
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                np.asarray(n).astype(np.asarray(c).dtype, copy=False)
+                for c, n in zip(cur_leaves, new_leaves)
+            ],
+        )
+
     def maybe_save(self, step: int, state: Any) -> bool:
         """Saves iff ``step`` is on the cadence. Returns whether it saved.
 

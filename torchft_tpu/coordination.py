@@ -183,6 +183,12 @@ class QuorumResult:
         )
 
 
+class RequestAborted(RuntimeError):
+    """A blocked RPC was deliberately interrupted via ``abort()`` (drain
+    paths): distinct from transport failure so callers can translate it
+    into a graceful exit instead of an error latch + retry."""
+
+
 class _FramedClient:
     """Persistent framed-JSON connection with reconnect-on-error."""
 
@@ -191,6 +197,41 @@ class _FramedClient:
         self._connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._aborted = False
+
+    def abort(self) -> None:
+        """Interrupts a blocked ``call`` from another thread (or a signal
+        handler: takes NO locks — ``call`` holds ``_lock`` for its whole
+        duration, so a locking abort would deadlock). The blocked recv
+        fails on the closed socket and ``call`` raises RequestAborted
+        instead of reconnect-retrying."""
+        self._aborted = True
+        sock = self._sock
+        if sock is not None:
+            try:
+                # shutdown(), not just close(): close() of an fd another
+                # thread is blocked in recv() on does not reliably wake
+                # the recv; shutdown() delivers EOF to it immediately.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def clear_abort(self) -> None:
+        """Re-arms the client after an abort window closes (see
+        Manager._async_quorum): without this, an abort that raced the
+        RPC's completion would falsely kill the NEXT request. A still-set
+        flag here means exactly that race happened — abort() killed the
+        socket but no blocked recv was there to notice — so the dead
+        socket is dropped too, or the next retry=False request
+        (should_commit) would send into it and fail its single attempt."""
+        with self._lock:
+            if self._aborted:
+                self._aborted = False
+                self.close_unlocked()
 
     @property
     def addr(self) -> str:
@@ -214,6 +255,14 @@ class _FramedClient:
         votes): a reconnect-resend could double-apply a request whose first
         copy the server already processed."""
         with self._lock:
+            if self._aborted:
+                # The socket (if any) was killed by abort(); drop it so
+                # the caller after us reconnects cleanly.
+                self._aborted = False
+                self.close_unlocked()
+                raise RequestAborted(
+                    f"request {req.get('type')} to {self._addr} aborted"
+                )
             attempts = (0, 1) if retry else (1,)
             for attempt in attempts:
                 if self._sock is None:
@@ -223,11 +272,25 @@ class _FramedClient:
                     break
                 except (TimeoutError, socket.timeout) as e:
                     self.close_unlocked()
+                    if self._aborted:
+                        self._aborted = False
+                        raise RequestAborted(
+                            f"request {req.get('type')} to {self._addr} "
+                            "aborted"
+                        ) from e
                     raise TimeoutError(
                         f"request {req.get('type')} to {self._addr} timed out"
                     ) from e
-                except OSError as e:
+                except (OSError, _net.FrameError) as e:
+                    # FrameError covers the abort path's shutdown(): EOF
+                    # mid-frame on the deliberately killed connection.
                     self.close_unlocked()
+                    if self._aborted:
+                        self._aborted = False
+                        raise RequestAborted(
+                            f"request {req.get('type')} to {self._addr} "
+                            "aborted"
+                        ) from e
                     if attempt == 1:
                         raise RuntimeError(
                             f"request {req.get('type')} to {self._addr} failed: {e}"
@@ -505,6 +568,14 @@ class ManagerClient:
     @property
     def addr(self) -> str:
         return self._client.addr
+
+    def abort(self) -> None:
+        """Signal-handler-safe: interrupts a blocked RPC (see
+        _FramedClient.abort)."""
+        self._client.abort()
+
+    def clear_abort(self) -> None:
+        self._client.clear_abort()
 
     def _quorum(
         self,

@@ -419,6 +419,51 @@ class DiLoCo:
     def fragments(self) -> List[_Fragment]:
         return self._fragments
 
+    @property
+    def sync_in_flight(self) -> bool:
+        """True while a fragment sync is prepared but not yet performed
+        (the ``fragment_sync_delay`` overlap window). A drain must NOT
+        leave here — peers are counting on this collective — but equally
+        must not WAIT for a future sync to drain: that sync needs a
+        quorum the departing peers may never form again."""
+        return self._prepared is not None
+
+    def state_dict(self) -> Dict[str, Any]:
+        """The GLOBAL state as a host pytree: per-fragment backup + outer
+        optimizer state — exactly what a healed replica receives
+        (``DiLoCoFragment_{i}`` registrations). For durable snapshots:
+        this plus the caller's inner params/optimizer is a full resume
+        point after total job loss."""
+        return {
+            f"fragment_{f.index}": f._state_dict() for f in self._fragments
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Restores the global state into every fragment (resetting local
+        params to it, same as the heal path). Must be called at an outer
+        boundary — no sync may be in flight.
+
+        The outer optimizer state is re-hung on the live structure by
+        flattened-leaf order (``DurableCheckpointer.rehang_like``), so
+        the restore tolerates container-type drift through serialization
+        (orbax round-trips NamedTuples as plain containers)."""
+        from torchft_tpu.checkpointing.durable import DurableCheckpointer
+
+        assert self._prepared is None, "load_state_dict during a sync"
+        for f in self._fragments:
+            s = state[f"fragment_{f.index}"]
+            f._load_state_dict(
+                {
+                    "backup": jax.tree_util.tree_map(
+                        np.asarray, s["backup"]
+                    ),
+                    "opt_state": DurableCheckpointer.rehang_like(
+                        f._opt_state, s["opt_state"]
+                    ),
+                }
+            )
+        self._local_step = 0
+
     def _current_fragment(self) -> _Fragment:
         step = self._manager.current_step()
         return self._fragments[step % len(self._fragments)]

@@ -158,6 +158,11 @@ class Manager:
         self._quorum_id = -1
         self._drained = False
         self._drain_requested = False
+        # Drain-abort of a blocked sync quorum (see abort_pending_quorum):
+        # _quorum_rpc_pending brackets the client RPC so the abort only
+        # fires into a live (or imminent) wait.
+        self._quorum_rpc_pending = False
+        self._local_drain_abort = False
 
         # Goodput accounting (no reference counterpart; the TPU-ecosystem
         # analog is the goodput library's productive-vs-lost split):
@@ -392,16 +397,38 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, timeout: float
     ) -> None:
+        from torchft_tpu.coordination import RequestAborted
+
         try:
-            result = self._client._quorum(
-                group_rank=self._group_rank,
-                step=self._step,
-                checkpoint_metadata=self._checkpoint_transport.metadata(),
-                shrink_only=shrink_only,
-                timeout=timeout,
-                init_sync=self._init_sync,
-                commit_failures=self._commit_failures,
-            )
+            self._quorum_rpc_pending = True
+            try:
+                if self._local_drain_abort:
+                    # The drain signal won the race to before the RPC —
+                    # don't enter a wait nobody will end.
+                    raise RequestAborted("drain requested before quorum")
+                result = self._client._quorum(
+                    group_rank=self._group_rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    timeout=timeout,
+                    init_sync=self._init_sync,
+                    commit_failures=self._commit_failures,
+                )
+            finally:
+                self._quorum_rpc_pending = False
+                self._client.clear_abort()
+        except RequestAborted as e:
+            # The trainer's drain path interrupted the wait (a peer that
+            # already drained may mean this quorum can NEVER form again —
+            # waiting it out would wedge the drain past any preemption
+            # grace period). Latched so the async-quorum step path fails
+            # fast (local_ok=False) and the trainer's loop-top drain
+            # check fires next; logged at info, not exception — a
+            # deliberate interrupt, not a fault.
+            self._logger.info("quorum wait aborted by drain request")
+            self.report_error(e)
+            raise
         except Exception as e:
             self._logger.exception(f"quorum failed: {e}")
             self.report_error(e)
@@ -847,6 +874,28 @@ class Manager:
         should finish the current step, call :meth:`leave`, and exit 0 —
         the same flow as a preemption SIGTERM."""
         return self._drain_requested
+
+    def abort_pending_quorum(self) -> bool:
+        """Interrupts a blocked sync-quorum wait so a drain can proceed.
+
+        The full-job-preemption wedge this solves: every group gets
+        SIGTERM within milliseconds, but a group already blocked in a
+        sync ``start_quorum`` when its signal lands waits on a quorum
+        that can never form again (its peers drained and left) — the
+        drain would stall the whole quorum timeout, far past a typical
+        preemption grace period. Safe to call from a signal handler: it
+        only sets flags and shuts down the client socket (no locks).
+        After the abort, ``start_quorum``/``wait_quorum`` raise
+        ``coordination.RequestAborted``; the trainer's drain path
+        catches it and calls :meth:`leave` (which still works — the
+        framed client reconnects). Any later ``start_quorum`` on this
+        manager also aborts immediately: once draining, never re-wait.
+        Returns whether a live quorum RPC was interrupted."""
+        self._local_drain_abort = True
+        if self._quorum_rpc_pending:
+            self._client.abort()
+            return True
+        return False
 
     def leave(self, timeout: float = 5.0) -> bool:
         """Gracefully drains this replica group out of the quorum (e.g. on a

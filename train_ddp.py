@@ -26,7 +26,12 @@ import time
 
 import jax
 
-from _train_common import drain_signal, group_data_seed, maybe_pin_cpu
+from _train_common import (
+    DurableRegime,
+    drain_signal,
+    group_data_seed,
+    maybe_pin_cpu,
+)
 
 maybe_pin_cpu()  # before any backend initializes or package import
 
@@ -239,30 +244,26 @@ def main() -> int:
         return state
 
     if args.durable_dir:
-        from torchft_tpu.checkpointing import DurableCheckpointer
-
-        ckpt = DurableCheckpointer(
-            os.path.join(args.durable_dir, f"group{replica_group}"),
-            every=args.durable_every,
+        ckpt = DurableRegime(
+            args.durable_dir, replica_group, every=args.durable_every
         )
-        if ckpt.latest_step() is not None:
-            snap = ckpt.restore()
+        snap = ckpt.restore_if_any()
+        if snap is not None:
             opt.load_state_dict(snap["optimizer"])
             if snap.get("batch_stats") is not None:
                 batch_stats[0] = snap["batch_stats"]
-            manager.load_state_dict(
-                {k: int(v) for k, v in snap["manager"].items()}
-            )
-            print(
-                f"[group {replica_group}] resumed from durable step "
-                f"{manager.current_step()}",
-                flush=True,
-            )
+            ckpt.restore_manager(manager, snap)
+            ckpt.log_resumed(manager.current_step())
 
     # Preemption-aware graceful drain (SIGTERM) + operator-initiated
     # drain (lighthouse dashboard drain button, surfaced via the quorum
     # response): either way the loop drains at the next step boundary so
     # the last commit stays clean.
+    # No abort_pending_quorum hook here (unlike train_diloco): with an
+    # ASYNC quorum every wait is bounded (dead-peer fast-fail +
+    # collective-abort propagation), the loop-top check below drains at
+    # step speed, and an eager abort would turn "finish the step, commit,
+    # drain" into a failed final step whenever SIGTERM lands mid-step.
     sigterm_drain = drain_signal(args.drain_on_sigterm)
 
     drained = False
@@ -276,14 +277,8 @@ def main() -> int:
                 flush=True,
             )
             manager.leave()  # unblock peers first; the save is local
-            if ckpt is not None and ckpt.latest_step() != manager.current_step():
-                ckpt.save(manager.current_step(), durable_state())
-                ckpt.wait()
-                print(
-                    f"[group {replica_group}] durable snapshot at step "
-                    f"{manager.current_step()}",
-                    flush=True,
-                )
+            if ckpt is not None:
+                ckpt.on_drain(manager.current_step(), durable_state)
             drained = True
             break
         step = manager.current_step()
@@ -326,7 +321,7 @@ def main() -> int:
         if committed and ckpt is not None:
             # Pass the factory, not the state: durable_state() is a full
             # device->host materialization, built only on cadence steps.
-            ckpt.maybe_save(manager.current_step(), durable_state)
+            ckpt.on_commit(manager.current_step(), durable_state)
 
     if ckpt is not None:
         ckpt.close()

@@ -25,7 +25,12 @@ import sys
 
 import jax
 
-from _train_common import drain_signal, group_data_seed, maybe_pin_cpu
+from _train_common import (
+    DurableRegime,
+    drain_signal,
+    group_data_seed,
+    maybe_pin_cpu,
+)
 
 maybe_pin_cpu()  # before any backend initializes or package import
 
@@ -34,6 +39,7 @@ import numpy as np
 import optax
 
 from torchft_tpu import telemetry
+from torchft_tpu.coordination import RequestAborted
 from torchft_tpu.local_sgd import DiLoCo, partition_fragments
 from torchft_tpu.manager import Manager
 from torchft_tpu.models import Transformer, llama_debug
@@ -84,11 +90,27 @@ def main() -> int:
         "inner step, gracefully leave the quorum at an outer boundary, "
         "exit 0",
     )
+    parser.add_argument(
+        "--durable-dir", type=str, default=None,
+        help="orbax durable-checkpoint directory (per-group subdir "
+        "added): snapshots of the GLOBAL state (fragment backups + outer "
+        "optimizer) plus this group's inner params/optimizer on the "
+        "--durable-every OUTER-step cadence, a final snapshot on drain, "
+        "automatic resume at startup — survival of a FULL-job preemption "
+        "(no live peer left to heal from)",
+    )
+    parser.add_argument("--durable-every", type=int, default=10)
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     replica_group = os.environ.get("REPLICA_GROUP_ID", "0")
-    sigterm_drain = drain_signal(args.drain_on_sigterm)
+    # Late-bound: filled with manager.abort_pending_quorum once the
+    # Manager exists, so a SIGTERM landing while this process is blocked
+    # in a sync quorum wait interrupts the wait instead of riding it out.
+    abort_hook = [lambda: None]
+    sigterm_drain = drain_signal(
+        args.drain_on_sigterm, on_signal=lambda: abort_hook[0]()
+    )
 
     cfg = llama_debug()
     model = Transformer(cfg)
@@ -146,6 +168,7 @@ def main() -> int:
         group_rank=0,
         group_world_size=1,
     )
+    abort_hook[0] = manager.abort_pending_quorum
     diloco = DiLoCo(
         manager,
         [make_fragment(g) for g in groups],
@@ -163,6 +186,45 @@ def main() -> int:
     data_base = jax.random.PRNGKey(group_data_seed(replica_group))
     metrics = telemetry.get_metrics_logger()
 
+    # Durable regime: global state (fragment backups + outer optimizer,
+    # via DiLoCo.state_dict) plus this group's inner params/optimizer.
+    # Snapshots happen with no sync in flight (periodic saves at
+    # committed syncs; the drain save at any drainable inner step, which
+    # may be MID-window — inner params then sit a few inner steps past
+    # the fragment backups, and the restored inner stream resumes from
+    # there), so restore needs no in-flight-sync handling.
+    ckpt = None
+
+    def durable_state():
+        return {
+            "diloco": diloco.state_dict(),
+            "params": jax.tree_util.tree_map(np.asarray, state["params"]),
+            "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+            "manager": manager.state_dict(),
+        }
+
+    if args.durable_dir:
+        ckpt = DurableRegime(
+            args.durable_dir, replica_group, every=args.durable_every
+        )
+        snap = ckpt.restore_if_any()
+        if snap is not None:
+            diloco.load_state_dict(snap["diloco"])
+            # Inner state restores OVER the fragment reset: the saved
+            # inner params may sit ahead of the fragment backups (a
+            # drain snapshot taken mid-window), and are the right resume
+            # point for this group's local stream either way.
+            state["params"] = jax.tree_util.tree_map(
+                lambda cur, v: jnp.asarray(np.asarray(v), dtype=cur.dtype),
+                state["params"],
+                snap["params"],
+            )
+            opt_state = DurableRegime.rehang_like(
+                opt_state, snap["opt_state"]
+            )
+            ckpt.restore_manager(manager, snap)
+            ckpt.log_resumed(manager.current_step())
+
     def inner_iter():
         if args.outer_steps > 0:
             i = 0
@@ -173,11 +235,34 @@ def main() -> int:
             yield from range(args.steps)
 
     drained = False
+
+    def maybe_drain() -> bool:
+        # Drain whenever NO sync is in flight — the leave never abandons
+        # a collective peers are counting on, but also never WAITS for a
+        # future sync to reach a boundary: that sync needs a quorum, and
+        # when every group is draining (full-job preemption) a peer that
+        # drained one boundary earlier means the quorum never forms
+        # again and the waiter wedges. A prepared sync (the delay
+        # overlap window) is finished first; the post-sync check catches
+        # the flag then. Checked immediately before diloco.step() (the
+        # call that may block on a new quorum) so the undrainable window
+        # is sub-millisecond, not a whole inner step.
+        if not (sigterm_drain() or manager.drain_requested()):
+            return False
+        if diloco.sync_in_flight:
+            return False
+        print(
+            f"[group {replica_group}] draining at outer step "
+            f"{manager.current_step()} "
+            f"({'SIGTERM' if sigterm_drain() else 'operator request'})",
+            flush=True,
+        )
+        manager.leave()
+        if ckpt is not None:
+            ckpt.on_drain(manager.current_step(), durable_state)
+        return True
+
     for inner in inner_iter():
-        # Drain at an outer-sync boundary (see check after diloco.step):
-        # between a completed perform_sync and the next fragment's
-        # prepare, no outer allreduce is in flight, so the leave never
-        # abandons a collective peers are counting on.
         telemetry.trace_window(inner)
         kx = jax.random.fold_in(data_base, inner)
         x = jax.random.randint(
@@ -188,7 +273,23 @@ def main() -> int:
             state["params"], opt_state, x, y
         )
         state["params"] = params
-        committed = diloco.step()
+        if maybe_drain():
+            drained = True
+            break
+        try:
+            committed = diloco.step()
+        except RequestAborted:
+            # A SIGTERM mid-wait aborted the blocked quorum
+            # (abort_pending_quorum): start_quorum raised BEFORE the
+            # fragment prepared, so no sync is in flight and the global
+            # state is the untouched last boundary — safe to snapshot
+            # and drain. ONLY this exception resolves to a drain: any
+            # other failure (e.g. a torn perform_sync) must crash
+            # loudly, not exit 0 with a possibly-divergent snapshot.
+            if maybe_drain():
+                drained = True
+                break
+            raise
         if committed is not None:
             print(
                 f"[group {replica_group}] inner={inner} outer_step="
@@ -205,14 +306,9 @@ def main() -> int:
                     committed=float(committed),
                     inner_step=inner,
                 )
-            if sigterm_drain() or manager.drain_requested():
-                print(
-                    f"[group {replica_group}] draining at outer step "
-                    f"{manager.current_step()} "
-                    f"({'SIGTERM' if sigterm_drain() else 'operator request'})",
-                    flush=True,
-                )
-                manager.leave()
+            if ckpt is not None and committed:
+                ckpt.on_commit(manager.current_step(), durable_state)
+            if maybe_drain():
                 drained = True
                 break
 
@@ -244,6 +340,8 @@ def main() -> int:
                 },
                 f,
             )
+    if ckpt is not None:
+        ckpt.close()
     manager.shutdown()
     print(f"[group {replica_group}] done at outer step {final_outer}")
     return 0
