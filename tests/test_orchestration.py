@@ -258,3 +258,35 @@ def test_diloco_int4_ef_kill_heal_bitwise_equal(tmp_path):
     assert results[0]["final_outer_step"] >= outer_steps
     assert results[1]["final_outer_step"] >= outer_steps
     assert results[0]["global_sha"] == results[1]["global_sha"], results
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(480)
+def test_preempt_all_drill_diloco():
+    """Full-job preemption through the committed drill harness, diloco
+    family: every group SIGTERMed at once (exercising the blocked-quorum
+    drain abort — Manager.abort_pending_quorum — whenever the signals
+    straddle a sync boundary), final durable snapshots, relaunch under a
+    FRESH lighthouse, resume asserted from the drain-time snapshot, and
+    a bitwise-equal finish."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [
+            sys.executable, "tools/drills.py", "preempt-all",
+            "--family", "diloco", "--steps", "12",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=450,
+        cwd=repo,
+    )
+    assert out.returncode == 0, (
+        f"drill failed rc={out.returncode}\n"
+        f"stdout:\n{out.stdout[-3000:]}\nstderr:\n{out.stderr[-3000:]}"
+    )
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["bitwise_equal"] is True
+    assert report["resumed_from_steps"] == report["drained_steps"]
+    assert all(s == 12 for s in report["final_steps"]), report
