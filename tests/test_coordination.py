@@ -78,6 +78,17 @@ def test_lighthouse_http_dashboard(lighthouse) -> None:
     # (graceful leave; no reference analog).
     assert "/replica/dash-replica/kill" in body
     assert "/replica/dash-replica/drain" in body
+    # Whole-job action: drain ALL (operator-triggered full-job stop).
+    assert "/drain_all" in body
+    # Side-effecting endpoints are POST-only: a browser prefetch or a
+    # path-walking scraper GETting /drain_all must NOT stop the job.
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(
+            f"http://{lighthouse.address()}/drain_all", timeout=5
+        )
+    assert err.value.code == 405
     with urllib.request.urlopen(
         f"http://{lighthouse.address()}/status.json", timeout=5
     ) as resp:

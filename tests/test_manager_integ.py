@@ -435,6 +435,91 @@ def test_operator_requested_drain() -> None:
     assert outcome[0]["final_step"] == total_steps
 
 
+def test_operator_drain_all() -> None:
+    """Whole-job operator drain: ONE ``drain_all`` RPC (the dashboard's
+    "drain ALL" button) reaches every member's manager; each trainer
+    sees ``drain_requested()`` at its next quorum and drains at its own
+    safe boundary — the operator-triggered twin of a whole-pod
+    preemption (with --durable-dir the trainers snapshot on drain, so
+    the stopped job can relaunch and resume; tools/drills.py
+    preempt-all drills that path). No reference analog."""
+    from torchft_tpu.coordination import LighthouseClient
+
+    server = LighthouseServer(
+        min_replicas=2,
+        join_timeout_ms=2000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=30000,
+    )
+    total_steps = 300
+    outcome: Dict[int, Dict[str, Any]] = {}
+    training = [threading.Event(), threading.Event()]
+
+    def run(replica: int) -> None:
+        params = {"w": np.zeros(4, dtype=np.float32)}
+
+        def load_state(state):
+            params["w"][...] = state["w"]
+
+        manager = Manager(
+            pg=ProcessGroupSocket(timeout=10.0),
+            state_dict=lambda: {"w": params["w"].copy()},
+            load_state_dict=load_state,
+            min_replica_size=2,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            replica_id=f"drainall{replica}",
+            lighthouse_addr=server.address(),
+            group_rank=0,
+            group_world_size=1,
+        )
+        drained = False
+        try:
+            while manager.current_step() < total_steps:
+                if manager.drain_requested():
+                    assert manager.leave() is True
+                    drained = True
+                    break
+                manager.start_quorum()
+                step = manager.current_step()
+                if step >= 2:
+                    training[replica].set()
+                work = manager.allreduce(
+                    np.full(4, 1.0 + step, dtype=np.float32)
+                )
+                (g,) = work.wait(timeout=30)
+                with manager.fenced_state_dict():
+                    if manager.should_commit():
+                        params["w"] -= 0.01 * g
+            outcome[replica] = {
+                "drained": drained,
+                "final_step": manager.current_step(),
+            }
+        finally:
+            manager.shutdown()
+
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        futs = [pool.submit(run, r) for r in range(2)]
+        for ev in training:
+            assert ev.wait(timeout=60), "a replica never trained"
+        client = LighthouseClient(server.address())
+        report = client.drain_all()
+        client.close()
+        assert report["n_members"] == 2, report
+        assert report["n_sent"] == 2, report
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+        server.shutdown()
+
+    # EVERY replica drained mid-run on the single RPC.
+    for r in (0, 1):
+        assert outcome[r]["drained"], outcome
+        assert 0 < outcome[r]["final_step"] < total_steps, outcome
+
+
 def test_manager_quantized_jax_allreduce(lighthouse) -> None:
     """manager.allreduce(jax_arrays, should_quantize=True) takes the
     device-quantized path end-to-end across two live replica groups:

@@ -497,8 +497,25 @@ def drill_preempt_all(args) -> dict:
         assert _wait_step_mark(
             runner, log_dir1, 1, 0, kill_marks, 600
         ), f"group 1 never reached the kill window {kill_marks}"
-        for g in (0, 1):
-            assert runner.kill_group(g, _sig.SIGTERM), f"SIGTERM {g} failed"
+        if args.via == "operator":
+            # ONE dashboard-equivalent RPC drains the whole job: every
+            # member's manager gets request_drain; the flag rides each
+            # group's next quorum response and the trainer drains at its
+            # own safe boundary (same downstream path as the SIGTERM
+            # leg, different trigger).
+            from torchft_tpu.coordination import LighthouseClient
+
+            client = LighthouseClient(lighthouse.address())
+            report = client.drain_all()
+            client.close()
+            assert report["n_members"] == 2 and report["n_sent"] == 2, (
+                f"drain_all did not reach every member: {report}"
+            )
+        else:
+            for g in (0, 1):
+                assert runner.kill_group(g, _sig.SIGTERM), (
+                    f"SIGTERM {g} failed"
+                )
         ok1 = runner.run_until_done(timeout=300)
     finally:
         runner.stop()
@@ -550,6 +567,7 @@ def drill_preempt_all(args) -> dict:
     )
     return {
         "drill": f"preempt-all:{args.family}",
+        "via": args.via,
         "drained_steps": drained_steps,
         "resumed_from_steps": resumed,
         "final_steps": [fstep(res2[0]), fstep(res2[1])],
@@ -902,6 +920,12 @@ def main() -> int:
     s.add_argument("--steps", type=int, default=60)
     s.add_argument(
         "--family", choices=("ddp", "diloco", "hsdp"), default="ddp"
+    )
+    s.add_argument(
+        "--via", choices=("sigterm", "operator"), default="sigterm",
+        help="how the full-job drain is triggered: per-process SIGTERM "
+        "(preemption shape) or one lighthouse drain_all RPC (dashboard "
+        "'drain ALL' button)",
     )
     s = sub.add_parser("heal-storm")
     s.add_argument("--steps", type=int, default=100)

@@ -559,6 +559,85 @@ static void test_operator_drain_request() {
   lh.stop();
 }
 
+static void test_operator_drain_all() {
+  // Whole-job operator drain: one drain_all RPC forwards request_drain
+  // to EVERY registered member's manager; each member's flag rides its
+  // next quorum response (the operator-triggered twin of a whole-pod
+  // preemption — pairs with the trainers' durable final snapshots).
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 2000;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 60000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+
+  auto mk = [&](const std::string& id) {
+    ManagerOpts mo;
+    mo.replica_id = id;
+    mo.lighthouse_addr = lh.address();
+    mo.store_address = "store-x";
+    mo.world_size = 1;
+    mo.heartbeat_interval_ms = 50;
+    return new ManagerServer(mo);
+  };
+  ManagerServer* m0 = mk("job-a");
+  ManagerServer* m1 = mk("job-b");
+  CHECK(m0->start());
+  CHECK(m1->start());
+
+  auto quorum_req = [&](ManagerServer* m, int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["group_rank"] = Json::of(int64_t(0));
+    req["step"] = Json::of(step);
+    req["checkpoint_metadata"] = Json::of(std::string("meta"));
+    req["init_sync"] = Json::of(false);
+    req["timeout_ms"] = Json::of(int64_t(8000));
+    return lighthouse_call(m->address(), req, 9000);
+  };
+
+  // Register BOTH members via a concurrent quorum round (drain_all
+  // forwards to the lighthouse's participant map, which quorum
+  // registration fills; the split-brain guard means each request waits
+  // for the other, so they must be issued together).
+  Json a0, a1;
+  {
+    std::thread t0([&] { a0 = quorum_req(m0, 1); });
+    std::thread t1([&] { a1 = quorum_req(m1, 1); });
+    t0.join();
+    t1.join();
+  }
+  CHECK(a0.get("ok").as_bool());
+  CHECK(a1.get("ok").as_bool());
+  CHECK(!a0.get("drain_requested").as_bool());
+  CHECK(!a1.get("drain_requested").as_bool());
+
+  Json dreq = Json::object();
+  dreq["type"] = Json::of("drain_all");
+  Json dresp = lighthouse_call(lh.address(), dreq, 8000);
+  CHECK(dresp.get("ok").as_bool());
+  CHECK(dresp.get("n_sent").as_int() == 2);
+  CHECK(dresp.get("n_members").as_int() == 2);
+  CHECK(dresp.get("sent").get("job-a").as_bool());
+  CHECK(dresp.get("sent").get("job-b").as_bool());
+
+  {
+    std::thread t0([&] { a0 = quorum_req(m0, 2); });
+    std::thread t1([&] { a1 = quorum_req(m1, 2); });
+    t0.join();
+    t1.join();
+  }
+  CHECK(a0.get("drain_requested").as_bool());
+  CHECK(a1.get("drain_requested").as_bool());
+
+  m0->stop();
+  m1->stop();
+  delete m0;
+  delete m1;
+  lh.stop();
+}
+
 static void test_lighthouse_quorum_timeout() {
   LighthouseOpts opt;
   opt.min_replicas = 2;
@@ -709,6 +788,7 @@ int main() {
   test_lighthouse_leave();
   test_manager_leave();
   test_operator_drain_request();
+  test_operator_drain_all();
   test_lighthouse_quorum_timeout();
   test_manager_e2e();
   fprintf(stderr, "%d checks, %d failures\n", g_checks, g_failures);

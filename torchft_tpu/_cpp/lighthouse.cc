@@ -6,6 +6,7 @@
 
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <sstream>
 
 #include "net.hpp"
@@ -227,6 +228,62 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     resp["sent"] = Json::of(ok);
     return resp;
   }
+  if (type == "drain_all") {
+    // Operator-initiated FULL-job drain: forward request_drain to every
+    // registered member's manager. Each trainer drains at its own safe
+    // boundary (with --durable-dir that includes a final durable
+    // snapshot), so the whole job can be stopped cleanly and relaunched
+    // later — the operator-triggered twin of a whole-pod preemption.
+    // No reference analog (the reference's only job-wide stop is
+    // killing each replica). The flag rides the next quorum response
+    // per member (manager_server.cc request_drain), so for sync-quorum
+    // trainers every group learns it at the SAME sync — no group can
+    // drain a boundary ahead and strand the others' quorum.
+    // Union of the last formed quorum and any currently-registering
+    // members (same lookup the single-replica drain uses: registration
+    // empties into prev_quorum when a quorum forms, and a drain must
+    // reach members in either place). Live registrations overwrite
+    // stale prev_quorum addresses; tombstoned (already-left) members
+    // are excluded.
+    std::map<std::string, std::string> members;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (state_.prev_quorum) {
+        for (const auto& m : state_.prev_quorum->participants)
+          if (!state_.left.count(m.replica_id))
+            members[m.replica_id] = m.address;
+      }
+      for (const auto& kv : state_.participants)
+        members[kv.first] = kv.second.first.address;
+    }
+    Json sent = Json::object();
+    int n_sent = 0;
+    for (const auto& m : members) {
+      Json fwd = Json::object();
+      fwd["type"] = Json::of("request_drain");
+      Json ignored;
+      // Bound each forward by the request's remaining deadline (capped
+      // at 5 s): a job with several unreachable members (stale
+      // prev_quorum addresses after crashes — exactly when an operator
+      // reaches for drain ALL) must still return the per-member send
+      // report to the caller instead of timing out the whole RPC.
+      int64_t remaining = deadline_ms - now_ms();
+      if (remaining < 200) {
+        sent[m.first] = Json::of(false);
+        continue;
+      }
+      int64_t budget = remaining < 5000 ? remaining : 5000;
+      bool ok = call_json_addr(m.second, fwd, &ignored,
+                               static_cast<int>(budget));
+      sent[m.first] = Json::of(ok);
+      if (ok) n_sent++;
+    }
+    resp["ok"] = Json::of(true);
+    resp["sent"] = sent;
+    resp["n_sent"] = Json::of(static_cast<int64_t>(n_sent));
+    resp["n_members"] = Json::of(static_cast<int64_t>(members.size()));
+    return resp;
+  }
   resp["ok"] = Json::of(false);
   resp["error"] = Json::of("unknown request type '" + type + "'");
   return resp;
@@ -337,7 +394,10 @@ std::string Lighthouse::render_status_html() {
          << "/drain\" style=\"display:inline\"><button>drain</button></form>"
          << "</td></tr>";
   }
-  html << "</table><h2>previous quorum</h2><table><tr><th>replica</th>"
+  html << "</table><p><form method=post action=\"/drain_all\" "
+          "style=\"display:inline\"><button>drain ALL (stop job "
+          "cleanly)</button></form></p>";
+  html << "<h2>previous quorum</h2><table><tr><th>replica</th>"
        << "<th>address</th><th>step</th><th>world</th></tr>";
   if (s.get("prev_quorum").is_object()) {
     for (const auto& p : s.get("prev_quorum").get("participants").arr) {
@@ -412,11 +472,30 @@ std::string Lighthouse::render_metrics() {
 void Lighthouse::handle_http(int fd) {
   std::string req = read_http_request(fd, 10000);
   std::string path = "/";
+  std::string method;
   {
     size_t sp1 = req.find(' ');
     size_t sp2 = req.find(' ', sp1 + 1);
-    if (sp1 != std::string::npos && sp2 != std::string::npos)
+    if (sp1 != std::string::npos && sp2 != std::string::npos) {
+      method = req.substr(0, sp1);
       path = req.substr(sp1 + 1, sp2 - sp1 - 1);
+    }
+  }
+  // Side-effecting endpoints (kill / drain / drain_all) are POST-only:
+  // a GET must never stop a replica — browsers prefetch URLs and
+  // monitoring scrapers walk dashboard paths. The dashboard forms
+  // declare method=post already.
+  const bool side_effecting =
+      path == "/drain_all" || path.rfind("/replica/", 0) == 0;
+  if (side_effecting && method != "POST") {
+    std::string body405 = "method not allowed (POST required)";
+    std::ostringstream hdr;
+    hdr << "HTTP/1.1 405 Method Not Allowed\r\nContent-Type: text/plain"
+        << "\r\nAllow: POST\r\nContent-Length: " << body405.size()
+        << "\r\nConnection: close\r\n\r\n";
+    std::string out405 = hdr.str() + body405;
+    write_all(fd, out405.data(), out405.size(), 10000);
+    return;
   }
   std::string body;
   std::string ctype = "text/html";
@@ -442,6 +521,12 @@ void Lighthouse::handle_http(int fd) {
     body = kresp.dump();
     ctype = "application/json";
     if (!kresp.get("ok").as_bool()) code = 404;
+  } else if (path == "/drain_all") {
+    Json dreq = Json::object();
+    dreq["type"] = Json::of("drain_all");
+    Json dresp = handle_request(dreq, now_ms() + 15000);
+    body = dresp.dump();
+    ctype = "application/json";
   } else {
     code = 404;
     body = "not found";

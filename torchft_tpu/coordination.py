@@ -501,6 +501,26 @@ class LighthouseClient:
             timeout,
         )
 
+    def drain_all(self, timeout: float = 15.0) -> Dict[str, Any]:
+        """Operator-initiated FULL-job drain (the dashboard's
+        ``drain ALL`` button / ``POST /drain_all``): forwards
+        request_drain to every registered member's manager. Each trainer
+        drains at its own safe boundary — with ``--durable-dir`` that
+        includes a final durable snapshot, so the stopped job can later
+        be relaunched and resume (the operator-triggered twin of a
+        whole-pod preemption; see tools/drills.py preempt-all). Returns
+        ``{"sent": {replica_id: bool}, "n_sent": .., "n_members": ..}``.
+        No reference analog."""
+        resp = self._client.call(
+            {"type": "drain_all", "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+        return {
+            "sent": resp.get("sent", {}),
+            "n_sent": resp.get("n_sent", 0),
+            "n_members": resp.get("n_members", 0),
+        }
+
     def close(self) -> None:
         self._client.close()
 
