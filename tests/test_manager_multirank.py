@@ -365,3 +365,84 @@ def test_multirank_single_rank_death_group_restart(lighthouse) -> None:
     for group_states in results:
         for st in group_states:
             np.testing.assert_array_equal(st["w"], ref)
+
+
+def test_multirank_drain_and_straggler_fail_fast() -> None:
+    """group_world_size>1 drain contract (Manager.leave docstring): the
+    ranks of a group drain at the same step boundary; AND a straggler
+    rank that misses the boundary fails FAST on its next quorum — the
+    shared manager server refuses registrations once draining (refusal
+    enforced server-side, not just by the per-object _drained flag) —
+    instead of wedging the group."""
+    import time
+
+    # Own lighthouse: min_replicas=1 so group 0 keeps training after
+    # group 1 drains (the fixture's min_replicas=2 would wedge it).
+    lighthouse = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=10000,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=2000,
+    )
+    stores = [TCPStoreServer() for _ in range(N_GROUPS)]
+    n_steps = 6
+    drain_at = 3
+    rank0_left = threading.Event()
+    results: Dict[str, Dict] = {}
+
+    def run(group: int, rank: int):
+        manager = _make_manager(
+            lighthouse.address(), stores[group].address(), group, rank,
+            min_replica_size=1,
+            init_sync=False,
+        )
+        try:
+            while manager.current_step() < n_steps:
+                step = manager.current_step()
+                if group == 1 and step >= drain_at:
+                    if rank == 0:
+                        # Rank 0 drains at the boundary.
+                        assert manager.leave() is True
+                        rank0_left.set()
+                        results["g1r0"] = {"left_at": step}
+                        return
+                    # Rank 1 is a STRAGGLER: it missed the coordinated
+                    # boundary and tries another quorum after rank 0
+                    # drained the shared server.
+                    assert rank0_left.wait(timeout=30)
+                    t0 = time.monotonic()
+                    with pytest.raises(Exception, match="draining"):
+                        manager.start_quorum()
+                    results["g1r1"] = {
+                        "refusal_s": time.monotonic() - t0,
+                        "at_step": step,
+                    }
+                    return
+                manager.start_quorum()
+                grad = np.full(4, 1.0 + step, np.float32)
+                manager.allreduce(grad).wait(timeout=20)
+                manager.should_commit()
+            results[f"g{group}r{rank}"] = {
+                "final_step": manager.current_step()
+            }
+        finally:
+            manager.shutdown()
+
+    try:
+        _run_all(
+            [
+                lambda g=g, r=r: run(g, r)
+                for g in range(N_GROUPS)
+                for r in range(GROUP_WS)
+            ],
+            timeout=180,
+        )
+    finally:
+        lighthouse.shutdown()
+    # Group 0 survived the departure and ran to completion on both ranks.
+    assert results["g0r0"]["final_step"] == n_steps
+    assert results["g0r1"]["final_step"] == n_steps
+    assert results["g1r0"]["left_at"] == drain_at
+    # The straggler was refused in seconds (server-side draining flag),
+    # not after a quorum-timeout wedge (30 s here).
+    assert results["g1r1"]["refusal_s"] < 10, results["g1r1"]
