@@ -40,6 +40,10 @@ def test_replica_group_jobs_topology():
             "cloud.google.com/gke-tpu-topology"
         ] == "4x4"
         assert job["spec"]["backoffLimit"] == 100  # keep-alive restarts
+        # Pod deletion / node drain = SIGTERM -> graceful drain + final
+        # durable snapshot; 120 s (vs k8s's default 30) leaves room for
+        # the snapshot before SIGKILL.
+        assert pod["terminationGracePeriodSeconds"] == 120
 
 
 def test_lighthouse_deployment_and_service():

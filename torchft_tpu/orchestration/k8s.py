@@ -87,11 +87,18 @@ def render_replica_groups(
     max_restarts: int = 100,
     timeout_sec: Optional[float] = None,
     quorum_timeout_sec: Optional[float] = None,
+    termination_grace_period_sec: int = 120,
 ) -> List[dict]:
     """One Kubernetes Job per replica group (the reference's torchx role
     per group, torchx.py:41-76). The cluster restarts failed pods up to
     ``max_restarts`` (the runner.py keep-alive loop, scheduler-side);
     a restarted pod rejoins the quorum and live-heals.
+
+    ``termination_grace_period_sec``: pod deletion / node drain delivers
+    SIGTERM, the trainers' ``--drain-on-sigterm`` path finishes the
+    step, leaves the quorum, and (with ``--durable-dir``) writes a final
+    durable snapshot — the default 120 s (vs k8s's 30 s) leaves room for
+    that snapshot on large models before the SIGKILL follow-up.
 
     The FT env contract is OWNED by launcher.render_topology — this
     renderer just re-emits its ProcessSpecs as Jobs, so the two launch
@@ -120,6 +127,7 @@ def render_replica_groups(
         }
         pod_spec: dict = {
             "restartPolicy": "Never",  # the Job controller restarts
+            "terminationGracePeriodSeconds": termination_grace_period_sec,
             "containers": [container],
         }
         if tpu_chips > 0:
