@@ -51,6 +51,7 @@ def make_manager(pg=None, quorum_result=None, **kwargs):
         client.should_commit.side_effect = (
             lambda rank, step, ok, timeout=None: ok
         )
+        client.drain_status.return_value = False
         manager = Manager(
             pg=pg,
             checkpoint_transport=transport,
@@ -276,6 +277,28 @@ def test_abort_pending_quorum_interrupts_sync_wait():
         assert isinstance(m.errored(), RequestAborted)  # fails fast
         client.leave.return_value = True
         assert m.leave() is True
+    finally:
+        m.shutdown()
+
+
+def test_drain_requested_falls_back_to_status_rpc_on_error():
+    """The quorum-response piggyback only delivers on quorum SUCCESS; an
+    errored manager (peers drained first -> its quorums keep failing)
+    must learn the operator drain from the out-of-band drain_status
+    read, or a whole-job drain_all strands it retrying unwinnable
+    quorums."""
+    m = make_manager()
+    client = m._test_client
+    try:
+        assert m.drain_requested() is False
+        client.drain_status.assert_not_called()  # healthy: piggyback only
+        m.report_error(RuntimeError("quorum failed"))
+        client.drain_status.return_value = True
+        assert m.drain_requested() is True
+        client.drain_status.assert_called_once()
+        # Latched: no second RPC.
+        assert m.drain_requested() is True
+        client.drain_status.assert_called_once()
     finally:
         m.shutdown()
 

@@ -435,6 +435,7 @@ def test_operator_requested_drain() -> None:
     assert outcome[0]["final_step"] == total_steps
 
 
+@pytest.mark.timeout(240)
 def test_operator_drain_all() -> None:
     """Whole-job operator drain: ONE ``drain_all`` RPC (the dashboard's
     "drain ALL" button) reaches every member's manager; each trainer

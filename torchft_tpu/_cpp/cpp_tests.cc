@@ -555,6 +555,14 @@ static void test_operator_drain_request() {
   CHECK(a.get("ok").as_bool());
   CHECK(a.get("drain_requested").as_bool());
 
+  // Out-of-band read (the failed-step fallback path): flag visible
+  // without a successful quorum.
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("drain_status");
+  Json sresp = lighthouse_call(m.address(), sreq, 3000);
+  CHECK(sresp.get("ok").as_bool());
+  CHECK(sresp.get("drain_requested").as_bool());
+
   m.stop();
   lh.stop();
 }

@@ -155,6 +155,15 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     resp["ok"] = Json::of(true);
     return resp;
   }
+  if (type == "drain_status") {
+    // Out-of-band read of the flag: the piggyback on quorum responses
+    // only delivers on quorum SUCCESS, so a trainer whose peers drained
+    // a beat earlier (its quorums now fail) polls this after a failed
+    // step instead of retrying quorums it can never win.
+    resp["ok"] = Json::of(true);
+    resp["drain_requested"] = Json::of(drain_requested_.load());
+    return resp;
+  }
   if (type == "info") {
     resp["ok"] = Json::of(true);
     resp["replica_id"] = Json::of(opts_.replica_id);

@@ -266,7 +266,12 @@ class _FramedClient:
             attempts = (0, 1) if retry else (1,)
             for attempt in attempts:
                 if self._sock is None:
-                    self._sock = _net.connect(self._addr, self._connect_timeout)
+                    # Reconnect bounded by the PER-CALL deadline too: a
+                    # 2 s drain_status probe against a dead server must
+                    # fail in ~2 s, not the full connect_timeout.
+                    self._sock = _net.connect(
+                        self._addr, min(self._connect_timeout, timeout)
+                    )
                 try:
                     resp = _net.call_json(self._sock, req, timeout)
                     break
@@ -624,6 +629,18 @@ class ManagerClient:
         result = QuorumResult.from_json(resp["result"], quorum)
         result.drain_requested = bool(resp.get("drain_requested", False))
         return result
+
+    def drain_status(self, timeout: float = 2.0) -> bool:
+        """Out-of-band read of the operator-drain flag. The quorum
+        response piggyback only delivers on quorum SUCCESS — a trainer
+        whose peers drained a beat earlier (its quorums now fail) reads
+        the flag here after a failed step instead of retrying quorums it
+        can never win."""
+        resp = self._client.call(
+            {"type": "drain_status", "timeout_ms": int(timeout * 1000)},
+            timeout,
+        )
+        return bool(resp.get("drain_requested", False))
 
     def _checkpoint_metadata(self, rank: int, timeout: float = 10.0) -> str:
         resp = self._client.call(

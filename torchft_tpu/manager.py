@@ -872,7 +872,19 @@ class Manager:
         """True once an operator asked this replica group to drain (the
         lighthouse dashboard's drain button / ``drain`` RPC). The trainer
         should finish the current step, call :meth:`leave`, and exit 0 —
-        the same flow as a preemption SIGTERM."""
+        the same flow as a preemption SIGTERM.
+
+        Normally latched from the quorum-response piggyback (zero extra
+        RPCs). After a FAILED step the piggyback may never deliver — a
+        whole-job drain (``drain_all``) where a peer drained one beat
+        earlier means this group's quorums keep failing — so an errored
+        manager falls back to one cheap out-of-band ``drain_status``
+        read per check."""
+        if not self._drain_requested and self._errored is not None:
+            try:
+                self._drain_requested = self._client.drain_status()
+            except (RuntimeError, TimeoutError):
+                pass
         return self._drain_requested
 
     def abort_pending_quorum(self) -> bool:
