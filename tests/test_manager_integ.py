@@ -8,6 +8,7 @@ torchelastic-style restarts with `attempts`."""
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -1034,7 +1035,18 @@ def test_allreduce_reduce_op_sum(lighthouse) -> None:
             group_world_size=1,
         )
         try:
-            manager.start_quorum()
+            # The shared lighthouse has min_replicas=1 and a 1 s join
+            # window: if this replica's manager server boots before its
+            # peer's first heartbeat lands, a 1-member quorum forms and
+            # a single-shot start_quorum would fail the commit vote
+            # (participation 1 < min_replica_size 2). Re-quorum until
+            # the peer is in — exactly what a trainer's next step does.
+            deadline = time.monotonic() + 30
+            while True:
+                manager.start_quorum()
+                if manager.num_participants() >= ws:
+                    break
+                assert time.monotonic() < deadline, "peer never joined"
             from torchft_tpu.process_group import ReduceOp
 
             val = float(replica * 2 + 1)  # 1.0 and 3.0
