@@ -467,6 +467,84 @@ def test_goodput_accounting():
         m.shutdown()
 
 
+def test_goodput_frac_none_before_first_gate():
+    """The window before the first commit gate is unattributed: every
+    bucket stays zero and goodput_frac is None — not 0.0, which would
+    read as 'all time lost'."""
+    m = make_manager()
+    try:
+        g = m.goodput()
+        assert g["goodput_frac"] is None
+        assert g["committed_steps"] == 0 and g["failed_commits"] == 0
+        assert g["committed_s"] == 0.0 and g["failed_s"] == 0.0
+        assert g["heal_count"] == 0 and g["heal_s"] == 0.0
+        # Still None after a quorum forms but before any gate.
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.goodput()["goodput_frac"] is None
+    finally:
+        m.shutdown()
+
+
+def test_goodput_commit_fail_heal_bucketing():
+    """A commit -> fail -> heal sequence lands in the right buckets: a
+    clean gate adds to committed_s, a latched error turns its window into
+    failed_s, and the checkpoint recv lands in heal_s — excluded from the
+    surrounding window's outcome bucket (manager._heal_since_gate)."""
+    import time as _time
+
+    m = make_manager()
+    try:
+        # Gate 1 opens the accounting window; gate 2 commits ~40ms.
+        m.start_quorum()
+        assert m.should_commit() is True
+        _time.sleep(0.04)
+        m.start_quorum()
+        assert m.should_commit() is True
+        # Latched error -> the next window is failed time.
+        m.start_quorum()
+        m.report_error(RuntimeError("injected"))
+        _time.sleep(0.04)
+        assert m.should_commit() is False
+
+        # Heal quorum: recv_checkpoint sleeps so heal_s is measurable.
+        def slow_recv(**kwargs):
+            _time.sleep(0.05)
+            return {
+                "torchft": {"step": 9, "batches_committed": 18},
+                "user": {},
+            }
+
+        m._test_transport.recv_checkpoint.side_effect = slow_recv
+        m._test_client._quorum.return_value = make_quorum_result(
+            quorum_id=2,
+            heal=True,
+            max_step=9,
+            recover_src_manager_address="127.0.0.1:9",
+            recover_src_replica_rank=1,
+        )
+        with patch("torchft_tpu.manager.ManagerClient") as peer_cls:
+            peer_cls.return_value._checkpoint_metadata.return_value = (
+                "http://peer"
+            )
+            m.start_quorum()
+            m.wait_quorum()
+        assert m.should_commit() is True
+
+        g = m.goodput()
+        assert g["committed_steps"] == 3
+        assert g["failed_commits"] == 1
+        assert g["heal_count"] == 1
+        assert g["heal_s"] >= 0.05
+        assert g["committed_s"] > 0 and g["failed_s"] > 0
+        # frac is consistent with the buckets, heal time in the denominator.
+        denom = g["committed_s"] + g["failed_s"] + g["heal_s"]
+        assert g["goodput_frac"] == round(g["committed_s"] / denom, 4)
+        assert 0.0 < g["goodput_frac"] < 1.0
+    finally:
+        m.shutdown()
+
+
 def test_wrap_future_completes_even_if_report_error_raises():
     """If report_error (or the logger) raises on the callback thread, the
     wrapped future must still resolve to the default — otherwise the

@@ -1,0 +1,313 @@
+"""Observability-plane tests: the cross-replica timeline merge in
+tools/obs_report.py (phase math, heal alignment, slowest-replica
+attribution, stall detection, goodput rollup) and the Prometheus
+rendering in tools/obs_export.py — all on synthetic journals, no
+processes spawned."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+import obs_export  # noqa: E402
+import obs_report  # noqa: E402
+
+
+def _ev(ts, event, step=None, replica_id="0", **attrs):
+    return {
+        "ts": ts,
+        "replica_id": replica_id,
+        "step": step,
+        "event": event,
+        "attrs": attrs,
+    }
+
+
+def _write_journal(path, events):
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# obs_report: loading and identity
+# ---------------------------------------------------------------------------
+
+
+def test_load_events_merges_dir_sorts_and_skips_garbage(tmp_path):
+    """A directory of journals merges time-sorted; truncated/garbage lines
+    (the tail a SIGKILL leaves behind) are skipped, not fatal."""
+    _write_journal(
+        tmp_path / "a.jsonl", [_ev(2.0, "quorum_start", step=0)]
+    )
+    with open(tmp_path / "b.jsonl", "w") as fh:
+        fh.write(json.dumps(_ev(1.0, "quorum_start", step=0, replica_id="1")))
+        fh.write("\n{not json\n")
+        fh.write('{"no_event_key": 1}\n')
+        fh.write(json.dumps(_ev(3.0, "commit_gate", step=0, replica_id="1")))
+        # No trailing newline: the torn-final-line case.
+    events = obs_report.load_events([str(tmp_path)])
+    assert [e["ts"] for e in events] == [1.0, 2.0, 3.0]
+
+
+def test_replica_key_folds_manager_uuid_onto_group():
+    """Manager ids are <group>:<run-uuid>; env-derived ids are the bare
+    group. Both — and a relaunched incarnation's fresh uuid — must land on
+    one timeline row."""
+    assert obs_report._replica_key(_ev(0, "x", replica_id="3:abc-123")) == "3"
+    assert obs_report._replica_key(_ev(0, "x", replica_id="3")) == "3"
+    assert obs_report._replica_key(_ev(0, "x", replica_id="3:other")) == "3"
+
+
+def test_heal_events_align_to_max_step():
+    """Heal events carry the healing replica's STALE step counter; the
+    timeline must file them under attrs.max_step — the step being healed
+    to — so the heal shows up next to the peers' matching step."""
+    events = [
+        _ev(1.0, "heal_start", step=0, max_step=7),
+        _ev(2.0, "heal_done", step=0, max_step=7, elapsed_s=1.0),
+        _ev(3.0, "quorum_start", step=2),
+    ]
+    steps = [obs_report._event_step(e) for e in events]
+    assert steps == [7, 7, 2]
+
+
+# ---------------------------------------------------------------------------
+# obs_report: phase math
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_phase_breakdown_and_compute_residual():
+    """total = gate - quorum_start; compute is the residual after quorum,
+    heal, allreduce and commit are subtracted (clamped at zero)."""
+    events = [
+        _ev(10.0, "quorum_start", step=4),
+        _ev(10.2, "quorum_ready", step=4, elapsed_s=0.2),
+        # 0.5s of compute lives between quorum_ready and allreduce.
+        _ev(10.8, "allreduce_issue", step=4, nbytes=1024),
+        _ev(11.0, "allreduce_complete", step=4, ok=True, elapsed_s=0.3),
+        _ev(11.1, "commit_gate", step=4, committed=True),
+    ]
+    row = obs_report.build_timeline(events)[4]["0"]
+    assert row["quorum_s"] == pytest.approx(0.2)
+    assert row["allreduce_s"] == pytest.approx(0.3)
+    assert row["commit_s"] == pytest.approx(0.1)  # gate - last allreduce
+    assert row["total_s"] == pytest.approx(1.1)   # gate - quorum_start
+    assert row["compute_s"] == pytest.approx(1.1 - 0.2 - 0.3 - 0.1)
+    assert row["committed"] is True
+    assert row["heal_s"] == 0.0
+
+
+def test_timeline_heal_phase_from_heal_done():
+    events = [
+        _ev(10.0, "quorum_start", step=0, replica_id="1:u2"),
+        _ev(10.1, "quorum_ready", step=0, replica_id="1:u2", elapsed_s=0.1),
+        _ev(10.2, "heal_start", step=0, replica_id="1:u2", max_step=0),
+        _ev(12.2, "heal_done", step=0, replica_id="1:u2", max_step=0,
+            elapsed_s=2.0),
+        _ev(12.5, "commit_gate", step=0, replica_id="1:u2", committed=True),
+    ]
+    row = obs_report.build_timeline(events)[0]["1"]
+    assert row["heal_s"] == pytest.approx(2.0)
+    # No allreduce on the heal step -> commit_s stays 0, residual absorbs.
+    assert row["commit_s"] == 0.0
+    assert row["compute_s"] == pytest.approx(2.5 - 0.1 - 2.0)
+
+
+def test_timeline_without_gate_totals_observed_phases():
+    """A journal truncated before the gate (killed replica) still renders:
+    total falls back to the sum of observed phase durations."""
+    events = [
+        _ev(1.0, "quorum_start", step=9),
+        _ev(1.4, "quorum_ready", step=9, elapsed_s=0.4),
+    ]
+    row = obs_report.build_timeline(events)[9]["0"]
+    assert row["committed"] is None
+    assert row["total_s"] == pytest.approx(0.4)
+
+
+def test_slowest_replica_attribution():
+    """The marker goes to the replica with the largest step wall-time and
+    names its dominant phase."""
+    events = [
+        _ev(1.0, "quorum_start", step=0, replica_id="0"),
+        _ev(1.1, "quorum_ready", step=0, replica_id="0", elapsed_s=0.1),
+        _ev(1.2, "commit_gate", step=0, replica_id="0", committed=True),
+        _ev(1.0, "quorum_start", step=0, replica_id="1"),
+        _ev(3.0, "quorum_ready", step=0, replica_id="1", elapsed_s=2.0),
+        _ev(3.1, "commit_gate", step=0, replica_id="1", committed=True),
+    ]
+    rows = obs_report.build_timeline(events)[0]
+    rid, phase = obs_report.slowest_replica(rows)
+    assert (rid, phase) == ("1", "quorum")
+
+
+def test_detect_stalls_flags_outlier_quorum_wait():
+    # 40 steps so the 95th-percentile rank lands below the single
+    # outlier (with too few samples the outlier IS its own threshold).
+    events = []
+    for step in range(40):
+        t = float(step * 10)
+        wait = 5.0 if step == 7 else 0.01
+        events += [
+            _ev(t, "quorum_start", step=step),
+            _ev(t + wait, "quorum_ready", step=step, elapsed_s=wait),
+            _ev(t + wait + 0.1, "commit_gate", step=step, committed=True),
+        ]
+    timeline = obs_report.build_timeline(events)
+    stalls = obs_report.detect_stalls(timeline, 95.0, 0.5)
+    assert [s["step"] for s in stalls] == [7]
+    assert stalls[0]["replica"] == "0"
+    # Raise the floor above the outlier -> nothing flagged.
+    assert obs_report.detect_stalls(timeline, 95.0, 10.0) == []
+
+
+def test_goodput_rollup_last_event_per_replica_wins():
+    """A healed relaunch re-emits goodput at its own shutdown; the rollup
+    must take the LAST event per replica key, then recompute the combined
+    fraction."""
+    events = [
+        _ev(1.0, "goodput", replica_id="0:u1", committed_steps=2,
+            failed_commits=1, committed_s=2.0, failed_s=1.0,
+            heal_count=0, heal_s=0.0),
+        # Same group, relaunched uuid: supersedes the first event.
+        _ev(9.0, "goodput", replica_id="0:u2", committed_steps=5,
+            failed_commits=1, committed_s=6.0, failed_s=1.0,
+            heal_count=1, heal_s=1.0),
+        _ev(9.5, "goodput", replica_id="1:u9", committed_steps=5,
+            failed_commits=0, committed_s=2.0, failed_s=0.0,
+            heal_count=0, heal_s=0.0),
+    ]
+    roll = obs_report.goodput_rollup(events)
+    assert roll["replicas"] == ["0", "1"]
+    assert roll["committed_steps"] == 10
+    assert roll["heal_count"] == 1
+    assert roll["goodput_frac"] == pytest.approx(8.0 / 10.0)
+
+
+def test_render_text_marks_slowest_and_rolls_up():
+    events = [
+        _ev(1.0, "quorum_start", step=0, replica_id="0"),
+        _ev(1.1, "quorum_ready", step=0, replica_id="0", elapsed_s=0.1),
+        _ev(1.2, "commit_gate", step=0, replica_id="0", committed=True),
+        _ev(1.0, "quorum_start", step=0, replica_id="1"),
+        _ev(2.0, "quorum_ready", step=0, replica_id="1", elapsed_s=1.0),
+        _ev(2.1, "commit_gate", step=0, replica_id="1", committed=False),
+        _ev(3.0, "goodput", replica_id="0", committed_steps=1,
+            failed_commits=0, committed_s=1.0, failed_s=0.0,
+            heal_count=0, heal_s=0.0),
+    ]
+    timeline = obs_report.build_timeline(events)
+    text = obs_report.render_text(
+        timeline, [], obs_report.goodput_rollup(events)
+    )
+    lines = text.splitlines()
+    slow_lines = [ln for ln in lines if "<- slowest (quorum)" in ln]
+    assert len(slow_lines) == 1 and " 1 " in slow_lines[0]
+    assert any("FAIL" in ln for ln in lines)
+    assert any("goodput rollup:" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# obs_export: Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def _sample(**kwargs):
+    base = {
+        "quorum_id": 3,
+        "quorum_generation": 5,
+        "joins_total": 4,
+        "leaves_total": 2,
+        "participants_waiting": 1,
+        "quorum_members": 2,
+        "heartbeat_ages_ms": {"0": 120, "1": 40},
+        "heartbeat_age_max_ms": 120,
+        "member_steps": {"0": 10, "1": 10},
+        "step_spread": 0,
+        "left": [],
+        "reason": "",
+    }
+    base.update(kwargs)
+    return base
+
+
+def test_render_prometheus_gauges_and_labels():
+    text = obs_export.render_prometheus(_sample())
+    assert "torchft_exporter_quorum_generation 5" in text
+    assert "torchft_exporter_joins_total 4" in text
+    assert "torchft_exporter_leaves_total 2" in text
+    assert "torchft_exporter_heartbeat_age_max_ms 120" in text
+    assert 'torchft_exporter_heartbeat_age_ms{replica="0"} 120' in text
+    assert 'torchft_exporter_member_step{replica="1"} 10' in text
+    # Every metric line carries HELP and TYPE headers.
+    for name in ("torchft_exporter_quorum_id",
+                 "torchft_exporter_member_step_spread"):
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} gauge" in text
+    assert text.endswith("\n")
+
+
+def test_render_prometheus_escapes_label_values():
+    text = obs_export.render_prometheus(
+        _sample(heartbeat_ages_ms={'we"ird\\id': 7}, member_steps={})
+    )
+    assert (
+        'torchft_exporter_heartbeat_age_ms{replica="we\\"ird\\\\id"} 7'
+        in text
+    )
+
+
+def test_exporter_up_gauge_tracks_scrape_health():
+    ex = obs_export._Exporter()
+    assert "torchft_exporter_up 0" in ex.render()  # no scrape yet
+    ex.update(_sample())
+    assert "torchft_exporter_up 1" in ex.render()
+    ex.fail("connection refused")
+    out = ex.render()
+    # Stale sample still served, but up goes to 0.
+    assert "torchft_exporter_up 0" in out
+    assert "torchft_exporter_quorum_id 3" in out
+
+
+# ---------------------------------------------------------------------------
+# Manager journal integration: a mocked-RPC manager writes a journal that
+# obs_report folds into a committed timeline row.
+# ---------------------------------------------------------------------------
+
+
+def test_manager_journal_feeds_obs_report(tmp_path, monkeypatch):
+    from torchft_tpu import telemetry
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    from tests.test_manager import make_manager
+
+    path = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    telemetry.reset_event_log()
+    try:
+        import numpy as np
+
+        m = make_manager()
+        try:
+            m.start_quorum()
+            m.allreduce(np.ones(4, np.float32)).wait()
+            assert m.should_commit()
+        finally:
+            m.shutdown()
+    finally:
+        telemetry.reset_event_log()
+
+    events = obs_report.load_events([path])
+    names = {e["event"] for e in events}
+    assert {"quorum_start", "quorum_ready", "allreduce_issue",
+            "allreduce_complete", "commit_gate", "goodput"} <= names
+    timeline = obs_report.build_timeline(events)
+    row = timeline[0][obs_report._replica_key(events[0])]
+    assert row["committed"] is True
+    assert row["total_s"] >= 0.0

@@ -197,3 +197,192 @@ def test_trace_window_noop_without_env(monkeypatch):
     for step in range(10):
         telemetry.trace_window(step)  # must not raise or start traces
     assert telemetry._TRACE_STATE["active"] is False
+
+
+def test_reset_trace_window_rearms_the_one_shot():
+    """The window is one-shot per process; reset_trace_window clears the
+    done latch so a test or multi-run process can schedule a fresh one."""
+    telemetry._TRACE_STATE["done"] = True
+    telemetry._TRACE_STATE["stop_at"] = 99
+    telemetry.reset_trace_window()
+    assert telemetry._TRACE_STATE == {
+        "active": False, "done": False, "stop_at": -1
+    }
+
+
+# ---------------------------------------------------------------------------
+# Latency histograms (p50/p95/p99 over log-spaced buckets)
+# ---------------------------------------------------------------------------
+
+
+def test_span_percentiles_from_histogram():
+    telemetry.reset_span_stats()
+    for _ in range(90):
+        telemetry._SPAN_STATS.add("test::hist", 0.001)
+    for _ in range(10):
+        telemetry._SPAN_STATS.add("test::hist", 0.1)
+    pcts = telemetry.span_percentiles("test::hist")["test::hist"]
+    # Bucket upper bounds: p50 lands in the ~1ms bucket, p95/p99 in the
+    # ~100ms bucket (log-spaced 2x buckets, so within a factor of 2).
+    assert 0.001 <= pcts["p50"] <= 0.002
+    assert 0.1 <= pcts["p95"] <= 0.2
+    assert 0.1 <= pcts["p99"] <= 0.2
+    # count/total/max stats keep their original shape alongside.
+    s = telemetry.span_stats()["test::hist"]
+    assert s["count"] == 100 and s["max_s"] == 0.1
+
+
+def test_span_percentiles_all_spans_and_reset():
+    telemetry.reset_span_stats()
+    with telemetry.trace_span("test::a"):
+        pass
+    with telemetry.timeit("test::b"):
+        pass
+    pcts = telemetry.span_percentiles()
+    assert set(pcts) >= {"test::a", "test::b"}
+    for v in pcts.values():
+        assert set(v) == {"p50", "p95", "p99"}
+    telemetry.reset_span_stats()
+    assert telemetry.span_percentiles() == {}
+    assert telemetry.span_percentiles("test::gone") == {}
+
+
+# ---------------------------------------------------------------------------
+# Event journal
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_writes_structured_jsonl(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    log = telemetry.EventLog(path, replica_id="r0")
+    log.emit("quorum_start", step=3, allow_heal=True)
+    log.emit("commit_gate", step=3, replica_id="r0:uuid", committed=True)
+    log.emit("server_start", server="lighthouse")  # step-less event
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["event"] for l in lines] == [
+        "quorum_start", "commit_gate", "server_start"
+    ]
+    assert lines[0] == {
+        "ts": lines[0]["ts"], "replica_id": "r0", "step": 3,
+        "event": "quorum_start", "attrs": {"allow_heal": True},
+    }
+    assert lines[1]["replica_id"] == "r0:uuid"  # per-emit override
+    assert lines[2]["step"] is None
+    # Closed log drops silently rather than raising mid-step.
+    log.emit("after_close", step=4)
+    assert len(open(path).readlines()) == 3
+
+
+def test_get_event_log_env_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("TORCHFT_JOURNAL_FILE", raising=False)
+    monkeypatch.delenv("TORCHFT_JOURNAL_DIR", raising=False)
+    telemetry.reset_event_log()
+    assert telemetry.get_event_log() is None
+
+    path = str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    log = telemetry.get_event_log()
+    assert log is not None
+    assert telemetry.get_event_log() is log  # cached
+    log.emit("ev", step=1)
+    assert json.loads(open(path).read())["event"] == "ev"
+
+    # Dir mode derives a per-process filename from the replica env.
+    monkeypatch.delenv("TORCHFT_JOURNAL_FILE", raising=False)
+    monkeypatch.setenv("TORCHFT_JOURNAL_DIR", str(tmp_path / "d"))
+    monkeypatch.setenv("REPLICA_GROUP_ID", "2")
+    monkeypatch.setenv("RANK", "0")
+    log2 = telemetry.get_event_log()
+    assert log2 is not log
+    log2.emit("ev2", step=1)
+    assert f"journal_replica2_rank0_{os.getpid()}.jsonl" in log2._path
+    telemetry.reset_event_log()
+
+
+def test_event_log_default_replica_from_env(tmp_path, monkeypatch):
+    telemetry.reset_event_log()  # clear any pinned default from other tests
+    monkeypatch.delenv("TORCHFT_REPLICA_ID", raising=False)
+    monkeypatch.setenv("REPLICA_GROUP_ID", "5")
+    log = telemetry.EventLog(str(tmp_path / "j.jsonl"))
+    assert log.replica_id == "5"
+    log.close()
+    monkeypatch.setenv("TORCHFT_REPLICA_ID", "custom")
+    log = telemetry.EventLog(str(tmp_path / "j2.jsonl"))
+    assert log.replica_id == "custom"  # explicit override wins
+    log.close()
+
+
+def test_set_default_replica_id_pins_journal_identity(tmp_path, monkeypatch):
+    """The Manager pins its replica id on the journal so pg/transport
+    events (which don't pass one) share its timeline row; the pin beats
+    REPLICA_GROUP_ID, loses to TORCHFT_REPLICA_ID, updates the live
+    cached log, and clears on reset_event_log()."""
+    monkeypatch.delenv("TORCHFT_REPLICA_ID", raising=False)
+    monkeypatch.setenv("REPLICA_GROUP_ID", "0")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", str(tmp_path / "j.jsonl"))
+    telemetry.reset_event_log()
+    try:
+        log = telemetry.get_event_log()
+        assert log.replica_id == "0"
+        telemetry.set_default_replica_id("train_ddp_0:uuid")
+        assert log.replica_id == "train_ddp_0:uuid"  # live log updated
+        # A freshly created log also picks up the pin.
+        log2 = telemetry.EventLog(str(tmp_path / "j2.jsonl"))
+        assert log2.replica_id == "train_ddp_0:uuid"
+        log2.close()
+        # Env override still wins over the pin.
+        monkeypatch.setenv("TORCHFT_REPLICA_ID", "custom")
+        log3 = telemetry.EventLog(str(tmp_path / "j3.jsonl"))
+        assert log3.replica_id == "custom"
+        log3.close()
+    finally:
+        telemetry.reset_event_log()
+    monkeypatch.delenv("TORCHFT_REPLICA_ID", raising=False)
+    log4 = telemetry.EventLog(str(tmp_path / "j4.jsonl"))
+    assert log4.replica_id == "0"  # pin cleared by reset
+    log4.close()
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger persistent handle
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_logger_holds_one_handle(tmp_path):
+    """Regression: log() used to reopen the file on every call; it must
+    hold one append handle, flush per line, and close() must close it."""
+    path = str(tmp_path / "m.jsonl")
+    m = telemetry.MetricsLogger(path)
+    fh = m._fh
+    assert fh is not None
+    m.log(0, loss=1.0)
+    m.log(1, loss=0.5)
+    assert m._fh is fh  # same handle across calls
+    # Flushed per line: visible to a concurrent reader before close.
+    assert len(open(path).readlines()) == 2
+    m.close()
+    assert m._fh is None and fh.closed
+    m.log(2, loss=0.1)  # closed: dropped, not raised
+    assert len(open(path).readlines()) == 2
+    m.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder O(1) completion index
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_index_tracks_eviction():
+    fr = telemetry.FlightRecorder(capacity=4)
+    seqs = [fr.record("allreduce") for _ in range(10)]
+    # Index never outgrows the ring.
+    assert len(fr._by_seq) == 4
+    assert set(fr._by_seq) == {r["seq"] for r in fr.snapshot()}
+    # Completing an evicted seq is a no-op, not a scan or a KeyError.
+    fr.complete(seqs[0])
+    assert all(r["status"] == "issued" for r in fr.snapshot())
+    # Completing a live one lands on the right record.
+    fr.complete(seqs[-1], error="boom")
+    assert fr.snapshot()[-1]["status"] == "error"
+    assert fr.snapshot()[-2]["status"] == "issued"

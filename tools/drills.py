@@ -60,7 +60,10 @@ def _lighthouse(min_replicas: int = 2) -> LighthouseServer:
     )
 
 
-def _specs(cmd, n_groups, lighthouse, extra_env=None, result_dir=None):
+def _specs(
+    cmd, n_groups, lighthouse, extra_env=None, result_dir=None,
+    journal_dir=None,
+):
     env = {
         "JAX_PLATFORMS": "cpu",
         "PYTHONUNBUFFERED": "1",  # step-mark detection reads live logs
@@ -70,11 +73,19 @@ def _specs(cmd, n_groups, lighthouse, extra_env=None, result_dir=None):
     full = list(cmd)
     if result_dir:
         full += ["--result-dir", result_dir]
+        # Every drill run journals by default: a drill IS a fault-injection
+        # experiment, and the per-replica event journals are what
+        # tools/obs_report.py turns into the step/heal timeline afterwards.
+        if journal_dir is None:
+            journal_dir = os.path.join(os.path.dirname(result_dir), "journal")
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
     return render_topology(
         full,
         num_replica_groups=n_groups,
         lighthouse_addr=lighthouse.address(),
         env=env,
+        journal_dir=journal_dir,
     )
 
 
@@ -185,6 +196,9 @@ def drill_soak(args) -> dict:
         "bitwise_equal": _sha(res[0]) is not None
         and _sha(res[0]) == _sha(res[1]),
         "wall_s": round(time.time() - t0, 1),
+        # Feed to `python tools/obs_report.py <journal_dir>` for the
+        # step-aligned heal timeline of this run.
+        "journal_dir": workdir + "/journal",
     }
 
 

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Control-plane exporter: polls the lighthouse ``status`` RPC and turns it
+into (a) journal events in the same JSONL stream the trainers write and
+(b) a Prometheus-style text exposition served over a stdlib HTTP endpoint.
+
+The C++ lighthouse already serves its own ``/metrics``; this exporter adds
+the pieces monitoring actually wants but a single C++ process can't give:
+the status sampled into the *event journal* (so ``tools/obs_report.py``
+timelines include control-plane state between steps) and derived gauges
+(max heartbeat age, member-step spread) computed Python-side.
+
+Usage::
+
+    python tools/obs_export.py --lighthouse 127.0.0.1:29510 \
+        --journal-file /tmp/journal/exporter.jsonl --port 9109
+
+    python tools/obs_export.py --lighthouse 127.0.0.1:29510 --once
+
+Env: ``TORCHFT_LIGHTHOUSE`` is the default for ``--lighthouse``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu.coordination import LighthouseClient  # noqa: E402
+from torchft_tpu.telemetry import EventLog  # noqa: E402
+
+
+def scrape(client: LighthouseClient, timeout: float = 5.0) -> Dict[str, Any]:
+    """One status scrape, flattened into the fields the exporter serves."""
+    s = client.status(timeout=timeout)
+    hb = s.get("heartbeat_ages_ms", {}) or {}
+    prev = s.get("prev_quorum") or {}
+    members = prev.get("participants", []) or []
+    steps = [int(m.get("step", 0)) for m in members]
+    return {
+        "quorum_id": int(s.get("quorum_id", 0)),
+        "quorum_generation": int(s.get("quorum_generation", 0)),
+        "joins_total": int(s.get("joins_total", 0)),
+        "leaves_total": int(s.get("leaves_total", 0)),
+        "participants_waiting": len(s.get("participants", []) or []),
+        "quorum_members": len(members),
+        "heartbeat_ages_ms": {k: int(v) for k, v in hb.items()},
+        "heartbeat_age_max_ms": max(hb.values()) if hb else 0,
+        "member_steps": {
+            str(m.get("replica_id", "")): int(m.get("step", 0))
+            for m in members
+        },
+        "step_spread": (max(steps) - min(steps)) if steps else 0,
+        "left": list(s.get("left", []) or []),
+        "reason": s.get("reason", ""),
+    }
+
+
+def render_prometheus(sample: Dict[str, Any]) -> str:
+    """Prometheus text exposition for one scrape sample."""
+    lines = []
+
+    def gauge(name: str, value: Any, help_: str, labels: str = "") -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value}")
+
+    gauge("torchft_exporter_quorum_id", sample["quorum_id"],
+          "Current quorum id.")
+    gauge("torchft_exporter_quorum_generation", sample["quorum_generation"],
+          "Quorum broadcasts since lighthouse boot.")
+    gauge("torchft_exporter_joins_total", sample["joins_total"],
+          "Members added across quorum transitions.")
+    gauge("torchft_exporter_leaves_total", sample["leaves_total"],
+          "Members gone across quorum transitions.")
+    gauge("torchft_exporter_participants_waiting",
+          sample["participants_waiting"],
+          "Replicas waiting in the next quorum round.")
+    gauge("torchft_exporter_quorum_members", sample["quorum_members"],
+          "Members of the last delivered quorum.")
+    gauge("torchft_exporter_heartbeat_age_max_ms",
+          sample["heartbeat_age_max_ms"],
+          "Max milliseconds since any replica's last heartbeat.")
+    gauge("torchft_exporter_member_step_spread", sample["step_spread"],
+          "Max minus min training step across quorum members.")
+    lines.append("# HELP torchft_exporter_heartbeat_age_ms Milliseconds "
+                 "since each replica's last heartbeat.")
+    lines.append("# TYPE torchft_exporter_heartbeat_age_ms gauge")
+    for rid, age in sorted(sample["heartbeat_ages_ms"].items()):
+        esc = rid.replace("\\", "\\\\").replace('"', '\\"')
+        lines.append(
+            f'torchft_exporter_heartbeat_age_ms{{replica="{esc}"}} {age}'
+        )
+    lines.append("# HELP torchft_exporter_member_step Training step each "
+                 "quorum member reported.")
+    lines.append("# TYPE torchft_exporter_member_step gauge")
+    for rid, step in sorted(sample["member_steps"].items()):
+        esc = rid.replace("\\", "\\\\").replace('"', '\\"')
+        lines.append(f'torchft_exporter_member_step{{replica="{esc}"}} {step}')
+    return "\n".join(lines) + "\n"
+
+
+class _Exporter:
+    """Holds the latest sample; the HTTP handler and poll loop share it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sample: Optional[Dict[str, Any]] = None
+        self._error: str = "no scrape yet"
+
+    def update(self, sample: Dict[str, Any]) -> None:
+        with self._lock:
+            self._sample = sample
+            self._error = ""
+
+    def fail(self, error: str) -> None:
+        with self._lock:
+            self._error = error
+
+    def render(self) -> str:
+        with self._lock:
+            sample, error = self._sample, self._error
+        body = render_prometheus(sample) if sample is not None else ""
+        up = 1 if (sample is not None and not error) else 0
+        body += ("# HELP torchft_exporter_up Last scrape succeeded.\n"
+                 "# TYPE torchft_exporter_up gauge\n"
+                 f"torchft_exporter_up {up}\n")
+        return body
+
+
+def _make_handler(exporter: _Exporter):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = exporter.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args: Any) -> None:
+            pass  # scrape chatter does not belong on stderr
+
+    return Handler
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--lighthouse",
+                   default=os.environ.get("TORCHFT_LIGHTHOUSE", ""),
+                   help="lighthouse host:port (default: $TORCHFT_LIGHTHOUSE)")
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="poll interval seconds (default 5)")
+    p.add_argument("--journal-file", default="",
+                   help="append lighthouse_status events to this JSONL file")
+    p.add_argument("--port", type=int, default=0,
+                   help="serve Prometheus text on this port (0 = off)")
+    p.add_argument("--once", action="store_true",
+                   help="scrape once, print the exposition to stdout, exit")
+    p.add_argument("--max-scrapes", type=int, default=0,
+                   help="exit after N successful scrapes (0 = run forever)")
+    args = p.parse_args(argv)
+    if not args.lighthouse:
+        p.error("--lighthouse or $TORCHFT_LIGHTHOUSE is required")
+
+    client = LighthouseClient(args.lighthouse, connect_timeout=10.0)
+    journal = (
+        EventLog(args.journal_file, replica_id="exporter")
+        if args.journal_file
+        else None
+    )
+
+    if args.once:
+        sample = scrape(client)
+        if journal is not None:
+            journal.emit("lighthouse_status", **sample)
+        sys.stdout.write(render_prometheus(sample))
+        return 0
+
+    exporter = _Exporter()
+    server = None
+    if args.port:
+        server = ThreadingHTTPServer(
+            ("0.0.0.0", args.port), _make_handler(exporter)
+        )
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        print(f"serving /metrics on :{server.server_address[1]}", flush=True)
+
+    scrapes = 0
+    try:
+        while True:
+            try:
+                sample = scrape(client)
+                exporter.update(sample)
+                if journal is not None:
+                    journal.emit("lighthouse_status", **sample)
+                scrapes += 1
+                if args.max_scrapes and scrapes >= args.max_scrapes:
+                    return 0
+            except Exception as e:  # noqa: BLE001 - keep polling through faults
+                exporter.fail(str(e))
+                print(f"scrape failed: {e}", file=sys.stderr, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()
+        if journal is not None:
+            journal.close()
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Cross-replica timeline: merges per-replica event journals (see
+``telemetry.EventLog``; written when ``TORCHFT_JOURNAL_DIR``/``_FILE`` is
+set) into a step-aligned report.
+
+For every (step, replica) the journal's event sequence is folded into a
+phase breakdown::
+
+    quorum wait | heal | compute | allreduce | commit
+
+plus slowest-replica attribution per step, a goodput rollup (from the
+``goodput`` event each Manager emits at shutdown — the same dict
+``Manager.goodput()`` returns), and a stall detector flagging steps whose
+quorum wait exceeds a percentile threshold across the run.
+
+Usage::
+
+    python tools/obs_report.py /tmp/journal/            # a dir of *.jsonl
+    python tools/obs_report.py a.jsonl b.jsonl --json
+    python tools/obs_report.py /tmp/journal --stall-pct 95 --stall-min-s 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+PHASES = ("quorum_s", "heal_s", "compute_s", "allreduce_s", "commit_s")
+
+
+def load_events(paths: List[str]) -> List[Dict[str, Any]]:
+    """Reads journal JSONL files (files or directories of ``*.jsonl``),
+    returns all events sorted by timestamp. Malformed lines are skipped —
+    a journal truncated by a kill is exactly the interesting case."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    events: List[Dict[str, Any]] = []
+    for f in files:
+        try:
+            fh = open(f)
+        except OSError as e:
+            print(f"warning: cannot open {f}: {e}", file=sys.stderr)
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict) and "event" in ev:
+                    events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+def _replica_key(ev: Dict[str, Any]) -> str:
+    """Stable replica identity for timeline rows. Manager replica ids are
+    ``<group>:<run-uuid>`` (the uuid changes on every relaunch) while
+    env-derived journal ids are the bare group — fold both onto the
+    group so one replica's pg/transport/manager events share a row and a
+    relaunched incarnation continues its predecessor's timeline."""
+    return str(ev.get("replica_id", "?")).split(":", 1)[0]
+
+
+def _event_step(ev: Dict[str, Any]) -> Optional[int]:
+    """Step a journal event belongs to on the aligned timeline. Heal events
+    align to the step being healed TO (attrs.max_step): the healing
+    replica's own counter is stale mid-heal by definition."""
+    attrs = ev.get("attrs") or {}
+    if ev["event"].startswith("heal") and "max_step" in attrs:
+        return int(attrs["max_step"])
+    step = ev.get("step")
+    return None if step is None else int(step)
+
+
+def build_timeline(
+    events: List[Dict[str, Any]],
+) -> Dict[int, Dict[str, Dict[str, Any]]]:
+    """Folds events into {step: {replica: row}} where each row carries the
+    phase breakdown, commit verdict, and raw timestamps."""
+    # Group (step, replica) -> ordered events.
+    grouped: Dict[Tuple[int, str], List[Dict[str, Any]]] = {}
+    for ev in events:
+        step = _event_step(ev)
+        if step is None:
+            continue
+        rid = _replica_key(ev)
+        grouped.setdefault((step, rid), []).append(ev)
+
+    timeline: Dict[int, Dict[str, Dict[str, Any]]] = {}
+    for (step, rid), evs in grouped.items():
+        row: Dict[str, Any] = {p: 0.0 for p in PHASES}
+        row["committed"] = None
+        row["events"] = len(evs)
+        t_start = t_gate = None
+        t_last_allreduce = None
+        for ev in evs:
+            name = ev["event"]
+            attrs = ev.get("attrs") or {}
+            ts = float(ev.get("ts", 0.0))
+            if name == "quorum_start" and t_start is None:
+                t_start = ts
+            elif name == "quorum_ready":
+                row["quorum_s"] += float(attrs.get("elapsed_s") or 0.0)
+            elif name == "heal_done":
+                row["heal_s"] += float(attrs.get("elapsed_s") or 0.0)
+            elif name == "allreduce_complete":
+                row["allreduce_s"] += float(attrs.get("elapsed_s") or 0.0)
+                t_last_allreduce = ts
+            elif name == "commit_gate":
+                t_gate = ts
+                row["committed"] = attrs.get("committed")
+        if t_gate is not None and t_last_allreduce is not None:
+            row["commit_s"] = max(t_gate - t_last_allreduce, 0.0)
+        if t_gate is not None and t_start is not None:
+            total = max(t_gate - t_start, 0.0)
+            row["total_s"] = total
+            accounted = (
+                row["quorum_s"] + row["heal_s"] + row["allreduce_s"]
+                + row["commit_s"]
+            )
+            row["compute_s"] = max(total - accounted, 0.0)
+        else:
+            row["total_s"] = sum(row[p] for p in PHASES)
+        timeline.setdefault(step, {})[rid] = row
+    return timeline
+
+
+def slowest_replica(rows: Dict[str, Dict[str, Any]]) -> Tuple[str, str]:
+    """(replica, dominant phase) for the replica with the largest step
+    wall-time."""
+    rid = max(rows, key=lambda r: rows[r].get("total_s", 0.0))
+    row = rows[rid]
+    phase = max(PHASES, key=lambda p: row.get(p, 0.0))
+    return rid, phase.replace("_s", "")
+
+
+def _percentile(values: List[float], pct: float) -> float:
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(int(len(vs) * pct / 100.0), len(vs) - 1)
+    return vs[idx]
+
+
+def detect_stalls(
+    timeline: Dict[int, Dict[str, Dict[str, Any]]],
+    pct: float,
+    min_s: float,
+) -> List[Dict[str, Any]]:
+    """Steps whose worst quorum wait exceeds the pct-percentile of all
+    quorum waits AND the absolute floor ``min_s``."""
+    waits = [
+        row["quorum_s"]
+        for rows in timeline.values()
+        for row in rows.values()
+        if row["quorum_s"] > 0
+    ]
+    threshold = max(_percentile(waits, pct), min_s)
+    stalls = []
+    for step in sorted(timeline):
+        rows = timeline[step]
+        worst_rid = max(rows, key=lambda r: rows[r]["quorum_s"])
+        worst = rows[worst_rid]["quorum_s"]
+        if worst > threshold:
+            stalls.append(
+                {
+                    "step": step,
+                    "replica": worst_rid,
+                    "quorum_wait_s": round(worst, 4),
+                    "threshold_s": round(threshold, 4),
+                }
+            )
+    return stalls
+
+
+def goodput_rollup(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregates the per-replica ``goodput`` shutdown events (the dict
+    ``Manager.goodput()`` returns) into a run-level rollup. The LAST
+    goodput event per replica wins (a healed relaunch re-emits)."""
+    per_replica: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        if ev["event"] == "goodput":
+            per_replica[_replica_key(ev)] = ev.get("attrs") or {}
+    if not per_replica:
+        return {}
+    total = {
+        k: sum(float(g.get(k) or 0.0) for g in per_replica.values())
+        for k in (
+            "committed_steps", "failed_commits", "committed_s",
+            "failed_s", "heal_count", "heal_s",
+        )
+    }
+    denom = total["committed_s"] + total["failed_s"] + total["heal_s"]
+    total["goodput_frac"] = (
+        round(total["committed_s"] / denom, 4) if denom > 0 else None
+    )
+    total["replicas"] = sorted(per_replica)
+    return total
+
+
+def render_text(
+    timeline: Dict[int, Dict[str, Dict[str, Any]]],
+    stalls: List[Dict[str, Any]],
+    goodput: Dict[str, Any],
+) -> str:
+    out = []
+    out.append(
+        f"{'step':>6} {'replica':>10} {'quorum':>8} {'heal':>8} "
+        f"{'compute':>8} {'allreduce':>9} {'commit':>8} {'total':>8} "
+        f"{'verdict':>8}  slowest"
+    )
+    for step in sorted(timeline):
+        rows = timeline[step]
+        slow_rid, slow_phase = slowest_replica(rows)
+        for rid in sorted(rows):
+            row = rows[rid]
+            verdict = {True: "commit", False: "FAIL", None: "-"}[
+                row["committed"]
+            ]
+            marker = (
+                f"<- slowest ({slow_phase})"
+                if rid == slow_rid and len(rows) > 1
+                else ""
+            )
+            out.append(
+                f"{step:>6} {rid:>10} {row['quorum_s']:>8.3f} "
+                f"{row['heal_s']:>8.3f} {row['compute_s']:>8.3f} "
+                f"{row['allreduce_s']:>9.3f} {row['commit_s']:>8.3f} "
+                f"{row['total_s']:>8.3f} {verdict:>8}  {marker}"
+            )
+    if stalls:
+        out.append("")
+        out.append("stalled steps (quorum wait above threshold):")
+        for s in stalls:
+            out.append(
+                f"  step {s['step']}: replica {s['replica']} waited "
+                f"{s['quorum_wait_s']}s (threshold {s['threshold_s']}s)"
+            )
+    if goodput:
+        out.append("")
+        out.append(
+            "goodput rollup: "
+            f"committed_steps={int(goodput['committed_steps'])} "
+            f"failed_commits={int(goodput['failed_commits'])} "
+            f"heal_count={int(goodput['heal_count'])} "
+            f"heal_s={goodput['heal_s']:.3f} "
+            f"goodput_frac={goodput['goodput_frac']}"
+        )
+    return "\n".join(out)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+",
+                   help="journal files or directories of *.jsonl")
+    p.add_argument("--json", action="store_true",
+                   help="emit the merged report as JSON")
+    p.add_argument("--stall-pct", type=float, default=95.0,
+                   help="quorum-wait percentile for the stall detector")
+    p.add_argument("--stall-min-s", type=float, default=0.5,
+                   help="absolute quorum-wait floor for the stall detector")
+    args = p.parse_args(argv)
+
+    events = load_events(args.paths)
+    if not events:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    timeline = build_timeline(events)
+    stalls = detect_stalls(timeline, args.stall_pct, args.stall_min_s)
+    goodput = goodput_rollup(events)
+
+    if args.json:
+        report = {
+            "steps": {
+                str(step): {
+                    "replicas": timeline[step],
+                    "slowest": dict(
+                        zip(("replica", "phase"),
+                            slowest_replica(timeline[step]))
+                    ),
+                }
+                for step in sorted(timeline)
+            },
+            "stalls": stalls,
+            "goodput": goodput,
+            "num_events": len(events),
+        }
+        json.dump(report, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(render_text(timeline, stalls, goodput))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,9 +10,17 @@
 # time — lane order: fast first (fails fast on logic regressions), slow
 # integ second.
 #
-# Usage: bash tools/suite_gate.sh   # exits nonzero if EITHER lane fails
+# Usage: bash tools/suite_gate.sh       # exits nonzero if EITHER lane fails
+#        bash tools/suite_gate.sh obs   # observability smoke only: 2-replica
+#                                       # demo with the event journal on,
+#                                       # asserted through tools/obs_report.py
 set -u
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "obs" ]; then
+  echo "== obs smoke: 2-replica journaled demo -> obs_report =="
+  exec timeout 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+fi
 
 t0=$(date +%s)
 echo "== lane 1/2: fast (pytest -m 'not slow') =="

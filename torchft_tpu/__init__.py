@@ -32,8 +32,11 @@ from torchft_tpu.process_group import (  # noqa: E402,F401
     ReduceOp,
 )
 from torchft_tpu.telemetry import (  # noqa: E402,F401
+    EventLog,
     MetricsLogger,
     flight_recorder,
+    get_event_log,
+    span_percentiles,
     span_stats,
     timeit,
     trace_span,
@@ -47,7 +50,10 @@ __all__ = [
     "ManagedMesh",
     "ManagedProcessGroup",
     "Manager",
+    "EventLog",
     "MetricsLogger",
+    "get_event_log",
+    "span_percentiles",
     "OptimizerWrapper",
     "ProcessGroup",
     "ProcessGroupBabySocket",

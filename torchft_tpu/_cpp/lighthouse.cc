@@ -94,6 +94,23 @@ void Lighthouse::tick() {
   }
   if (bump) state_.quorum_id += 1;
 
+  // Participant churn across quorum transitions (surfaced via status +
+  // /metrics): a member present now but not in the previous quorum is a
+  // join; one gone is a leave. Covers crash, kill, and graceful drain
+  // uniformly at the granularity monitoring cares about.
+  {
+    std::set<std::string> prev_ids;
+    if (state_.prev_quorum)
+      for (const auto& m : state_.prev_quorum->participants)
+        prev_ids.insert(m.replica_id);
+    std::set<std::string> new_ids;
+    for (const auto& m : *members) new_ids.insert(m.replica_id);
+    for (const auto& id : new_ids)
+      if (!prev_ids.count(id)) joins_total_ += 1;
+    for (const auto& id : prev_ids)
+      if (!new_ids.count(id)) leaves_total_ += 1;
+  }
+
   Quorum q;
   q.quorum_id = state_.quorum_id;
   q.participants = *members;
@@ -358,6 +375,9 @@ Json Lighthouse::status_json() {
   std::lock_guard<std::mutex> lk(mu_);
   Json s = Json::object();
   s["quorum_id"] = Json::of(state_.quorum_id);
+  s["quorum_generation"] = Json::of(quorum_gen_);
+  s["joins_total"] = Json::of(joins_total_);
+  s["leaves_total"] = Json::of(leaves_total_);
   int64_t now = now_ms();
   Json hb = Json::object();
   for (const auto& kv : state_.heartbeats)
@@ -441,6 +461,14 @@ std::string Lighthouse::render_metrics() {
        "boot.\n"
     << "# TYPE torchft_lighthouse_quorum_generation counter\n"
     << "torchft_lighthouse_quorum_generation " << quorum_gen_ << "\n";
+  m << "# HELP torchft_lighthouse_joins_total Members added across quorum "
+       "transitions.\n"
+    << "# TYPE torchft_lighthouse_joins_total counter\n"
+    << "torchft_lighthouse_joins_total " << joins_total_ << "\n";
+  m << "# HELP torchft_lighthouse_leaves_total Members gone across quorum "
+       "transitions.\n"
+    << "# TYPE torchft_lighthouse_leaves_total counter\n"
+    << "torchft_lighthouse_leaves_total " << leaves_total_ << "\n";
   m << "# HELP torchft_lighthouse_participants Replicas currently waiting in "
        "the next quorum.\n"
     << "# TYPE torchft_lighthouse_participants gauge\n"

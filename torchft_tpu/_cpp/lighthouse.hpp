@@ -63,6 +63,8 @@ class Lighthouse {
   LighthouseState state_;
   std::optional<Quorum> last_quorum_;  // most recently broadcast quorum
   int64_t quorum_gen_ = 0;             // bumped on every broadcast
+  int64_t joins_total_ = 0;   // members added across quorum transitions
+  int64_t leaves_total_ = 0;  // members gone across quorum transitions
   std::string last_reason_;            // why no quorum yet (for status page)
 
   int listen_fd_ = -1;

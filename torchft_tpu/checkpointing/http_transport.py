@@ -21,7 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
 from torchft_tpu.checkpointing._rwlock import RWLock
-from torchft_tpu.telemetry import timed, timeit
+from torchft_tpu.telemetry import get_event_log, timed, timeit
 from torchft_tpu.checkpointing._serialization import (
     _LEN,
     _read_exact,
@@ -214,6 +214,15 @@ class HTTPTransport(CheckpointTransport):
             self._state.meta = meta
             self._state.buffers = buffers
             self._state.step = step
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "ckpt_send",
+                step=step,
+                transport="http",
+                dst_ranks=list(dst_ranks),
+                nbytes=int(sum(b.nbytes for b in buffers)),
+            )
 
     def disallow_checkpoint(self) -> None:
         with self._state.lock.w_lock(self._timeout):
@@ -253,10 +262,21 @@ class HTTPTransport(CheckpointTransport):
         # (frombuffer: no second copy).
         refs = collect_refs(meta)
         buffers: List[Optional[Any]] = [None] * len(refs)
+        nbytes = 0
         for ref in refs:
             raw = parts.pop(ref.index)
+            nbytes += len(raw)
             buffers[ref.index] = np.frombuffer(
                 raw, dtype=np.dtype(ref.dtype)
+            )
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "ckpt_recv",
+                step=step,
+                transport="http",
+                peer=src_rank,
+                nbytes=int(nbytes),
             )
         return join_state(meta, buffers)
 

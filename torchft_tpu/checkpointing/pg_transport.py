@@ -18,7 +18,7 @@ import numpy as np
 from torchft_tpu.checkpointing._serialization import join_state, split_state
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.process_group import ProcessGroup
-from torchft_tpu.telemetry import timed
+from torchft_tpu.telemetry import get_event_log, timed
 
 
 class PGTransport(CheckpointTransport):
@@ -70,6 +70,15 @@ class PGTransport(CheckpointTransport):
             self._send_preamble(dst, step, blob, timeout)
             for i, buf in enumerate(buffers):
                 self._pg.send([buf], dst, tag=f"ckpt{step}.t{i}").wait(timeout)
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "ckpt_send",
+                step=step,
+                transport="pg",
+                dst_ranks=list(dst_ranks),
+                nbytes=int(sum(b.nbytes for b in buffers)),
+            )
 
     def _send_preamble(
         self, dst: int, step: int, blob: np.ndarray, timeout: float
@@ -172,6 +181,12 @@ class PGTransport(CheckpointTransport):
                     built[ref.index] = place_plain_leaf(
                         ref, buf.reshape(-1), t_leaf
                     )
+            log = get_event_log()
+            if log is not None:
+                log.emit(
+                    "ckpt_recv", step=step, transport="pg", peer=src_rank,
+                    sharded=True,
+                )
             return substitute_built_leaves(meta, built)
 
         from torchft_tpu.checkpointing._serialization import collect_refs
@@ -183,6 +198,12 @@ class PGTransport(CheckpointTransport):
                 timeout
             )
             buffers[ref.index] = buf.reshape(-1)
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "ckpt_recv", step=step, transport="pg", peer=src_rank,
+                nbytes=int(sum(b.nbytes for b in buffers if b is not None)),
+            )
         inplace = self._state_dict_fn() if self._state_dict_fn else None
         return join_state(meta, buffers, inplace_into=inplace)
 

@@ -343,6 +343,23 @@ class _ServerProcess:
         )
         self.port = self._read_port()
         atexit.register(self.shutdown)
+        from torchft_tpu.telemetry import get_event_log
+
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "server_start",
+                server=self._name,
+                port=self.port,
+                pid=self._proc.pid,
+            )
+
+    def _journal_stop(self) -> None:
+        from torchft_tpu.telemetry import get_event_log
+
+        log = get_event_log()
+        if log is not None:
+            log.emit("server_stop", server=self._name, port=self.port)
 
     def _read_port(self, timeout: float = 10.0) -> int:
         assert self._proc.stdout is not None
@@ -378,6 +395,7 @@ class _ServerProcess:
 
     def shutdown(self) -> None:
         if self._proc.poll() is None:
+            self._journal_stop()
             self._proc.terminate()
             try:
                 self._proc.wait(timeout=5)

@@ -35,7 +35,7 @@ import numpy as np
 import optax
 
 from torchft_tpu.manager import Manager
-from torchft_tpu.telemetry import traced
+from torchft_tpu.telemetry import get_event_log, traced
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
@@ -113,6 +113,13 @@ class LocalSGD:
         """Quorum + parameter average + conditional commit (reference:
         local_sgd.py:126-155)."""
         manager = self._manager
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "local_sgd_sync",
+                step=manager.current_step(),
+                sync_every=self._sync_every,
+            )
         manager.start_quorum()
         params = self._get()
         # Leaves go to the manager AS-IS: Manager.allreduce itself routes
@@ -278,6 +285,14 @@ class _Fragment:
             )
             self._pending.append((work, idx_list))
         self._pending_leaves = leaves
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "fragment_prepare_sync",
+                step=self._manager.current_step(),
+                fragment=self.index,
+                buckets=len(buckets),
+            )
 
     @traced("torchft::local_sgd::perform_sync")
     def perform_sync(self) -> bool:
@@ -300,6 +315,13 @@ class _Fragment:
         pseudograd = jax.tree_util.tree_unflatten(
             self._pending_treedef, out
         )
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "fragment_perform_sync",
+                step=self._manager.current_step(),
+                fragment=self.index,
+            )
 
         # Fenced: the commit decision (step bump) and the backup/param
         # merge must be one critical section vs checkpoint-send reads

@@ -41,7 +41,14 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.store import StoreClient, TCPStoreServer
-from torchft_tpu.telemetry import get_metrics_logger, timeit, trace_span, traced
+from torchft_tpu.telemetry import (
+    get_event_log,
+    get_metrics_logger,
+    set_default_replica_id,
+    timeit,
+    trace_span,
+    traced,
+)
 from torchft_tpu.work import DummyWork, Work
 
 logger = logging.getLogger(__name__)
@@ -232,6 +239,9 @@ class Manager:
         self._replica_id = self._store.get_str(
             REPLICA_ID_KEY, timeout=self._connect_timeout
         )
+        # Pin the journal's default id so pg/transport events from this
+        # process share the manager's timeline row in obs_report.
+        set_default_replica_id(self._replica_id)
         self._client = ManagerClient(manager_addr, self._connect_timeout)
         self._logger = _ManagerLogger(self)
 
@@ -360,6 +370,15 @@ class Manager:
     # ------------------------------------------------------------------
 
     @traced("torchft::manager::start_quorum")
+    def _journal(self, event: str, **attrs: Any) -> None:
+        """Emits a step-event journal record. No-op (one env read, no
+        allocation) unless TORCHFT_JOURNAL_FILE/_DIR is set."""
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                event, step=self._step, replica_id=self._replica_id, **attrs
+            )
+
     def start_quorum(
         self,
         allow_heal: bool = True,
@@ -374,6 +393,9 @@ class Manager:
                 "start_quorum after leave(): a drained manager must not "
                 "rejoin the quorum (relaunch the process to rejoin)"
             )
+        self._journal(
+            "quorum_start", allow_heal=allow_heal, shrink_only=shrink_only
+        )
         self._errored = None
         self._healing = False
         self._quorum_future = self._executor.submit(
@@ -399,6 +421,7 @@ class Manager:
     ) -> None:
         from torchft_tpu.coordination import RequestAborted
 
+        t_quorum0 = time.monotonic()
         try:
             self._quorum_rpc_pending = True
             try:
@@ -427,15 +450,26 @@ class Manager:
             # check fires next; logged at info, not exception — a
             # deliberate interrupt, not a fault.
             self._logger.info("quorum wait aborted by drain request")
+            self._journal("quorum_abort", reason="drain")
             self.report_error(e)
             raise
         except Exception as e:
             self._logger.exception(f"quorum failed: {e}")
+            self._journal("quorum_abort", reason=str(e)[:200])
             self.report_error(e)
             raise
 
         quorum_id_changed = result.quorum_id != self._quorum_id
         heal = result.heal and allow_heal
+        self._journal(
+            "quorum_ready",
+            quorum_id=result.quorum_id,
+            replica_rank=result.replica_rank,
+            replica_world_size=result.replica_world_size,
+            max_step=result.max_step,
+            heal=bool(heal),
+            elapsed_s=time.monotonic() - t_quorum0,
+        )
         # Operator-initiated drain flag (latched: a one-shot observation
         # must not be lost if a later quorum response races the trainer's
         # loop-top check).
@@ -505,15 +539,25 @@ class Manager:
                     self._logger.info(
                         f"sending checkpoint to {result.recover_dst_replica_ranks}"
                     )
+                    self._journal(
+                        "heal_send_start",
+                        dst_ranks=list(result.recover_dst_replica_ranks),
+                        max_step=result.max_step,
+                    )
                     with timeit(
                         "torchft::manager::send_checkpoint", self._logger
-                    ):
+                    ) as t_send:
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=result.recover_dst_replica_ranks,
                             step=result.max_step,
                             state_dict=self._manager_state_dict(),
                             timeout=self._timeout,
                         )
+                    self._journal(
+                        "heal_send_done",
+                        dst_ranks=list(result.recover_dst_replica_ranks),
+                        elapsed_s=t_send["elapsed_s"],
+                    )
                 if heal:
                     self._healing = True
                     src_client = ManagerClient(
@@ -530,6 +574,11 @@ class Manager:
                         f"{result.recover_src_replica_rank} at step "
                         f"{result.max_step}"
                     )
+                    self._journal(
+                        "heal_start",
+                        peer=result.recover_src_replica_rank,
+                        max_step=result.max_step,
+                    )
                     with timeit(
                         "torchft::manager::recv_checkpoint", self._logger
                     ) as t_heal:
@@ -543,12 +592,19 @@ class Manager:
                         self._goodput["heal_count"] += 1
                         self._goodput["heal_s"] += t_heal["elapsed_s"]
                         self._heal_since_gate += t_heal["elapsed_s"]
+                    self._journal(
+                        "heal_done",
+                        peer=result.recover_src_replica_rank,
+                        max_step=result.max_step,
+                        elapsed_s=t_heal["elapsed_s"],
+                    )
                     # torchft state applies immediately; user state is
                     # deferred to the main thread (manager.py:716-720).
                     self.load_state_dict(state["torchft"])
                     self._pending_state_dict = state["user"]
             except Exception as e:
                 self._logger.exception(f"recovery failed: {e}")
+                self._journal("heal_failed", error=str(e)[:200])
                 self.report_error(e)
 
     def _apply_pending_state_dict(self) -> None:
@@ -653,6 +709,12 @@ class Manager:
                 self._logger.exception(f"quantized allreduce failed: {e}")
                 self.report_error(e)
                 return DummyWork(items)
+            self._journal(
+                "allreduce_issue",
+                nbytes=int(sum(getattr(t, "nbytes", 0) for t in items)),
+                quantized=True,
+                bits=quantize_bits,
+            )
             return _ManagedWork(self, work, items, scale=1.0, in_place=False)
 
         def to_mutable(t: Any) -> np.ndarray:
@@ -697,6 +759,11 @@ class Manager:
             self.report_error(e)
             return DummyWork(arrays)
 
+        self._journal(
+            "allreduce_issue",
+            nbytes=int(sum(a.nbytes for a in arrays)),
+            quantized=bool(should_quantize),
+        )
         return _ManagedWork(
             self,
             work,
@@ -737,7 +804,17 @@ class Manager:
 
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Distributed commit gate (reference: manager.py:760-836)."""
+        gated_step = self._step  # _should_commit_inner increments on commit
         answer = self._should_commit_inner(timeout)
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "commit_gate",
+                step=gated_step,
+                replica_id=self._replica_id,
+                committed=bool(answer),
+                num_participants=self.num_participants(),
+            )
         metrics = get_metrics_logger()
         if metrics is not None:
             metrics.log(
@@ -951,6 +1028,7 @@ class Manager:
             g = self.goodput()
             if g["committed_steps"] or g["failed_commits"]:
                 self._logger.info(f"goodput: {g}")
+                self._journal("goodput", **g)
         except Exception:  # noqa: BLE001 - shutdown must not fail on a log
             pass
         self._executor.shutdown(wait=False, cancel_futures=True)
@@ -993,6 +1071,7 @@ class _ManagedWork(Work):
                 return
             self._finished = True
             t = timeout if timeout is not None else self._manager._timeout
+            t0 = time.monotonic()
             try:
                 # Belt and braces: the wait carries a deadline, AND the
                 # timeout engine aborts the pg if the wait wedges past it —
@@ -1008,8 +1087,19 @@ class _ManagedWork(Work):
                         a *= self._scale
                 else:
                     self._arrays = list(result)
+                self._manager._journal(
+                    "allreduce_complete",
+                    ok=True,
+                    elapsed_s=time.monotonic() - t0,
+                )
             except Exception as e:  # noqa: BLE001
                 self._manager._logger.exception(f"allreduce work failed: {e}")
+                self._manager._journal(
+                    "allreduce_complete",
+                    ok=False,
+                    elapsed_s=time.monotonic() - t0,
+                    error=str(e)[:200],
+                )
                 self._manager.report_error(e)
 
     def wait(self, timeout: Optional[float] = None) -> Any:

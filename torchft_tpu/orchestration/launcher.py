@@ -45,12 +45,19 @@ def render_topology(
     env: Optional[Dict[str, str]] = None,
     timeout_sec: Optional[float] = None,
     quorum_timeout_sec: Optional[float] = None,
+    journal_dir: Optional[str] = None,
 ) -> List[ProcessSpec]:
     """Returns one ProcessSpec per (replica_group, group_rank).
 
     ``cmd`` is the trainer command (e.g. ``[sys.executable, "train_ddp.py"]``);
     the FT topology is injected purely through env vars, like the reference's
     torchrun roles (torchx.py:70-74).
+
+    ``journal_dir`` wires the step-event journal (telemetry.EventLog): each
+    process gets a distinct ``TORCHFT_JOURNAL_FILE`` under the dir so a run
+    produces per-replica journals that ``tools/obs_report.py`` can merge.
+    Relaunches of the same slot append to the same file — the timeline of a
+    replica that died and came back belongs in one journal.
     """
     specs: List[ProcessSpec] = []
     for group in range(num_replica_groups):
@@ -69,6 +76,10 @@ def render_topology(
             if master_port is not None:
                 e["MASTER_ADDR"] = "127.0.0.1"
                 e["MASTER_PORT"] = str(master_port)
+            if journal_dir is not None:
+                e["TORCHFT_JOURNAL_FILE"] = (
+                    f"{journal_dir}/journal_replica{group}_rank{rank}.jsonl"
+                )
             if timeout_sec is not None:
                 e["TORCHFT_TIMEOUT_SEC"] = str(timeout_sec)
             if quorum_timeout_sec is not None:

@@ -32,7 +32,7 @@ import numpy as np
 
 from torchft_tpu import _net
 from torchft_tpu.store import StoreClient
-from torchft_tpu.telemetry import add_bytes, flight_recorder
+from torchft_tpu.telemetry import add_bytes, flight_recorder, get_event_log
 from torchft_tpu.work import DummyWork, ErrorWork, FutureWork, Work
 
 import logging
@@ -333,6 +333,7 @@ class ProcessGroupSocket(ProcessGroup):
     # -- lifecycle ---------------------------------------------------------
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        _t0 = time.monotonic()
         with self._configure_lock:
             self._abort_locked()
             self._errored = None
@@ -347,6 +348,14 @@ class ProcessGroupSocket(ProcessGroup):
                 self._executor = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="pg-exec"
                 )
+                log = get_event_log()
+                if log is not None:
+                    log.emit(
+                        "pg_configure",
+                        rank=rank,
+                        world=world_size,
+                        elapsed_s=time.monotonic() - _t0,
+                    )
                 return
 
             addr, _, prefix = store_addr.partition("/")
@@ -381,6 +390,15 @@ class ProcessGroupSocket(ProcessGroup):
             except (OSError, TimeoutError) as e:
                 for c in peers.values():
                     c.close()
+                log = get_event_log()
+                if log is not None:
+                    log.emit(
+                        "pg_configure_failed",
+                        rank=rank,
+                        world=world_size,
+                        error=str(e)[:200],
+                        elapsed_s=time.monotonic() - _t0,
+                    )
                 raise RuntimeError(
                     f"rank {rank}: process group rendezvous failed: {e}"
                 ) from e
@@ -392,6 +410,14 @@ class ProcessGroupSocket(ProcessGroup):
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="pg-exec"
             )
+            log = get_event_log()
+            if log is not None:
+                log.emit(
+                    "pg_configure",
+                    rank=rank,
+                    world=world_size,
+                    elapsed_s=time.monotonic() - _t0,
+                )
 
     def abort(self, _dump: bool = True) -> None:
         with self._configure_lock:
@@ -402,6 +428,11 @@ class ProcessGroupSocket(ProcessGroup):
         # reference's NCCL flight recorder (process_group.py:89-108).
         # Clean shutdown() passes _dump=False: teardown is not a failure.
         if _dump:
+            log = get_event_log()
+            if log is not None:
+                log.emit(
+                    "pg_abort", rank=self._rank, error=str(self._errored)[:200]
+                )
             path = flight_recorder.maybe_dump_on_abort(
                 f"pg abort: {self._errored}"
             )

@@ -31,6 +31,8 @@ are off unless their env vars are set.
 
 from __future__ import annotations
 
+import atexit
+import bisect
 import collections
 import contextlib
 import functools
@@ -44,12 +46,18 @@ __all__ = [
     "trace_span",
     "traced",
     "span_stats",
+    "span_percentiles",
     "reset_span_stats",
     "timeit",
     "timed",
     "MetricsLogger",
     "get_metrics_logger",
+    "EventLog",
+    "get_event_log",
+    "reset_event_log",
+    "set_default_replica_id",
     "trace_window",
+    "reset_trace_window",
     "FlightRecorder",
     "flight_recorder",
 ]
@@ -59,30 +67,50 @@ __all__ = [
 # Spans
 # ----------------------------------------------------------------------
 
+# Fixed log-spaced histogram boundaries shared by every span: 1µs doubling
+# up to ~137s (28 finite buckets + one overflow). Precomputed once so the
+# hot-path cost is a bisect over a tuple plus a list increment — no
+# allocation per observation.
+_HIST_BOUNDS: tuple = tuple(1e-6 * (2.0 ** i) for i in range(28))
+_HIST_NBUCKETS = len(_HIST_BOUNDS) + 1
+
+
 class _SpanStats:
-    """Process-local span accounting: count + total/max wall seconds."""
+    """Process-local span accounting: count + total/max wall seconds, plus a
+    fixed-bucket latency histogram per span (log-spaced; p50/p95/p99 come
+    from :func:`span_percentiles`)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stats: Dict[str, Dict[str, float]] = {}
+        self._hist: Dict[str, List[int]] = {}
 
     def add(self, name: str, dt: float) -> None:
         with self._lock:
-            s = self._stats.setdefault(
-                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
-            )
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0
+                }
+                self._hist[name] = [0] * _HIST_NBUCKETS
             s["count"] += 1
             s["total_s"] += dt
             if dt > s["max_s"]:
                 s["max_s"] = dt
+            self._hist[name][bisect.bisect_left(_HIST_BOUNDS, dt)] += 1
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: dict(v) for k, v in self._stats.items()}
 
+    def hist_snapshot(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._hist.items()}
+
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._hist.clear()
 
 
 _SPAN_STATS = _SpanStats()
@@ -91,6 +119,43 @@ _SPAN_STATS = _SpanStats()
 def span_stats() -> Dict[str, Dict[str, float]]:
     """Snapshot of per-span {count, total_s, max_s} accumulated so far."""
     return _SPAN_STATS.snapshot()
+
+
+def _hist_percentile(buckets: List[int], q: float) -> float:
+    """Upper-bound estimate of the q-quantile from bucket counts."""
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            if i < len(_HIST_BOUNDS):
+                return _HIST_BOUNDS[i]
+            # Overflow bucket: no upper bound; report the last boundary.
+            return _HIST_BOUNDS[-1]
+    return _HIST_BOUNDS[-1]
+
+
+def span_percentiles(
+    name: Optional[str] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Per-span latency percentiles {p50, p95, p99} (seconds), estimated
+    from the fixed log-spaced histogram (each value is the upper boundary
+    of the bucket containing that quantile — an over-estimate within one
+    2x bucket). Pass ``name`` to restrict to one span."""
+    hist = _SPAN_STATS.hist_snapshot()
+    if name is not None:
+        hist = {name: hist[name]} if name in hist else {}
+    return {
+        k: {
+            "p50": _hist_percentile(v, 0.50),
+            "p95": _hist_percentile(v, 0.95),
+            "p99": _hist_percentile(v, 0.99),
+        }
+        for k, v in hist.items()
+    }
 
 
 def reset_span_stats() -> None:
@@ -253,6 +318,10 @@ class MetricsLogger:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        # One append-mode handle for the logger's lifetime: reopening per
+        # log() costs a syscall-heavy open/close on every train step.
+        self._fh: Optional[Any] = open(path, "a")
+        atexit.register(self.close)
 
     def log(self, step: int, **scalars: Any) -> None:
         rec: Dict[str, Any] = {"step": int(step), "ts": time.time()}
@@ -263,11 +332,18 @@ class MetricsLogger:
                 rec[k] = str(v)
         line = json.dumps(rec)
         with self._lock:
-            with open(self._path, "a") as f:
-                f.write(line + "\n")
+            if self._fh is None:  # closed: drop rather than raise mid-step
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
 
-    def close(self) -> None:  # symmetry; file handle is per-write
-        pass
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
 
 
 _METRICS_LOGGER: Optional[MetricsLogger] = None
@@ -283,8 +359,142 @@ def get_metrics_logger() -> Optional[MetricsLogger]:
         return None
     with _METRICS_LOCK:
         if _METRICS_LOGGER is None or _METRICS_LOGGER._path != path:
+            if _METRICS_LOGGER is not None:
+                _METRICS_LOGGER.close()
             _METRICS_LOGGER = MetricsLogger(path)
         return _METRICS_LOGGER
+
+
+# ----------------------------------------------------------------------
+# Event journal (structured step-event JSONL)
+# ----------------------------------------------------------------------
+
+class EventLog:
+    """Structured step-event journal: one JSON line per event,
+    ``{ts, replica_id, step, event, **attrs}``.
+
+    Where :class:`MetricsLogger` records per-step scalars, the journal
+    records the *sequence* of control-plane events (quorum start/ready,
+    heal start/done, allreduce issue/complete, commit verdicts, PG
+    configure/abort, checkpoint send/recv) with enough attributes that
+    ``tools/obs_report.py`` can merge journals from every replica into a
+    step-aligned timeline. Lock-cheap: one json.dumps + write + flush per
+    event, and events only fire at control-plane frequency (a handful per
+    step), never per-microbatch.
+    """
+
+    def __init__(self, path: str, replica_id: Optional[str] = None) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        if replica_id is None:
+            replica_id = os.environ.get("TORCHFT_REPLICA_ID") or (
+                _DEFAULT_REPLICA_ID
+                or os.environ.get("REPLICA_GROUP_ID", f"pid{os.getpid()}")
+            )
+        self.replica_id = replica_id
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh: Optional[Any] = open(path, "a")
+        atexit.register(self.close)
+
+    def emit(
+        self,
+        event: str,
+        step: Optional[int] = None,
+        replica_id: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        rec: Dict[str, Any] = {
+            "ts": time.time(),
+            "replica_id": self.replica_id if replica_id is None else replica_id,
+            "step": None if step is None else int(step),
+            "event": event,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        try:
+            line = json.dumps(rec, default=str)
+        except Exception:
+            return  # never let journaling break the train loop
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+_EVENT_LOG: Optional[EventLog] = None
+_EVENT_LOCK = threading.Lock()
+_DEFAULT_REPLICA_ID: Optional[str] = None
+
+
+def set_default_replica_id(replica_id: str) -> None:
+    """Pins the ``replica_id`` stamped on journal events that don't pass
+    one explicitly (process-group / transport call sites). The Manager
+    calls this with its own id so every event from its process folds onto
+    one timeline row in ``tools/obs_report.py`` — otherwise those events
+    fall back to ``REPLICA_GROUP_ID``, which need not match the trainer's
+    chosen manager id. ``TORCHFT_REPLICA_ID`` still wins."""
+    global _DEFAULT_REPLICA_ID
+    _DEFAULT_REPLICA_ID = replica_id
+    with _EVENT_LOCK:
+        if _EVENT_LOG is not None and not os.environ.get("TORCHFT_REPLICA_ID"):
+            _EVENT_LOG.replica_id = replica_id
+
+
+def _journal_path_from_env() -> str:
+    """Journal destination: ``TORCHFT_JOURNAL_FILE`` wins; else
+    ``TORCHFT_JOURNAL_DIR`` derives a per-process filename. Empty when
+    neither is set (journal disabled)."""
+    path = os.environ.get("TORCHFT_JOURNAL_FILE", "")
+    if path:
+        return path
+    d = os.environ.get("TORCHFT_JOURNAL_DIR", "")
+    if not d:
+        return ""
+    rid = os.environ.get("REPLICA_GROUP_ID", "x")
+    rank = os.environ.get("RANK", "0")
+    return os.path.join(d, f"journal_replica{rid}_rank{rank}_{os.getpid()}.jsonl")
+
+
+def get_event_log() -> Optional[EventLog]:
+    """Process-wide event journal, enabled by ``TORCHFT_JOURNAL_FILE`` or
+    ``TORCHFT_JOURNAL_DIR``. Returns None (two env reads, no allocation)
+    when neither is set — callers guard with ``if log is not None`` so the
+    disabled hot path stays free."""
+    global _EVENT_LOG
+    path = _journal_path_from_env()
+    if not path:
+        return None
+    with _EVENT_LOCK:
+        if _EVENT_LOG is None or _EVENT_LOG._path != path:
+            if _EVENT_LOG is not None:
+                _EVENT_LOG.close()
+            _EVENT_LOG = EventLog(path)
+        return _EVENT_LOG
+
+
+def reset_event_log() -> None:
+    """Closes and forgets the cached journal and the pinned default
+    replica id (tests / re-exec)."""
+    global _EVENT_LOG, _DEFAULT_REPLICA_ID
+    with _EVENT_LOCK:
+        if _EVENT_LOG is not None:
+            _EVENT_LOG.close()
+        _EVENT_LOG = None
+        _DEFAULT_REPLICA_ID = None
 
 
 # ----------------------------------------------------------------------
@@ -346,6 +556,18 @@ def _trace_atexit() -> None:
             _trace_stop()
 
 
+def reset_trace_window() -> None:
+    """Re-arms the one-shot profiler window: stops a trace still running
+    and clears the done flag so the next :func:`trace_window` call can
+    schedule a fresh window (tests, multi-run processes)."""
+    with _TRACE_LOCK:
+        if _TRACE_STATE["active"]:
+            _trace_stop()
+        _TRACE_STATE["active"] = False
+        _TRACE_STATE["done"] = False
+        _TRACE_STATE["stop_at"] = -1
+
+
 # ----------------------------------------------------------------------
 # Flight recorder
 # ----------------------------------------------------------------------
@@ -367,6 +589,9 @@ class FlightRecorder:
     def __init__(self, capacity: int = 256) -> None:
         self._lock = threading.Lock()
         self._buf: collections.deque = collections.deque(maxlen=capacity)
+        # seq -> record index alongside the deque so complete() is O(1)
+        # instead of a reverse scan of the ring.
+        self._by_seq: Dict[int, Dict[str, Any]] = {}
         self._seq = 0
 
     def record(
@@ -381,29 +606,32 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             seq = self._seq
-            self._buf.append(
-                {
-                    "seq": seq,
-                    "op": op,
-                    "tag": tag,
-                    "nbytes": int(nbytes),
-                    "rank": rank,
-                    "world": world,
-                    "status": "issued",
-                    "t_issued": time.time(),
-                }
-            )
+            rec = {
+                "seq": seq,
+                "op": op,
+                "tag": tag,
+                "nbytes": int(nbytes),
+                "rank": rank,
+                "world": world,
+                "status": "issued",
+                "t_issued": time.time(),
+            }
+            if len(self._buf) == self._buf.maxlen:
+                # Deque is full: the append below evicts the oldest record;
+                # drop it from the index so the dict can't grow unbounded.
+                self._by_seq.pop(self._buf[0]["seq"], None)
+            self._buf.append(rec)
+            self._by_seq[seq] = rec
             return seq
 
     def complete(self, seq: int, error: Optional[str] = None) -> None:
         with self._lock:
-            for rec in reversed(self._buf):
-                if rec["seq"] == seq:
-                    rec["status"] = "error" if error else "ok"
-                    rec["t_done"] = time.time()
-                    if error:
-                        rec["error"] = error[:500]
-                    break
+            rec = self._by_seq.get(seq)
+            if rec is not None:
+                rec["status"] = "error" if error else "ok"
+                rec["t_done"] = time.time()
+                if error:
+                    rec["error"] = error[:500]
 
     def snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
