@@ -66,6 +66,62 @@ def test_retention_keeps_latest(tmp_path):
     ckpt.close()
 
 
+def test_structure_fingerprint_mismatch_fails_loudly(tmp_path):
+    """Restoring into a DIFFERENT model/optimizer structure must be refused
+    at the door: rehang-by-flattened-order would otherwise silently load
+    leaves into the wrong slots whenever the counts happen to line up."""
+    ckpt = DurableCheckpointer(str(tmp_path), every=1)
+    state = {"a": jnp.arange(8, dtype=jnp.float32), "b": jnp.zeros(4)}
+    ckpt.save(1, state)
+    ckpt.wait()
+
+    # Matching structure restores fine.
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    restored = ckpt.restore(abstract_state=abstract)
+    np.testing.assert_allclose(restored["a"], np.arange(8, dtype=np.float32))
+
+    # Same leaf count, different shapes: refused with a description.
+    wrong_shape = {
+        "a": jax.ShapeDtypeStruct((4,), jnp.float32),
+        "b": jax.ShapeDtypeStruct((8,), jnp.float32),
+    }
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt.restore(abstract_state=wrong_shape)
+
+    # Different tree structure (extra key): also refused.
+    wrong_tree = dict(abstract)
+    wrong_tree["c"] = jax.ShapeDtypeStruct((2,), jnp.float32)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt.restore(abstract_state=wrong_tree)
+
+    # Different dtype: refused.
+    wrong_dtype = dict(abstract)
+    wrong_dtype["a"] = jax.ShapeDtypeStruct((8,), jnp.float64)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt.restore(abstract_state=wrong_dtype)
+    ckpt.close()
+
+
+def test_structure_fingerprint_missing_sidecar_tolerated(tmp_path):
+    """Snapshots written before fingerprints existed (or whose sidecar was
+    lost) must still restore — the check is advisory-absent, loud-present."""
+    ckpt = DurableCheckpointer(str(tmp_path), every=1)
+    state = {"w": jnp.ones(4)}
+    ckpt.save(1, state)
+    ckpt.wait()
+    fp = ckpt._fingerprint_path(1)
+    assert fp.exists()
+    fp.unlink()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    restored = ckpt.restore(abstract_state=abstract)
+    np.testing.assert_allclose(restored["w"], 1.0)
+    ckpt.close()
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 @pytest.mark.slow
 def test_sharded_train_state_roundtrip(tmp_path):

@@ -251,6 +251,59 @@ def test_peer_conn_recv_fails_fast_after_peer_death():
             pass
 
 
+def test_peer_conn_abort_tombstone_cleared_by_fresh_data():
+    """An abort tombstone for a tag must not outlive the collective it
+    belonged to: on a long-lived PG, p2p tags are REUSED (the parameter
+    server's fixed session tags), so fresh data arriving under a
+    tombstoned tag means a new generation started — the recv must deliver
+    it, not keep raising the stale _CollectiveAborted forever."""
+    import socket as socket_mod
+    import time
+
+    from torchft_tpu import _net
+    from torchft_tpu.process_group import _CollectiveAborted, _PeerConn
+
+    a, b = socket_mod.socketpair()
+    conn = _PeerConn(a, peer=1)
+    try:
+        # Peer aborts collective "t1" (covers "t1" and "t1.*").
+        _net.send_json(b, {"tag": "t1", "abort": True, "error": "leg died"})
+        _net.send_frame(b, b"")
+        deadline = time.monotonic() + 5
+        while "t1" not in conn._aborted:
+            if time.monotonic() > deadline:
+                raise AssertionError("abort never registered")
+            time.sleep(0.01)
+
+        # The tombstone fails recvs under the prefix (sticky behavior).
+        with pytest.raises(_CollectiveAborted):
+            conn.recv("t1.0", timeout=5.0)
+
+        # The peer starts a NEW collective reusing the tag: fresh data
+        # must clear the tombstone and be delivered. (The clear happens
+        # when the reader processes the frame — wait for it, since a recv
+        # racing ahead of the wire legitimately still sees the tombstone.)
+        arr = np.arange(6, dtype=np.float32)
+        _net.send_json(b, {"tag": "t1.0", "dtype": "float32", "shape": [6]})
+        _net.send_frame(b, arr.tobytes())
+        while "t1" in conn._aborted:
+            if time.monotonic() > deadline:
+                raise AssertionError("fresh data never cleared the tombstone")
+            time.sleep(0.01)
+        np.testing.assert_array_equal(conn.recv("t1.0", timeout=5.0), arr)
+
+        # Later recvs under the same prefix behave normally again.
+        _net.send_json(b, {"tag": "t1.1", "dtype": "float32", "shape": [6]})
+        _net.send_frame(b, arr.tobytes())
+        np.testing.assert_array_equal(conn.recv("t1.1", timeout=5.0), arr)
+    finally:
+        conn.close()
+        try:
+            b.close()
+        except OSError:
+            pass
+
+
 def test_collective_abort_propagates_to_live_peers(store):
     """A rank that abandons a collective (its own leg failed) must unblock
     the OTHER ranks' pending waits on that collective immediately — one

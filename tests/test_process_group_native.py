@@ -1,0 +1,448 @@
+"""ProcessGroupNative tests: the C++ pipelined collective engine behind the
+same ProcessGroup surface as the socket backend. Covers the collective
+surface, socket-vs-native fp32 bitwise equivalence, the int8 wire codec
+(tolerance + wire-byte cut), abort/reconfigure mid-collective, backend
+selection via TORCHFT_PG, and the wrapper zoo over the native group."""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu import _native
+from torchft_tpu.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+    ProcessGroupNative,
+    ProcessGroupSocket,
+    ReduceOp,
+    make_process_group,
+)
+from torchft_tpu.store import TCPStoreServer
+from torchft_tpu.telemetry import byte_stats
+
+pytestmark = pytest.mark.skipif(
+    not _native.is_available(), reason="native collective engine unavailable"
+)
+
+
+def _run_parallel(fns, timeout=60):
+    with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = TCPStoreServer()
+    yield server
+    server.shutdown()
+
+
+def _make_group(store, world_size, prefix="npg0", timeout=10.0, **kw):
+    groups = [
+        ProcessGroupNative(timeout=timeout, **kw) for _ in range(world_size)
+    ]
+    _run_parallel(
+        [
+            lambda r=r: groups[r].configure(
+                f"{store.address()}/{prefix}", r, world_size
+            )
+            for r in range(world_size)
+        ]
+    )
+    return groups
+
+
+# -- core collectives --------------------------------------------------------
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_allreduce_sum(store, world_size):
+    groups = _make_group(store, world_size, prefix=f"nar{world_size}")
+    expected = sum(range(world_size))
+
+    def run(rank):
+        arr = np.full((5, 3), float(rank), dtype=np.float32)
+        return groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)[0]
+
+    for r in _run_parallel([lambda r=r: run(r) for r in range(world_size)]):
+        np.testing.assert_allclose(r, expected)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_ops(store):
+    groups = _make_group(store, 3, prefix="nops")
+
+    def run(rank, op):
+        arr = np.array([1.0, -2.0, 4.0], np.float32) * (rank + 1)
+        return groups[rank].allreduce(arr, op).wait(timeout=30)[0]
+
+    for op, expect in [
+        (ReduceOp.AVG, np.array([2.0, -4.0, 8.0]) / 1.0 * (1 + 2 + 3) / 6.0),
+        (ReduceOp.MAX, np.array([3.0, -2.0, 12.0])),
+        (ReduceOp.MIN, np.array([1.0, -6.0, 4.0])),
+    ]:
+        for r in _run_parallel(
+            [lambda r=r, o=op: run(r, o) for r in range(3)]
+        ):
+            np.testing.assert_allclose(r, expect, rtol=1e-6)
+    for g in groups:
+        g.shutdown()
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "int64"])
+def test_allreduce_native_dtypes(store, dtype):
+    groups = _make_group(store, 2, prefix=f"ndt_{dtype}")
+
+    def run(rank):
+        arr = (np.arange(1000 + 7) % 97).astype(dtype) * (rank + 1)
+        return groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)[0]
+
+    a, b = _run_parallel([lambda r=r: run(r) for r in range(2)])
+    expect = (np.arange(1000 + 7) % 97).astype(dtype) * 3
+    np.testing.assert_array_equal(a, expect)
+    np.testing.assert_array_equal(b, expect)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allreduce_fallback_dtype_rides_python_ring(store):
+    """Dtypes outside the engine's set (bf16) fall back to the inherited
+    socket ring transparently."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    groups = _make_group(store, 2, prefix="nbf16")
+
+    def run(rank):
+        arr = np.full(16, float(rank + 1), dtype=ml_dtypes.bfloat16)
+        return groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)[0]
+
+    for r in _run_parallel([lambda r=r: run(r) for r in range(2)]):
+        np.testing.assert_allclose(np.asarray(r, np.float32), 3.0)
+    for g in groups:
+        g.shutdown()
+
+
+def test_allgather_broadcast_barrier(store):
+    groups = _make_group(store, 3, prefix="nagb")
+
+    def run(rank):
+        ragged = np.arange(4 + rank, dtype=np.float64) + rank
+        gathered = groups[rank].allgather([ragged]).wait(timeout=30)
+        token = np.full((2, 2), float(rank), np.float32)
+        groups[rank].broadcast([token], root=1).wait(timeout=30)
+        groups[rank].barrier().wait(timeout=30)
+        return gathered, token
+
+    results = _run_parallel([lambda r=r: run(r) for r in range(3)])
+    for gathered, token in results:
+        for p in range(3):
+            np.testing.assert_allclose(
+                gathered[p][0], np.arange(4 + p, dtype=np.float64) + p
+            )
+        np.testing.assert_allclose(token, 1.0)  # root's payload
+    for g in groups:
+        g.shutdown()
+
+
+def test_noncontiguous_input(store):
+    groups = _make_group(store, 2, prefix="nnc")
+
+    def run(rank):
+        base = np.zeros((6, 8), np.float32)
+        view = base[::2, ::2]  # non-contiguous view
+        view[...] = rank + 1
+        groups[rank].allreduce(view, ReduceOp.SUM).wait(timeout=30)
+        return view.copy()
+
+    a, b = _run_parallel([lambda r=r: run(r) for r in range(2)])
+    np.testing.assert_allclose(a, 3.0)
+    np.testing.assert_allclose(b, 3.0)
+    for g in groups:
+        g.shutdown()
+
+
+def test_world_size_one_noop():
+    pg = ProcessGroupNative()
+    pg.configure("unused:0/nsolo", 0, 1)
+    out = pg.allreduce(np.full(4, 7.0, np.float32), ReduceOp.SUM).wait(
+        timeout=5
+    )
+    np.testing.assert_allclose(out[0], 7.0)
+    pg.shutdown()
+
+
+# -- equivalence with the socket backend -------------------------------------
+
+
+def test_socket_native_fp32_bitwise_equivalence(store):
+    """Same inputs through both backends must produce BITWISE identical
+    fp32 results: the C++ ring replicates the numpy ring's chunking
+    (np.array_split) and accumulation order exactly."""
+    ws = 3
+    rng = np.random.default_rng(7)
+    inputs = [
+        rng.standard_normal(4096 + 13).astype(np.float32) for _ in range(ws)
+    ]
+
+    def run_backend(groups):
+        def run(rank):
+            arr = inputs[rank].copy()
+            groups[rank].allreduce(arr, ReduceOp.AVG).wait(timeout=30)
+            return arr
+
+        out = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+        for g in groups:
+            g.shutdown()
+        return out
+
+    socket_groups = [ProcessGroupSocket(timeout=10.0) for _ in range(ws)]
+    _run_parallel(
+        [
+            lambda r=r: socket_groups[r].configure(
+                f"{store.address()}/eq_s", r, ws
+            )
+            for r in range(ws)
+        ]
+    )
+    native_out = run_backend(_make_group(store, ws, prefix="eq_n"))
+    socket_out = run_backend(socket_groups)
+    for s, n in zip(socket_out, native_out):
+        np.testing.assert_array_equal(s, n)
+
+
+# -- int8 wire codec ---------------------------------------------------------
+
+
+def test_int8_wire_tolerance_and_byte_cut(store):
+    """wire="int8" fp32 allreduce: within quantization tolerance of the
+    true mean, bitwise identical across ranks, and moving ~4x fewer wire
+    bytes than the fp32 path for the same payload."""
+    ws = 2
+    n = 512 * 8 + 5
+    rng = np.random.default_rng(3)
+    inputs = [rng.standard_normal(n).astype(np.float32) for _ in range(ws)]
+
+    def run_wire(prefix, wire):
+        groups = _make_group(store, ws, prefix=prefix, wire=wire)
+        tx0 = byte_stats().get("pg_wire_tx", 0)
+
+        def run(rank):
+            arr = inputs[rank].copy()
+            groups[rank].allreduce(arr, ReduceOp.AVG).wait(timeout=30)
+            return arr
+
+        out = _run_parallel([lambda r=r: run(r) for r in range(ws)])
+        tx = byte_stats().get("pg_wire_tx", 0) - tx0
+        for g in groups:
+            g.shutdown()
+        return out, tx
+
+    q_out, q_tx = run_wire("w_q8", "int8")
+    f_out, f_tx = run_wire("w_f32", "fp32")
+
+    true_mean = (inputs[0] + inputs[1]) / ws
+    # Two lossy quantization steps, each bounded by half a step of its
+    # block absmax (standard normal: absmax of a 512 block is ~3-4).
+    np.testing.assert_allclose(q_out[0], true_mean, atol=0.1)
+    # Cross-rank: everyone decodes the same final bytes.
+    np.testing.assert_array_equal(q_out[0], q_out[1])
+    # fp32 path is exact.
+    np.testing.assert_allclose(f_out[0], true_mean, rtol=1e-6)
+    # Wire cut: int8 moves ~n bytes/rank/phase vs ~4n for fp32.
+    assert q_tx > 0 and f_tx > 0
+    assert q_tx < f_tx / 2, f"int8 wire bytes {q_tx} not < half of {f_tx}"
+
+
+# -- abort / reconfigure -----------------------------------------------------
+
+
+def test_abort_unblocks_native_collective_and_reconfigures(store):
+    """Abort mid-collective: rank 1 never joins, rank 0's allreduce blocks
+    in the C++ engine; abort() must unblock it promptly (not wait out the
+    timeout), latch errored(), and a reconfigure must fully recover."""
+    groups = _make_group(store, 2, prefix="nab1", timeout=60.0)
+
+    work_holder = {}
+
+    def stuck():
+        work = groups[0].allreduce(np.ones(1 << 20, dtype=np.float32))
+        work_holder["w"] = work
+        with pytest.raises((RuntimeError, Exception)):
+            work.wait(timeout=120)
+        return time.monotonic()
+
+    def aborter():
+        time.sleep(0.5)
+        groups[0].abort()
+        return time.monotonic()
+
+    t0 = time.monotonic()
+    _run_parallel([stuck, aborter], timeout=120)
+    assert time.monotonic() - t0 < 20, "abort did not unblock the collective"
+    assert groups[0].errored() is not None
+
+    # Both ranks reconfigure under a fresh prefix and work again.
+    def reconfigure(rank):
+        groups[rank].configure(f"{store.address()}/nab2", rank, 2)
+        arr = np.full(8, float(rank + 1), np.float32)
+        groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+        return arr
+
+    a, b = _run_parallel([lambda r=r: reconfigure(r) for r in range(2)])
+    np.testing.assert_allclose(a, 3.0)
+    np.testing.assert_allclose(b, 3.0)
+    assert groups[0].errored() is None
+    for g in groups:
+        g.shutdown()
+
+
+def test_peer_failure_fails_native_collective_fast(store):
+    """A peer that abandons a collective broadcasts its abort over the
+    python mesh; the survivor blocked inside the C++ engine must be
+    poisoned through the cross-plane hook, not wait out the timeout."""
+    groups = _make_group(store, 2, prefix="nxp", timeout=60.0)
+    t0 = time.monotonic()
+
+    def survivor():
+        work = groups[0].allreduce(np.ones(1 << 18, dtype=np.float32))
+        with pytest.raises(Exception, match="abort|died"):
+            work.wait(timeout=120)
+
+    def failer():
+        # Wrong arity fails locally before any engine traffic, triggering
+        # the abort broadcast for its collective tag.
+        work = groups[1].alltoall([np.ones(4, dtype=np.float32)])
+        with pytest.raises(ValueError):
+            work.wait(timeout=60)
+
+    _run_parallel([survivor, failer], timeout=120)
+    assert time.monotonic() - t0 < 20
+    for g in groups:
+        g.shutdown()
+
+
+def test_abort_latches_error(store):
+    groups = _make_group(store, 2, prefix="nlatch")
+    groups[0].abort()
+    assert groups[0].errored() is not None
+    with pytest.raises(RuntimeError):
+        groups[0].allreduce(np.ones(2, np.float32)).wait(timeout=5)
+    for g in groups:
+        g.shutdown()
+
+
+# -- backend selection and wrappers ------------------------------------------
+
+
+def test_make_process_group_env(monkeypatch):
+    monkeypatch.delenv("TORCHFT_PG", raising=False)
+    assert isinstance(make_process_group(), ProcessGroupSocket)
+    assert not isinstance(make_process_group(), ProcessGroupNative)
+    monkeypatch.setenv("TORCHFT_PG", "native")
+    assert isinstance(make_process_group(), ProcessGroupNative)
+    assert make_process_group().getBackendName() == "torchft-native"
+    monkeypatch.setenv("TORCHFT_PG", "dummy")
+    assert isinstance(make_process_group(), ProcessGroupDummy)
+    monkeypatch.setenv("TORCHFT_PG", "nope")
+    with pytest.raises(ValueError, match="nope"):
+        make_process_group()
+
+
+def test_wrapper_zoo_over_native(store):
+    """ErrorSwallowing and Fake wrappers compose with the native backend
+    exactly as with the socket one."""
+    groups = _make_group(store, 2, prefix="nzoo")
+    wrapped = [ErrorSwallowingProcessGroupWrapper(g) for g in groups]
+
+    def run(rank):
+        arr = np.full(4, float(rank + 1), np.float32)
+        return wrapped[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)[0]
+
+    a, b = _run_parallel([lambda r=r: run(r) for r in range(2)])
+    np.testing.assert_allclose(a, 3.0)
+    np.testing.assert_allclose(b, 3.0)
+
+    # Post-error, collectives become no-ops until reconfigure.
+    wrapped[0].report_error(RuntimeError("injected"))
+    out = wrapped[0].allreduce(np.ones(2, np.float32)).wait(timeout=5)
+    np.testing.assert_allclose(out[0], 1.0)
+
+    fake = FakeProcessGroupWrapper(groups[0])
+    fake.report_future_error(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        fake.allreduce(np.ones(1, np.float32)).wait(timeout=5)
+    for g in groups:
+        g.shutdown()
+
+
+# -- OS-process kill + heal drill --------------------------------------------
+
+
+@pytest.mark.slow
+def test_native_kill_heal_drill(tmp_path):
+    """The chaos drill with TORCHFT_PG=native: replica groups train over the
+    native data plane, one is SIGKILLed mid-run, the runner relaunches it,
+    it heals, and all groups finish bitwise-equal."""
+    import json
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.orchestration import ReplicaGroupRunner, render_topology
+    from torchft_tpu.orchestration.punisher import kill_one
+
+    steps = 120
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=10000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=3000,
+    )
+    result_dir = str(tmp_path / "results")
+    runner = None
+    try:
+        specs = render_topology(
+            [
+                sys.executable, "-m",
+                "torchft_tpu.orchestration.demo_trainer",
+                "--steps", str(steps),
+                "--result-dir", result_dir,
+                "--step-sleep", "0.03",
+            ],
+            num_replica_groups=3,
+            lighthouse_addr=lighthouse.address(),
+        )
+        for s in specs:
+            s.env["TORCHFT_PG"] = "native"
+        runner = ReplicaGroupRunner(
+            specs, max_restarts=10, log_dir=str(tmp_path / "logs")
+        )
+        runner.start()
+        time.sleep(2.5)
+        assert kill_one(runner, spare_group_zero=True) is not None
+        ok = runner.run_until_done(timeout=180)
+        assert ok, f"runner did not finish (restarts={runner.restarts})"
+        assert sum(runner.restarts.values()) >= 1
+    finally:
+        if runner is not None:
+            runner.stop()
+        lighthouse.shutdown()
+
+    results = {}
+    for g in range(3):
+        with open(os.path.join(result_dir, f"group{g}.json")) as f:
+            results[g] = json.load(f)
+    ws = [np.asarray(results[g]["w"], np.float32) for g in range(3)]
+    for w in ws[1:]:
+        np.testing.assert_array_equal(ws[0], w)
+    for g in range(3):
+        assert results[g]["final_step"] == steps
+    healed = [
+        g for g in range(3) if results[g]["committed_this_life"] < steps
+    ]
+    assert healed, f"no group shows heal evidence: {results}"

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Replica-axis data plane micro-bench: socket vs native allreduce.
+
+Spawns WORLD OS-process workers per backend (real processes, not threads —
+the socket backend's python ring is GIL-bound and thread workers would
+understate it), times fp32 SUM allreduces across payload sizes, and writes
+a ``BENCH_PG_*.json`` with per-size throughput for both backends.
+
+Run directly:
+
+    python tools/bench_pg.py                     # report only
+    python tools/bench_pg.py --assert-speedup 2  # gate: native >= 2x socket
+                                                 # at the largest size
+
+or via ``bash tools/suite_gate.sh pg``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_SIZES_MIB = "1,16,64"
+
+
+def _worker(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from torchft_tpu.process_group import (
+        ProcessGroupNative,
+        ProcessGroupSocket,
+        ReduceOp,
+    )
+
+    cls = {"socket": ProcessGroupSocket, "native": ProcessGroupNative}[
+        args.backend
+    ]
+    pg = cls(timeout=args.timeout)
+    pg.configure(args.store, args.rank, args.world)
+    sizes = [int(s) for s in args.sizes.split(",")]
+    results = []
+    rng = np.random.default_rng(args.rank)
+    try:
+        for mib in sizes:
+            count = mib * (1 << 20) // 4
+            arr = rng.standard_normal(count).astype(np.float32)
+            # Sync + warmup (first collective pays rendezvous/alloc costs).
+            pg.barrier().wait(timeout=args.timeout)
+            pg.allreduce(arr.copy(), ReduceOp.SUM).wait(timeout=args.timeout)
+            best = float("inf")
+            for _ in range(args.iters):
+                buf = arr.copy()
+                pg.barrier().wait(timeout=args.timeout)
+                t0 = time.perf_counter()
+                pg.allreduce(buf, ReduceOp.SUM).wait(timeout=args.timeout)
+                best = min(best, time.perf_counter() - t0)
+            results.append(
+                {
+                    "size_mib": mib,
+                    "best_s": best,
+                    # Effective payload rate: caller bytes reduced per
+                    # second (the number a training loop experiences).
+                    "gib_per_s": (mib / 1024.0) / best,
+                }
+            )
+        if args.rank == 0 and args.result:
+            with open(args.result, "w") as f:
+                json.dump(results, f)
+    finally:
+        pg.shutdown()
+    return 0
+
+
+def _run_backend(
+    backend: str, world: int, sizes: str, iters: int, timeout: float
+) -> list:
+    from torchft_tpu.store import TCPStoreServer
+
+    server = TCPStoreServer()
+    result_path = tempfile.mktemp(prefix=f"bench_pg_{backend}_")
+    procs = []
+    try:
+        for rank in range(world):
+            cmd = [
+                sys.executable, os.path.abspath(__file__),
+                "--worker", "--backend", backend,
+                "--store", f"{server.address()}/bench_{backend}",
+                "--rank", str(rank), "--world", str(world),
+                "--sizes", sizes, "--iters", str(iters),
+                "--timeout", str(timeout),
+            ]
+            if rank == 0:
+                cmd += ["--result", result_path]
+            procs.append(
+                subprocess.Popen(
+                    cmd,
+                    cwd=REPO,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+            )
+        deadline = time.monotonic() + timeout * 4
+        for p in procs:
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"{backend} bench worker exited rc={p.returncode}"
+                )
+        with open(result_path) as f:
+            return json.load(f)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.shutdown()
+        if os.path.exists(result_path):
+            os.unlink(result_path)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--backend", default="socket")
+    ap.add_argument("--store", default="")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--sizes", default=DEFAULT_SIZES_MIB, help="MiB, csv")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--result", default="")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(REPO, "BENCH_PG_allreduce.json"),
+        help="report path (BENCH_PG_*.json)",
+    )
+    ap.add_argument(
+        "--assert-speedup",
+        type=float,
+        default=0.0,
+        help="fail unless native >= this x socket at the largest size",
+    )
+    args = ap.parse_args()
+    if args.worker:
+        return _worker(args)
+
+    report = {
+        "world": args.world,
+        "iters": args.iters,
+        "backends": {},
+    }
+    for backend in ("socket", "native"):
+        print(f"== bench {backend}: world={args.world} sizes={args.sizes} ==")
+        rows = _run_backend(
+            backend, args.world, args.sizes, args.iters, args.timeout
+        )
+        report["backends"][backend] = rows
+        for r in rows:
+            print(
+                f"  {backend:7s} {r['size_mib']:5d} MiB  "
+                f"{r['best_s'] * 1e3:9.1f} ms  {r['gib_per_s']:.2f} GiB/s"
+            )
+
+    largest = max(int(s) for s in args.sizes.split(","))
+
+    def rate(backend: str) -> float:
+        rows = report["backends"][backend]
+        return next(
+            r["gib_per_s"] for r in rows if r["size_mib"] == largest
+        )
+
+    speedup = rate("native") / rate("socket")
+    report["largest_size_mib"] = largest
+    report["native_over_socket"] = speedup
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(
+        f"== native/socket at {largest} MiB: {speedup:.2f}x  "
+        f"(report: {args.out}) =="
+    )
+    if args.assert_speedup and speedup < args.assert_speedup:
+        print(
+            f"FAIL: native speedup {speedup:.2f}x < required "
+            f"{args.assert_speedup:.1f}x"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,12 +14,23 @@
 #        bash tools/suite_gate.sh obs   # observability smoke only: 2-replica
 #                                       # demo with the event journal on,
 #                                       # asserted through tools/obs_report.py
+#        bash tools/suite_gate.sh pg    # data-plane micro-bench: socket vs
+#                                       # native allreduce -> BENCH_PG_*.json
 set -u
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "obs" ]; then
   echo "== obs smoke: 2-replica journaled demo -> obs_report =="
   exec timeout 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+fi
+
+if [ "${1:-}" = "pg" ]; then
+  echo "== pg bench: socket vs native allreduce (1/16/64 MiB, 2 ranks) =="
+  # Floor at 1.5x as the regression gate: the headline number on an idle
+  # 1-core box is >=2x at 64 MiB (see BENCH_PG_allreduce.json), but this
+  # lane shares the machine with whatever CI runs next to it.
+  exec timeout 900 env JAX_PLATFORMS=cpu python tools/bench_pg.py \
+    --iters 5 --assert-speedup 1.5
 fi
 
 t0=$(date +%s)

@@ -1,0 +1,928 @@
+#include "collectives.hpp"
+
+#include <math.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+
+#include "net.hpp"
+
+namespace tft {
+
+namespace {
+
+// Matches _net.set_buffer_sizes (Python side): 4 MiB socket buffers so a
+// single DCN stream can keep a large window in flight.
+constexpr int kSockBuf = 16 * 1024 * 1024;
+
+void set_data_plane_opts(int fd) {
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &kSockBuf, sizeof(kSockBuf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &kSockBuf, sizeof(kSockBuf));
+}
+
+// ---------------------------------------------------------------------------
+// Blockwise int8 quantization, numerically identical to
+// torchft_tpu/collectives.py quantize_blockwise / dequantize_blockwise
+// (bits=8): BLOCK=512 values per float32 scale, scale = absmax/127 (1.0 for
+// all-zero blocks), round-half-even, clip to ±127, zero-padded tail block.
+// All arithmetic stays in fp32 with the same operation order as the numpy
+// path, so quantized wire bytes and reduced results agree bit-for-bit with
+// the Python codec.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kQBlock = 512;
+
+void q8_quantize(const float* x, uint64_t n, uint64_t blocks, int8_t* q,
+                 float* scales) {
+  for (uint64_t b = 0; b < blocks; ++b) {
+    const uint64_t lo = b * kQBlock;
+    float absmax = 0.f;
+    for (uint64_t j = 0; j < kQBlock; ++j) {
+      const uint64_t idx = lo + j;
+      const float v = idx < n ? x[idx] : 0.f;
+      const float a = fabsf(v);
+      if (a > absmax) absmax = a;
+    }
+    float s = absmax / 127.0f;
+    if (absmax == 0.f) s = 1.0f;
+    scales[b] = s;
+    for (uint64_t j = 0; j < kQBlock; ++j) {
+      const uint64_t idx = lo + j;
+      const float v = idx < n ? x[idx] : 0.f;
+      float t = nearbyintf(v / s);  // FE_TONEAREST = ties-to-even = np.rint
+      if (t > 127.f) t = 127.f;
+      if (t < -127.f) t = -127.f;
+      q[lo + j] = static_cast<int8_t>(t);
+    }
+  }
+}
+
+// acc[i] += (float)q[i] * scale[block], same two fp32 roundings as the numpy
+// dequantize-then-accumulate (mat *= scales; acc += mat).
+void q8_accumulate(float* acc, const int8_t* q, const float* scales,
+                   uint64_t blocks) {
+  for (uint64_t b = 0; b < blocks; ++b) {
+    const float s = scales[b];
+    const uint64_t lo = b * kQBlock;
+    for (uint64_t j = 0; j < kQBlock; ++j) {
+      const float t = static_cast<float>(q[lo + j]) * s;
+      acc[lo + j] += t;
+    }
+  }
+}
+
+template <typename T>
+void reduce_into(T* dst, const T* src, uint64_t n, int32_t op) {
+  if (op == TFT_OP_SUM) {
+    for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];
+  } else if (op == TFT_OP_MAX) {
+    for (uint64_t i = 0; i < n; ++i)
+      dst[i] = dst[i] > src[i] ? dst[i] : src[i];
+  } else {
+    for (uint64_t i = 0; i < n; ++i)
+      dst[i] = dst[i] < src[i] ? dst[i] : src[i];
+  }
+}
+
+uint64_t dtype_size(int32_t dtype) {
+  switch (dtype) {
+    case TFT_DT_F32:
+    case TFT_DT_I32:
+      return 4;
+    case TFT_DT_F64:
+    case TFT_DT_I64:
+      return 8;
+  }
+  return 0;
+}
+
+// np.array_split semantics over `n` units across `parts`: the first n%parts
+// chunks get one extra unit. Identical to ProcessGroupSocket's chunking, so
+// the uncompressed ring reduces the exact same slices.
+uint64_t split_size(uint64_t n, int parts, int i) {
+  return n / parts + (static_cast<uint64_t>(i) < n % parts ? 1 : 0);
+}
+uint64_t split_off(uint64_t n, int parts, int i) {
+  const uint64_t base = n / parts;
+  const uint64_t rem = n % parts;
+  const uint64_t extra =
+      std::min<uint64_t>(static_cast<uint64_t>(i), rem);
+  return base * static_cast<uint64_t>(i) + extra;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// TaskPool
+// ---------------------------------------------------------------------------
+
+TaskPool::TaskPool(int n_threads) {
+  threads_.reserve(n_threads);
+  for (int i = 0; i < n_threads; ++i)
+    threads_.emplace_back([this] { worker(); });
+}
+
+TaskPool::~TaskPool() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : threads_) t.join();
+}
+
+void TaskPool::submit(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push(std::move(fn));
+  }
+  cv_.notify_one();
+}
+
+void TaskPool::worker() {
+  while (true) {
+    std::function<void()> fn;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+      // Drain remaining jobs even when stopping: queued jobs carry Waiter
+      // pointers someone may still be blocked on; with the sockets shut
+      // down they fail fast rather than hang.
+      if (queue_.empty()) return;
+      fn = std::move(queue_.front());
+      queue_.pop();
+    }
+    fn();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Waiter: completion barrier for a batch of striped transfer jobs.
+// ---------------------------------------------------------------------------
+
+struct CollectiveEngine::Waiter {
+  std::mutex mu;
+  std::condition_variable cv;
+  int pending = 0;
+  bool ok = true;
+  bool timed_out = false;
+  std::string err;
+
+  void add(int n) {
+    std::lock_guard<std::mutex> lk(mu);
+    pending += n;
+  }
+  void done(bool job_ok, bool job_timeout, const char* what) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!job_ok && ok) {
+      ok = false;
+      timed_out = job_timeout;
+      err = what;
+    }
+    if (--pending == 0) cv.notify_all();
+  }
+  bool wait_all() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [this] { return pending == 0; });
+    return ok;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// CollectiveEngine
+// ---------------------------------------------------------------------------
+
+CollectiveEngine::CollectiveEngine(int n_streams, int64_t pipeline_bytes)
+    : n_streams_(std::max(1, n_streams)),
+      pipeline_bytes_(std::max<int64_t>(64 * 1024, pipeline_bytes)) {}
+
+CollectiveEngine::~CollectiveEngine() {
+  abort("engine destroyed");
+  pool_.reset();  // joins workers; queued jobs fail fast on shut-down fds
+  close_all();
+}
+
+void CollectiveEngine::set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(err_mu_);
+  last_error_ = msg;
+}
+
+bool CollectiveEngine::fail(const std::string& msg) {
+  // An abort reason beats the downstream I/O error it caused.
+  if (!aborted_.load()) set_error(msg);
+  return false;
+}
+
+std::string CollectiveEngine::last_error() const {
+  std::lock_guard<std::mutex> lk(err_mu_);
+  return last_error_;
+}
+
+int CollectiveEngine::listen(const std::string& host) {
+  listen_fd_ = tcp_listen(host, 0, 256);
+  if (listen_fd_ < 0) {
+    set_error("data plane listen failed");
+    return -1;
+  }
+  // Accepted sockets inherit the buffer sizes; must precede accept.
+  set_data_plane_opts(listen_fd_);
+  port_ = bound_port(listen_fd_);
+  return port_;
+}
+
+bool CollectiveEngine::connect_mesh(int rank, int world,
+                                    const std::vector<std::string>& peers,
+                                    int64_t timeout_ms) {
+  rank_ = rank;
+  world_ = world;
+  results_.assign(world, {});
+  peer_fds_.assign(world, {});
+  if (world <= 1) {
+    pool_ = std::make_unique<TaskPool>(1);
+    return true;
+  }
+  if (static_cast<int>(peers.size()) != world)
+    return fail("connect_mesh: need one address per rank");
+  const int64_t deadline = now_ms() + timeout_ms;
+  // Deterministic full mesh (same shape as ProcessGroupSocket.configure):
+  // connect n_streams sockets to every lower rank, accept from higher ranks.
+  for (int p = 0; p < rank; ++p) {
+    std::string host;
+    int port = 0;
+    if (!split_host_port(peers[p], &host, &port))
+      return fail("connect_mesh: bad peer address " + peers[p]);
+    peer_fds_[p].assign(n_streams_, -1);
+    for (int s = 0; s < n_streams_; ++s) {
+      const int64_t remaining = deadline - now_ms();
+      if (remaining <= 0 || aborted_.load())
+        return fail("timeout: data plane connect to rank " +
+                    std::to_string(p));
+      int fd = tcp_connect_retry(host, port, remaining);
+      if (fd < 0)
+        return fail("timeout: data plane connect to rank " +
+                    std::to_string(p));
+      set_data_plane_opts(fd);
+      Json hello = Json::object();
+      hello["rank"] = Json::of(static_cast<int64_t>(rank));
+      hello["stripe"] = Json::of(static_cast<int64_t>(s));
+      if (!send_frame(fd, hello.dump(), deadline - now_ms())) {
+        close(fd);
+        return fail("connect_mesh: hello to rank " + std::to_string(p) +
+                    " failed");
+      }
+      peer_fds_[p][s] = fd;
+    }
+  }
+  const int expected = (world - 1 - rank) * n_streams_;
+  for (int i = 0; i < expected; ++i) {
+    const int64_t remaining = deadline - now_ms();
+    if (remaining <= 0 || aborted_.load())
+      return fail("timeout: data plane accept (" + std::to_string(i) + "/" +
+                  std::to_string(expected) + ")");
+    int fd = tcp_accept(listen_fd_, static_cast<int>(remaining));
+    if (fd < 0)
+      return fail("timeout: data plane accept (" + std::to_string(i) + "/" +
+                  std::to_string(expected) + ")");
+    set_data_plane_opts(fd);
+    std::string raw;
+    Json hello;
+    if (!recv_frame(fd, &raw, std::max<int64_t>(1, deadline - now_ms())) ||
+        !Json::parse(raw, &hello)) {
+      close(fd);
+      return fail("connect_mesh: bad hello frame");
+    }
+    const int p = static_cast<int>(hello.get("rank").as_int(-1));
+    const int s = static_cast<int>(hello.get("stripe").as_int(-1));
+    if (p <= rank || p >= world || s < 0 || s >= n_streams_) {
+      close(fd);
+      return fail("connect_mesh: hello from unexpected rank/stripe");
+    }
+    if (peer_fds_[p].empty()) peer_fds_[p].assign(n_streams_, -1);
+    peer_fds_[p][s] = fd;
+  }
+  // Worst concurrent job count: the compressed alltoall runs two striped
+  // sends + two striped recvs per peer at once. Undersizing the pool could
+  // fill every worker with blocked senders and deadlock the mesh.
+  const int n_threads =
+      std::min(64, std::max(2, 4 * n_streams_ * (world - 1)));
+  pool_ = std::make_unique<TaskPool>(n_threads);
+  return true;
+}
+
+void CollectiveEngine::abort(const std::string& why) {
+  if (aborted_.exchange(true)) return;
+  set_error("aborted: " + why);
+  // Shut down (not close) every socket: blocked reads/writes in pool jobs
+  // and any caller mid-collective fail immediately; fds stay valid until
+  // the destructor so no job can race a close/reuse.
+  if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  for (auto& fds : peer_fds_)
+    for (int fd : fds)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+}
+
+void CollectiveEngine::close_all() {
+  if (listen_fd_ >= 0) close(listen_fd_);
+  listen_fd_ = -1;
+  for (auto& fds : peer_fds_)
+    for (int fd : fds)
+      if (fd >= 0) close(fd);
+  peer_fds_.clear();
+}
+
+void CollectiveEngine::stripe_range(uint64_t units, int s, uint64_t* off,
+                                    uint64_t* len) const {
+  *off = split_off(units, n_streams_, s);
+  *len = split_size(units, n_streams_, s);
+}
+
+void CollectiveEngine::send_stripes(int peer, const char* data,
+                                    uint64_t nbytes, uint64_t esize,
+                                    int64_t deadline_ms, Waiter* w) {
+  if (nbytes == 0) return;
+  const uint64_t units = nbytes / esize;
+  for (int s = 0; s < n_streams_; ++s) {
+    uint64_t uoff, ulen;
+    stripe_range(units, s, &uoff, &ulen);
+    if (ulen == 0) continue;
+    const int fd = peer_fds_[peer][s];
+    const char* p = data + uoff * esize;
+    const uint64_t len = ulen * esize;
+    w->add(1);
+    pool_->submit([this, fd, p, len, deadline_ms, w] {
+      const int64_t remaining = deadline_ms - now_ms();
+      const bool ok = remaining > 0 && !aborted_.load() &&
+                      write_all(fd, p, len, remaining);
+      if (ok) bytes_tx_ += len;
+      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
+              "stripe send failed");
+    });
+  }
+}
+
+void CollectiveEngine::recv_stripes(int peer, char* data, uint64_t nbytes,
+                                    uint64_t esize, int64_t deadline_ms,
+                                    Waiter* w) {
+  if (nbytes == 0) return;
+  const uint64_t units = nbytes / esize;
+  for (int s = 0; s < n_streams_; ++s) {
+    uint64_t uoff, ulen;
+    stripe_range(units, s, &uoff, &ulen);
+    if (ulen == 0) continue;
+    const int fd = peer_fds_[peer][s];
+    char* p = data + uoff * esize;
+    const uint64_t len = ulen * esize;
+    w->add(1);
+    pool_->submit([this, fd, p, len, deadline_ms, w] {
+      const int64_t remaining = deadline_ms - now_ms();
+      const bool ok = remaining > 0 && !aborted_.load() &&
+                      read_exact(fd, p, len, remaining);
+      if (ok) bytes_rx_ += len;
+      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
+              "stripe recv failed");
+    });
+  }
+}
+
+namespace {
+
+// Pipelined receive-reduce for one stripe: consume the wire in sub-blocks
+// and fold each into dst while the peer (and the kernel socket buffer)
+// keeps the next sub-block in flight — the "reduce chunk k while chunk k+1
+// is on the wire" half of the double buffer.
+template <typename T>
+bool recv_reduce_stripe(int fd, T* dst, uint64_t elems, int32_t op,
+                        uint64_t block_elems, int64_t deadline_ms,
+                        std::atomic<uint64_t>* bytes_rx) {
+  std::vector<T> scratch(std::min(elems, block_elems));
+  uint64_t done = 0;
+  while (done < elems) {
+    const uint64_t m = std::min(block_elems, elems - done);
+    const int64_t remaining = deadline_ms - now_ms();
+    if (remaining <= 0) return false;
+    if (!read_exact(fd, reinterpret_cast<char*>(scratch.data()),
+                    m * sizeof(T), remaining))
+      return false;
+    *bytes_rx += m * sizeof(T);
+    reduce_into<T>(dst + done, scratch.data(), m, op);
+    done += m;
+  }
+  return true;
+}
+
+}  // namespace
+
+void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
+                                           int32_t dtype, int32_t op,
+                                           int64_t deadline_ms, Waiter* w) {
+  if (count == 0) return;
+  const uint64_t esize = dtype_size(dtype);
+  const uint64_t block_elems =
+      std::max<uint64_t>(1, static_cast<uint64_t>(pipeline_bytes_) / esize);
+  for (int s = 0; s < n_streams_; ++s) {
+    uint64_t uoff, ulen;
+    stripe_range(count, s, &uoff, &ulen);
+    if (ulen == 0) continue;
+    const int fd = peer_fds_[peer][s];
+    w->add(1);
+    pool_->submit([this, fd, dst, uoff, ulen, dtype, op, block_elems,
+                   deadline_ms, w] {
+      bool ok = false;
+      if (!aborted_.load()) {
+        switch (dtype) {
+          case TFT_DT_F32:
+            ok = recv_reduce_stripe<float>(fd, static_cast<float*>(dst) + uoff,
+                                           ulen, op, block_elems, deadline_ms,
+                                           &bytes_rx_);
+            break;
+          case TFT_DT_F64:
+            ok = recv_reduce_stripe<double>(
+                fd, static_cast<double*>(dst) + uoff, ulen, op, block_elems,
+                deadline_ms, &bytes_rx_);
+            break;
+          case TFT_DT_I32:
+            ok = recv_reduce_stripe<int32_t>(
+                fd, static_cast<int32_t*>(dst) + uoff, ulen, op, block_elems,
+                deadline_ms, &bytes_rx_);
+            break;
+          case TFT_DT_I64:
+            ok = recv_reduce_stripe<int64_t>(
+                fd, static_cast<int64_t*>(dst) + uoff, ulen, op, block_elems,
+                deadline_ms, &bytes_rx_);
+            break;
+        }
+      }
+      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
+              "stripe recv-reduce failed");
+    });
+  }
+}
+
+template <typename T>
+bool CollectiveEngine::ring_allreduce_t(T* data, uint64_t count, int32_t dtype,
+                                        int32_t op, int64_t deadline_ms) {
+  const int ws = world_, r = rank_;
+  const int right = (r + 1) % ws;
+  const int left = (r - 1 + ws) % ws;
+  auto coff = [&](int i) { return split_off(count, ws, i); };
+  auto clen = [&](int i) { return split_size(count, ws, i); };
+  auto ring_idx = [&](int i) { return ((i % ws) + ws) % ws; };
+  // Reduce-scatter: after step k, chunk (r - k - 1) holds the partial
+  // reduction of k+2 ranks; after ws-1 steps rank r owns the full reduction
+  // of chunk (r + 1) % ws. Same schedule (and therefore the same
+  // per-element accumulation order) as _ring_allreduce_flat.
+  for (int step = 0; step < ws - 1; ++step) {
+    const int si = ring_idx(r - step);
+    const int ri = ring_idx(r - step - 1);
+    Waiter w;
+    send_stripes(right, reinterpret_cast<const char*>(data + coff(si)),
+                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w);
+    recv_reduce_stripes(left, data + coff(ri), clen(ri), dtype, op,
+                        deadline_ms, &w);
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") + std::string(
+                      "allreduce reduce-scatter step ") +
+                  std::to_string(step) + ": " + w.err);
+  }
+  // Allgather: circulate the fully reduced chunks.
+  for (int step = 0; step < ws - 1; ++step) {
+    const int si = ring_idx(r - step + 1);
+    const int ri = ring_idx(r - step);
+    Waiter w;
+    send_stripes(right, reinterpret_cast<const char*>(data + coff(si)),
+                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w);
+    recv_stripes(left, reinterpret_cast<char*>(data + coff(ri)),
+                 clen(ri) * sizeof(T), sizeof(T), deadline_ms, &w);
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("allreduce allgather step ") +
+                  std::to_string(step) + ": " + w.err);
+  }
+  return true;
+}
+
+bool CollectiveEngine::allreduce(void* data, uint64_t count, int32_t dtype,
+                                 int32_t op, int64_t timeout_ms) {
+  if (world_ <= 1) return true;
+  if (aborted_.load()) return false;
+  if (pool_ == nullptr) return fail("engine not connected");
+  const int64_t deadline = now_ms() + timeout_ms;
+  switch (dtype) {
+    case TFT_DT_F32:
+      return ring_allreduce_t<float>(static_cast<float*>(data), count, dtype,
+                                     op, deadline);
+    case TFT_DT_F64:
+      return ring_allreduce_t<double>(static_cast<double*>(data), count, dtype,
+                                      op, deadline);
+    case TFT_DT_I32:
+      return ring_allreduce_t<int32_t>(static_cast<int32_t*>(data), count,
+                                       dtype, op, deadline);
+    case TFT_DT_I64:
+      return ring_allreduce_t<int64_t>(static_cast<int64_t*>(data), count,
+                                       dtype, op, deadline);
+  }
+  return fail("allreduce: unsupported dtype code " + std::to_string(dtype));
+}
+
+bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
+                                    int64_t timeout_ms) {
+  if (world_ <= 1) return true;
+  if (aborted_.load()) return false;
+  if (pool_ == nullptr) return fail("engine not connected");
+  const int64_t deadline = now_ms() + timeout_ms;
+  const int ws = world_, me = rank_;
+  const uint64_t blocks = (count + kQBlock - 1) / kQBlock;
+
+  // Quantize the full payload exactly once (collectives.py:586).
+  std::vector<int8_t> q(blocks * kQBlock);
+  std::vector<float> scales(blocks);
+  q8_quantize(data, count, blocks, q.data(), scales.data());
+
+  if (blocks < static_cast<uint64_t>(ws)) {
+    // Tiny payload (fewer blocks than ranks): allgather-all fallback, no
+    // chunking — mirrors _quantized_wire_pipeline's blocks < ws branch.
+    std::string payload(reinterpret_cast<const char*>(scales.data()),
+                        blocks * sizeof(float));
+    payload.append(reinterpret_cast<const char*>(q.data()), q.size());
+    if (!allgather("", payload.data(), payload.size(), timeout_ms))
+      return false;
+    std::vector<float> acc(blocks * kQBlock, 0.f);
+    for (int p = 0; p < ws; ++p) {
+      const char* src = p == me ? payload.data() : results_[p].second.data();
+      q8_accumulate(acc.data(),
+                    reinterpret_cast<const int8_t*>(src +
+                                                    blocks * sizeof(float)),
+                    reinterpret_cast<const float*>(src), blocks);
+    }
+    memcpy(data, acc.data(), count * sizeof(float));
+    return true;
+  }
+
+  // Owner chunks: contiguous block-aligned np.array_split over blocks, so
+  // each chunk owns whole scales (collectives.py:543).
+  auto boff = [&](int i) { return split_off(blocks, ws, i); };
+  auto blen = [&](int i) { return split_size(blocks, ws, i); };
+  const uint64_t my_blocks = blen(me);
+
+  // Each direction of each peer exchange must be one contiguous transfer:
+  // two concurrent send_stripes to the same peer would race on the shared
+  // per-stripe fds and interleave bytes. Wire layout per chunk of b blocks:
+  // [b fp32 scales][b * kQBlock int8 codes].
+  auto pack = [](const float* s, const int8_t* qv, uint64_t nb) {
+    std::vector<char> buf(nb * (sizeof(float) + kQBlock));
+    memcpy(buf.data(), s, nb * sizeof(float));
+    memcpy(buf.data() + nb * sizeof(float), qv, nb * kQBlock);
+    return buf;
+  };
+  auto unpack_s = [](const std::vector<char>& buf) {
+    return reinterpret_cast<const float*>(buf.data());
+  };
+  auto unpack_q = [](const std::vector<char>& buf, uint64_t nb) {
+    return reinterpret_cast<const int8_t*>(buf.data() + nb * sizeof(float));
+  };
+
+  // Phase 1: alltoall — send rank p its chunk of my quantized payload,
+  // receive every peer's slice of MY chunk.
+  std::vector<std::vector<char>> out(ws), in(ws);
+  {
+    Waiter w;
+    for (int p = 0; p < ws; ++p) {
+      if (p == me) continue;
+      out[p] = pack(scales.data() + boff(p), q.data() + boff(p) * kQBlock,
+                    blen(p));
+      send_stripes(p, out[p].data(), out[p].size(), 1, deadline, &w);
+      in[p].resize(my_blocks * (sizeof(float) + kQBlock));
+      recv_stripes(p, in[p].data(), in[p].size(), 1, deadline, &w);
+    }
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("q8 alltoall: ") + w.err);
+  }
+
+  // Local fp32 reduce of my chunk, rank order 0..ws-1 (alltoall output
+  // order in _alltoall_chunk_reduce) — cross-replica bitwise identical.
+  std::vector<float> acc(my_blocks * kQBlock, 0.f);
+  for (int p = 0; p < ws; ++p) {
+    const int8_t* src_q = p == me ? q.data() + boff(me) * kQBlock
+                                  : unpack_q(in[p], my_blocks);
+    const float* src_s = p == me ? scales.data() + boff(me) : unpack_s(in[p]);
+    q8_accumulate(acc.data(), src_q, src_s, my_blocks);
+  }
+
+  // Requantize my reduced chunk (the second and final lossy step), then
+  // allgather every rank's chunk.
+  std::vector<int8_t> q2(my_blocks * kQBlock);
+  std::vector<float> s2(my_blocks);
+  q8_quantize(acc.data(), acc.size(), my_blocks, q2.data(), s2.data());
+  const std::vector<char> mine = pack(s2.data(), q2.data(), my_blocks);
+  std::vector<std::vector<char>> gathered(ws);
+  {
+    Waiter w;
+    for (int p = 0; p < ws; ++p) {
+      if (p == me) continue;
+      send_stripes(p, mine.data(), mine.size(), 1, deadline, &w);
+      gathered[p].resize(blen(p) * (sizeof(float) + kQBlock));
+      recv_stripes(p, gathered[p].data(), gathered[p].size(), 1, deadline, &w);
+    }
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("q8 allgather: ") + w.err);
+  }
+
+  // Decode the assembled (q_final, s_final) straight into the caller's
+  // buffer: data[i] = (float)q * scale, trimmed to count.
+  for (int p = 0; p < ws; ++p) {
+    const uint64_t nb = blen(p);
+    const int8_t* fq = p == me ? q2.data() : unpack_q(gathered[p], nb);
+    const float* fs = p == me ? s2.data() : unpack_s(gathered[p]);
+    const uint64_t lo = boff(p) * kQBlock;
+    for (uint64_t b = 0; b < nb; ++b) {
+      const float s = fs[b];
+      for (uint64_t j = 0; j < kQBlock; ++j) {
+        const uint64_t idx = lo + b * kQBlock + j;
+        if (idx >= count) break;
+        data[idx] = static_cast<float>(fq[b * kQBlock + j]) * s;
+      }
+    }
+  }
+  return true;
+}
+
+bool CollectiveEngine::allgather(const std::string& meta, const void* data,
+                                 uint64_t nbytes, int64_t timeout_ms) {
+  for (auto& r : results_) r = {};
+  if (world_ <= 1) return true;
+  if (aborted_.load()) return false;
+  if (pool_ == nullptr) return fail("engine not connected");
+  const int64_t deadline = now_ms() + timeout_ms;
+  // Phase A: fixed-size headers + meta on stripe 0 of every peer link. The
+  // barrier before phase B guarantees the header precedes stripe-0 payload
+  // bytes on the same socket, and that every receive buffer is sized.
+  char hdr[12];
+  const uint32_t mlen = static_cast<uint32_t>(meta.size());
+  memcpy(hdr, &mlen, 4);
+  memcpy(hdr + 4, &nbytes, 8);
+  std::string hdr_full(hdr, 12);
+  hdr_full += meta;
+  {
+    Waiter w;
+    for (int p = 0; p < world_; ++p) {
+      if (p == rank_) continue;
+      const int fd0 = peer_fds_[p][0];
+      w.add(2);
+      pool_->submit([this, fd0, &hdr_full, deadline, w_ptr = &w] {
+        const int64_t remaining = deadline - now_ms();
+        const bool ok = remaining > 0 && !aborted_.load() &&
+                        write_all(fd0, hdr_full.data(), hdr_full.size(),
+                                  remaining);
+        if (ok) bytes_tx_ += hdr_full.size();
+        w_ptr->done(ok, !ok && now_ms() >= deadline && !aborted_.load(),
+                    "allgather header send failed");
+      });
+      pool_->submit([this, p, fd0, deadline, w_ptr = &w] {
+        char h[12];
+        int64_t remaining = deadline - now_ms();
+        bool ok = remaining > 0 && !aborted_.load() &&
+                  read_exact(fd0, h, 12, remaining);
+        uint32_t peer_mlen = 0;
+        uint64_t peer_nbytes = 0;
+        if (ok) {
+          memcpy(&peer_mlen, h, 4);
+          memcpy(&peer_nbytes, h + 4, 8);
+          ok = peer_mlen <= (64u << 20) && peer_nbytes <= (1ull << 40);
+        }
+        if (ok && peer_mlen > 0) {
+          results_[p].first.resize(peer_mlen);
+          remaining = deadline - now_ms();
+          ok = remaining > 0 &&
+               read_exact(fd0, &results_[p].first[0], peer_mlen, remaining);
+        }
+        if (ok) {
+          results_[p].second.resize(peer_nbytes);
+          bytes_rx_ += 12 + peer_mlen;
+        }
+        w_ptr->done(ok, !ok && now_ms() >= deadline && !aborted_.load(),
+                    "allgather header recv failed");
+      });
+    }
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("allgather headers: ") + w.err);
+  }
+  // Phase B: striped payloads, all peers in full flight.
+  {
+    Waiter w;
+    for (int p = 0; p < world_; ++p) {
+      if (p == rank_) continue;
+      send_stripes(p, static_cast<const char*>(data), nbytes, 1, deadline,
+                   &w);
+      recv_stripes(p, results_[p].second.empty() ? nullptr
+                                                 : &results_[p].second[0],
+                   results_[p].second.size(), 1, deadline, &w);
+    }
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("allgather payloads: ") + w.err);
+  }
+  return true;
+}
+
+bool CollectiveEngine::broadcast(const std::string& meta, const void* data,
+                                 uint64_t nbytes, int root,
+                                 int64_t timeout_ms) {
+  for (auto& r : results_) r = {};
+  if (world_ <= 1) return true;
+  if (aborted_.load()) return false;
+  if (pool_ == nullptr) return fail("engine not connected");
+  if (root < 0 || root >= world_)
+    return fail("broadcast: bad root " + std::to_string(root));
+  const int64_t deadline = now_ms() + timeout_ms;
+  if (rank_ == root) {
+    char hdr[12];
+    const uint32_t mlen = static_cast<uint32_t>(meta.size());
+    const uint64_t pn = nbytes;
+    memcpy(hdr, &mlen, 4);
+    memcpy(hdr + 4, &pn, 8);
+    std::string hdr_full(hdr, 12);
+    hdr_full += meta;
+    {
+      // Headers first (barrier keeps them ahead of stripe-0 payload).
+      Waiter w;
+      for (int p = 0; p < world_; ++p) {
+        if (p == rank_) continue;
+        const int fd0 = peer_fds_[p][0];
+        w.add(1);
+        pool_->submit([this, fd0, &hdr_full, deadline, w_ptr = &w] {
+          const int64_t remaining = deadline - now_ms();
+          const bool ok = remaining > 0 && !aborted_.load() &&
+                          write_all(fd0, hdr_full.data(), hdr_full.size(),
+                                    remaining);
+          if (ok) bytes_tx_ += hdr_full.size();
+          w_ptr->done(ok, !ok && now_ms() >= deadline && !aborted_.load(),
+                      "broadcast header send failed");
+        });
+      }
+      if (!w.wait_all())
+        return fail((w.timed_out ? "timeout: " : "") +
+                    std::string("broadcast headers: ") + w.err);
+    }
+    Waiter w;
+    for (int p = 0; p < world_; ++p) {
+      if (p == rank_) continue;
+      send_stripes(p, static_cast<const char*>(data), nbytes, 1, deadline,
+                   &w);
+    }
+    if (!w.wait_all())
+      return fail((w.timed_out ? "timeout: " : "") +
+                  std::string("broadcast payload: ") + w.err);
+    return true;
+  }
+  // Non-root: header from root on stripe 0 (caller thread), then striped
+  // payload into the result slot.
+  const int fd0 = peer_fds_[root][0];
+  char h[12];
+  int64_t remaining = deadline - now_ms();
+  if (remaining <= 0 || !read_exact(fd0, h, 12, remaining))
+    return fail(now_ms() >= deadline && !aborted_.load()
+                    ? "timeout: broadcast header"
+                    : "broadcast header recv failed");
+  uint32_t peer_mlen = 0;
+  uint64_t peer_nbytes = 0;
+  memcpy(&peer_mlen, h, 4);
+  memcpy(&peer_nbytes, h + 4, 8);
+  if (peer_mlen > (64u << 20) || peer_nbytes > (1ull << 40))
+    return fail("broadcast: implausible header");
+  if (peer_mlen > 0) {
+    results_[root].first.resize(peer_mlen);
+    remaining = deadline - now_ms();
+    if (remaining <= 0 ||
+        !read_exact(fd0, &results_[root].first[0], peer_mlen, remaining))
+      return fail("broadcast meta recv failed");
+  }
+  bytes_rx_ += 12 + peer_mlen;
+  results_[root].second.resize(peer_nbytes);
+  Waiter w;
+  recv_stripes(root,
+               results_[root].second.empty() ? nullptr
+                                             : &results_[root].second[0],
+               peer_nbytes, 1, deadline, &w);
+  if (!w.wait_all())
+    return fail((w.timed_out ? "timeout: " : "") +
+                std::string("broadcast payload: ") + w.err);
+  return true;
+}
+
+}  // namespace tft
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+namespace {
+
+tft::CollectiveEngine* eng(void* h) {
+  return static_cast<tft::CollectiveEngine*>(h);
+}
+
+int32_t rc_for(tft::CollectiveEngine* e, bool ok) {
+  if (ok) return 0;
+  return e->last_error().rfind("timeout", 0) == 0 ? 2 : 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes) {
+  return new tft::CollectiveEngine(n_streams, pipeline_bytes);
+}
+
+void tft_coll_destroy(void* h) { delete eng(h); }
+
+int32_t tft_coll_listen(void* h, const char* host) {
+  return eng(h)->listen(host ? host : "");
+}
+
+int32_t tft_coll_connect(void* h, int32_t rank, int32_t world,
+                         const char* peers_json, int64_t timeout_ms) {
+  tft::Json peers;
+  std::vector<std::string> addrs;
+  if (peers_json && tft::Json::parse(peers_json, &peers) &&
+      peers.is_array()) {
+    for (const auto& p : peers.arr) addrs.push_back(p.as_str());
+  }
+  return rc_for(eng(h),
+                eng(h)->connect_mesh(rank, world, addrs, timeout_ms));
+}
+
+void tft_coll_abort(void* h, const char* why) {
+  eng(h)->abort(why ? why : "abort");
+}
+
+int32_t tft_coll_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
+                           int32_t op, int64_t timeout_ms) {
+  return rc_for(eng(h), eng(h)->allreduce(data, count, dtype, op, timeout_ms));
+}
+
+int32_t tft_coll_allreduce_q8(void* h, float* data, uint64_t count,
+                              int64_t timeout_ms) {
+  return rc_for(eng(h), eng(h)->allreduce_q8(data, count, timeout_ms));
+}
+
+int32_t tft_coll_allgather(void* h, const char* meta, const void* data,
+                           uint64_t nbytes, int64_t timeout_ms) {
+  return rc_for(eng(h), eng(h)->allgather(meta ? meta : "", data, nbytes,
+                                          timeout_ms));
+}
+
+int32_t tft_coll_broadcast(void* h, const char* meta, const void* data,
+                           uint64_t nbytes, int32_t root, int64_t timeout_ms) {
+  return rc_for(eng(h), eng(h)->broadcast(meta ? meta : "", data, nbytes,
+                                          root, timeout_ms));
+}
+
+int64_t tft_coll_result_meta_len(void* h, int32_t slot) {
+  auto* e = eng(h);
+  if (slot < 0 || slot >= e->world()) return -1;
+  return static_cast<int64_t>(e->result_meta(slot).size());
+}
+
+int32_t tft_coll_result_meta(void* h, int32_t slot, char* out, int64_t cap) {
+  auto* e = eng(h);
+  if (slot < 0 || slot >= e->world()) return 1;
+  const std::string& m = e->result_meta(slot);
+  if (static_cast<int64_t>(m.size()) > cap) return 1;
+  memcpy(out, m.data(), m.size());
+  return 0;
+}
+
+int64_t tft_coll_result_size(void* h, int32_t slot) {
+  auto* e = eng(h);
+  if (slot < 0 || slot >= e->world()) return -1;
+  return static_cast<int64_t>(e->result_payload(slot).size());
+}
+
+int32_t tft_coll_result_copy(void* h, int32_t slot, void* out, int64_t cap) {
+  auto* e = eng(h);
+  if (slot < 0 || slot >= e->world()) return 1;
+  const std::string& p = e->result_payload(slot);
+  if (static_cast<int64_t>(p.size()) > cap) return 1;
+  memcpy(out, p.data(), p.size());
+  return 0;
+}
+
+uint64_t tft_coll_bytes_tx(void* h) { return eng(h)->bytes_tx(); }
+uint64_t tft_coll_bytes_rx(void* h) { return eng(h)->bytes_rx(); }
+
+void tft_coll_last_error(void* h, char* out, int64_t cap) {
+  if (cap <= 0) return;
+  const std::string e = eng(h)->last_error();
+  const int64_t n = std::min<int64_t>(cap - 1, e.size());
+  memcpy(out, e.data(), n);
+  out[n] = '\0';
+}
+
+}  // extern "C"

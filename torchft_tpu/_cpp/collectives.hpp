@@ -1,0 +1,196 @@
+// Native DCN data plane: a C++ pipelined collective engine.
+//
+// The fault-tolerant replica axis moves every gradient byte host-side over
+// DCN TCP. ProcessGroupSocket drives that ring from Python — one connection
+// per peer, one chunk in flight, the interpreter on the copy path — which
+// caps throughput far below the NIC. This engine is the native data plane
+// behind ProcessGroupNative (process_group.py): the same framed-TCP net
+// layer underneath (net.hpp), but
+//
+//  - multi-connection striping: n_streams sockets per peer, each carrying a
+//    contiguous slice of every transfer, so one TCP window / one core never
+//    bounds a transfer;
+//  - chunked ring allreduce with pipelined receive-reduce: each stripe
+//    reader consumes the wire in pipeline_bytes sub-blocks and reduces
+//    sub-block k into the destination while k+1 is still in flight (the
+//    kernel socket buffer is the second half of the double buffer);
+//  - optional int8 blockwise wire compression (allreduce_q8) that
+//    round-trips through the exact quantize_blockwise layout of
+//    torchft_tpu/collectives.py + ops/quantization.py: BLOCK=512 values per
+//    float32 scale, scale = absmax/127 (1.0 for all-zero blocks),
+//    round-half-even, clip to ±127 — quantize once, alltoall owner chunks,
+//    fp32 local reduce, requantize, allgather, so every rank decodes the
+//    same bytes and results stay cross-replica bitwise identical;
+//  - ragged allgather / broadcast carrying an opaque metadata string per
+//    payload (the Python side stores dtype/shape there; the engine only
+//    relays it).
+//
+// Numerics: the fp32/f64/i32/i64 ring uses np.array_split chunking and the
+// same per-element accumulation (dst = dst OP incoming, left-neighbor
+// contributions in ring order) as ProcessGroupSocket._ring_allreduce_flat,
+// so uncompressed results are bitwise identical to the socket backend.
+//
+// Exposed to Python through the C ABI at the bottom (ctypes over
+// libtftcollectives.so, see torchft_tpu/_native.py). One collective at a
+// time per engine (the Python PG already serializes ops on one executor
+// thread); abort() may be called concurrently from any thread and shuts
+// down every socket so blocked calls fail fast instead of timing out.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tft {
+
+// Codes shared with the ctypes bindings (_native.py). Keep in sync.
+enum : int32_t {
+  TFT_DT_F32 = 0,
+  TFT_DT_F64 = 1,
+  TFT_DT_I32 = 2,
+  TFT_DT_I64 = 3,
+};
+enum : int32_t {
+  TFT_OP_SUM = 0,
+  TFT_OP_MAX = 1,
+  TFT_OP_MIN = 2,
+};
+
+// Fixed-size worker pool for concurrent striped send/recv jobs. Sized so
+// every stripe to and from every peer can progress at once — a smaller pool
+// could fill up with blocked senders and deadlock the mesh.
+class TaskPool {
+ public:
+  explicit TaskPool(int n_threads);
+  ~TaskPool();
+  void submit(std::function<void()> fn);
+
+ private:
+  void worker();
+  std::vector<std::thread> threads_;
+  std::queue<std::function<void()>> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+class CollectiveEngine {
+ public:
+  CollectiveEngine(int n_streams, int64_t pipeline_bytes);
+  ~CollectiveEngine();
+
+  // Binds the data-plane listener. Returns the port, or -1 (last_error set).
+  int listen(const std::string& host);
+  // Full-mesh rendezvous: connect n_streams sockets to every lower rank,
+  // accept n_streams from every higher rank. peers[i] is rank i's
+  // "host:port" (peers[rank] ignored). False on failure.
+  bool connect_mesh(int rank, int world, const std::vector<std::string>& peers,
+                    int64_t timeout_ms);
+  // Shuts down every socket (listener included). Safe from any thread while
+  // a collective is blocked; that collective returns an error promptly.
+  void abort(const std::string& why);
+
+  // In-place ring allreduce over `count` elements of `dtype`. AVG is the
+  // caller's job (SUM then divide), matching ProcessGroupSocket.
+  bool allreduce(void* data, uint64_t count, int32_t dtype, int32_t op,
+                 int64_t timeout_ms);
+  // In-place int8-compressed fp32 SUM allreduce (blockwise layout above).
+  bool allreduce_q8(float* data, uint64_t count, int64_t timeout_ms);
+  // Ragged allgather of (meta, payload); results land in slots [0, world).
+  bool allgather(const std::string& meta, const void* data, uint64_t nbytes,
+                 int64_t timeout_ms);
+  // Broadcast from root; non-root ranks find (meta, payload) in slot `root`.
+  bool broadcast(const std::string& meta, const void* data, uint64_t nbytes,
+                 int root, int64_t timeout_ms);
+
+  const std::string& result_meta(int slot) const { return results_[slot].first; }
+  const std::string& result_payload(int slot) const {
+    return results_[slot].second;
+  }
+  int world() const { return world_; }
+  int port() const { return port_; }
+  uint64_t bytes_tx() const { return bytes_tx_.load(); }
+  uint64_t bytes_rx() const { return bytes_rx_.load(); }
+  std::string last_error() const;
+
+ private:
+  struct Waiter;
+
+  void set_error(const std::string& msg);
+  bool fail(const std::string& msg);  // set_error + return false
+  void close_all();
+
+  // Contiguous slice of [0, units) carried by stripe s (deterministic on
+  // both ends: base + 1 spare unit for the first units % n_streams stripes).
+  void stripe_range(uint64_t units, int s, uint64_t* off, uint64_t* len) const;
+
+  // Enqueue striped transfer jobs against `peer`; each job reports into *w.
+  // `esize` keeps stripe boundaries on element boundaries (both ends must
+  // pass the same esize or the slices would interleave mid-element).
+  void send_stripes(int peer, const char* data, uint64_t nbytes,
+                    uint64_t esize, int64_t deadline_ms, Waiter* w);
+  void recv_stripes(int peer, char* data, uint64_t nbytes, uint64_t esize,
+                    int64_t deadline_ms, Waiter* w);
+  // Striped receive that reduces into dst in pipeline_bytes sub-blocks
+  // (dst[i] = dst[i] OP incoming[i]) instead of storing raw bytes.
+  void recv_reduce_stripes(int peer, void* dst, uint64_t count, int32_t dtype,
+                           int32_t op, int64_t deadline_ms, Waiter* w);
+
+  template <typename T>
+  bool ring_allreduce_t(T* data, uint64_t count, int32_t dtype, int32_t op,
+                        int64_t deadline_ms);
+
+  int n_streams_;
+  int64_t pipeline_bytes_;
+  int rank_ = -1;
+  int world_ = 0;
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::vector<std::vector<int>> peer_fds_;  // [peer][stripe]; self empty
+  std::unique_ptr<TaskPool> pool_;
+  std::vector<std::pair<std::string, std::string>> results_;  // meta, payload
+  std::atomic<bool> aborted_{false};
+  std::atomic<uint64_t> bytes_tx_{0};
+  std::atomic<uint64_t> bytes_rx_{0};
+  mutable std::mutex err_mu_;
+  std::string last_error_;
+};
+
+}  // namespace tft
+
+// ---------------------------------------------------------------------------
+// C ABI for the ctypes bindings (torchft_tpu/_native.py). Return codes:
+// 0 = ok, 1 = error (see tft_coll_last_error), 2 = timeout.
+// ---------------------------------------------------------------------------
+extern "C" {
+void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes);
+void tft_coll_destroy(void* h);
+int32_t tft_coll_listen(void* h, const char* host);  // port or -1
+// peers_json: JSON array of "host:port", one per rank (self ignored).
+int32_t tft_coll_connect(void* h, int32_t rank, int32_t world,
+                         const char* peers_json, int64_t timeout_ms);
+void tft_coll_abort(void* h, const char* why);
+int32_t tft_coll_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
+                           int32_t op, int64_t timeout_ms);
+int32_t tft_coll_allreduce_q8(void* h, float* data, uint64_t count,
+                              int64_t timeout_ms);
+int32_t tft_coll_allgather(void* h, const char* meta, const void* data,
+                           uint64_t nbytes, int64_t timeout_ms);
+int32_t tft_coll_broadcast(void* h, const char* meta, const void* data,
+                           uint64_t nbytes, int32_t root, int64_t timeout_ms);
+int64_t tft_coll_result_meta_len(void* h, int32_t slot);
+int32_t tft_coll_result_meta(void* h, int32_t slot, char* out, int64_t cap);
+int64_t tft_coll_result_size(void* h, int32_t slot);
+int32_t tft_coll_result_copy(void* h, int32_t slot, void* out, int64_t cap);
+uint64_t tft_coll_bytes_tx(void* h);
+uint64_t tft_coll_bytes_rx(void* h);
+// Copies the last error into out (NUL-terminated, truncated to cap).
+void tft_coll_last_error(void* h, char* out, int64_t cap);
+}

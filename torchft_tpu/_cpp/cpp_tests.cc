@@ -5,9 +5,15 @@
 // should_commit barrier with concurrent clients, and heal planning.
 #include <unistd.h>
 
+#include <algorithm>
+#include <cmath>
 #include <cstdio>
+#include <cstring>
+#include <memory>
 #include <thread>
+#include <vector>
 
+#include "collectives.hpp"
 #include "json.hpp"
 #include "lighthouse.hpp"
 #include "manager_server.hpp"
@@ -780,6 +786,272 @@ static void test_split_host_port() {
   CHECK(!split_host_port("http://", &host, &port));
 }
 
+static void test_drain_all_reaches_heartbeat_only_replica() {
+  // The drain_all blind spot: a replica that heartbeats but never
+  // registered a quorum appears in neither prev_quorum nor participants.
+  // Heartbeats now carry the manager address, so drain_all reaches it.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 200;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 60000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+
+  auto mk = [&](const std::string& id) {
+    ManagerOpts mo;
+    mo.replica_id = id;
+    mo.lighthouse_addr = lh.address();
+    mo.store_address = "store-x";
+    mo.world_size = 1;
+    mo.heartbeat_interval_ms = 50;
+    return new ManagerServer(mo);
+  };
+  ManagerServer* registered = mk("hb-registered");
+  CHECK(registered->start());
+
+  // Register one replica through a quorum round first (so the split-brain
+  // guard doesn't count the unregistered heartbeat against it)...
+  Json req = Json::object();
+  req["type"] = Json::of("quorum");
+  req["group_rank"] = Json::of(int64_t(0));
+  req["step"] = Json::of(int64_t(1));
+  req["checkpoint_metadata"] = Json::of(std::string("meta"));
+  req["init_sync"] = Json::of(false);
+  req["timeout_ms"] = Json::of(int64_t(8000));
+  Json qresp = lighthouse_call(registered->address(), req, 9000);
+  CHECK(qresp.get("ok").as_bool());
+
+  // ...then bring up a second that only heartbeats (a trainer wedged before
+  // its first quorum RPC).
+  ManagerServer* hb_only = mk("hb-only");
+  CHECK(hb_only->start());
+  sleep_ms(300);  // several heartbeat intervals for hb-only
+
+  Json dreq = Json::object();
+  dreq["type"] = Json::of("drain_all");
+  Json dresp = lighthouse_call(lh.address(), dreq, 8000);
+  CHECK(dresp.get("ok").as_bool());
+  CHECK_EQ(dresp.get("n_members").as_int(), 2);
+  CHECK(dresp.get("sent").get("hb-registered").as_bool());
+  CHECK(dresp.get("sent").get("hb-only").as_bool());
+
+  // The heartbeat-only replica actually observed the drain request.
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("drain_status");
+  Json sresp = lighthouse_call(hb_only->address(), sreq, 3000);
+  CHECK(sresp.get("ok").as_bool());
+  CHECK(sresp.get("drain_requested").as_bool());
+
+  registered->stop();
+  hb_only->stop();
+  delete registered;
+  delete hb_only;
+  lh.stop();
+}
+
+// --------------------------------------------------------------------------
+// Native collective engine (collectives.cc)
+// --------------------------------------------------------------------------
+
+static std::vector<std::unique_ptr<CollectiveEngine>> engine_mesh(
+    int ws, int streams, int64_t pipeline_bytes = 1 << 20) {
+  std::vector<std::unique_ptr<CollectiveEngine>> es;
+  std::vector<std::string> addrs(ws);
+  for (int i = 0; i < ws; ++i) {
+    es.push_back(std::make_unique<CollectiveEngine>(streams, pipeline_bytes));
+    int p = es[i]->listen("127.0.0.1");
+    CHECK(p > 0);
+    addrs[i] = "127.0.0.1:" + std::to_string(p);
+  }
+  std::vector<int> oks(ws, 0);
+  std::vector<std::thread> ts;
+  for (int i = 0; i < ws; ++i)
+    ts.emplace_back([&, i] { oks[i] = es[i]->connect_mesh(i, ws, addrs, 8000); });
+  for (auto& t : ts) t.join();
+  for (int i = 0; i < ws; ++i) CHECK(oks[i]);
+  return es;
+}
+
+static void test_native_ring_allreduce() {
+  const int ws = 3;
+  auto es = engine_mesh(ws, 2);
+  // fp32 SUM over a count not divisible by ws or the stripe count; values
+  // are small integers so the float sums are exact.
+  const uint64_t n = 1000 + 7;
+  std::vector<std::vector<float>> bufs(ws);
+  for (int r = 0; r < ws; ++r) {
+    bufs[r].resize(n);
+    for (uint64_t i = 0; i < n; ++i)
+      bufs[r][i] = static_cast<float>((r + 1) * static_cast<int>(i % 100));
+  }
+  std::vector<int> oks(ws, 0);
+  std::vector<std::thread> ts;
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back([&, r] {
+      oks[r] = es[r]->allreduce(bufs[r].data(), n, TFT_DT_F32, TFT_OP_SUM,
+                                8000);
+    });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  bool all_ok = true;
+  for (int r = 0; r < ws; ++r)
+    for (uint64_t i = 0; i < n; ++i)
+      all_ok = all_ok &&
+               bufs[r][i] == static_cast<float>(6 * static_cast<int>(i % 100));
+  CHECK(all_ok);
+  CHECK(es[0]->bytes_tx() > 0);
+  CHECK(es[0]->bytes_rx() > 0);
+
+  // i64 MAX.
+  std::vector<std::vector<int64_t>> ib(ws);
+  const uint64_t m = 97;
+  for (int r = 0; r < ws; ++r) {
+    ib[r].resize(m);
+    for (uint64_t i = 0; i < m; ++i)
+      ib[r][i] = static_cast<int64_t>(i) * (r == 1 ? -1 : 1) + r;
+  }
+  ts.clear();
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back([&, r] {
+      oks[r] = es[r]->allreduce(ib[r].data(), m, TFT_DT_I64, TFT_OP_MAX, 8000);
+    });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  bool max_ok = true;
+  for (uint64_t i = 0; i < m; ++i) {
+    int64_t want = std::max<int64_t>(
+        {static_cast<int64_t>(i), -static_cast<int64_t>(i) + 1,
+         static_cast<int64_t>(i) + 2});
+    for (int r = 0; r < ws; ++r) max_ok = max_ok && ib[r][i] == want;
+  }
+  CHECK(max_ok);
+}
+
+static void test_native_q8_allreduce() {
+  const int ws = 2;
+  auto es = engine_mesh(ws, 2);
+  // Big enough for the chunked path (blocks >= ws) and a ragged tail.
+  const uint64_t n = 512 * 6 + 13;
+  std::vector<std::vector<float>> bufs(ws), orig(ws);
+  for (int r = 0; r < ws; ++r) {
+    bufs[r].resize(n);
+    for (uint64_t i = 0; i < n; ++i)
+      bufs[r][i] = 0.01f * static_cast<float>((i * (r + 3)) % 257) -
+                   1.2f * static_cast<float>(r);
+    orig[r] = bufs[r];
+  }
+  std::vector<int> oks(ws, 0);
+  std::vector<std::thread> ts;
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back(
+        [&, r] { oks[r] = es[r]->allreduce_q8(bufs[r].data(), n, 8000); });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  // Cross-rank bitwise identical (everyone decodes the same bytes).
+  CHECK(memcmp(bufs[0].data(), bufs[1].data(), n * sizeof(float)) == 0);
+  // Within quantization tolerance of the true fp32 sum: two lossy steps,
+  // each bounded by half a quantization step of its block absmax.
+  bool tol_ok = true;
+  for (uint64_t i = 0; i < n; ++i) {
+    const float want = orig[0][i] + orig[1][i];
+    tol_ok = tol_ok && std::abs(bufs[0][i] - want) < 0.08f;
+  }
+  CHECK(tol_ok);
+
+  // Tiny payload (blocks < ws): allgather fallback, exact fp32 sum path
+  // still within one quantize round trip of truth.
+  const uint64_t tiny = 40;
+  std::vector<std::vector<float>> tb(ws);
+  for (int r = 0; r < ws; ++r) {
+    tb[r].resize(tiny);
+    for (uint64_t i = 0; i < tiny; ++i)
+      tb[r][i] = static_cast<float>(r + 1) * 0.25f * static_cast<float>(i);
+  }
+  ts.clear();
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back(
+        [&, r] { oks[r] = es[r]->allreduce_q8(tb[r].data(), tiny, 8000); });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  CHECK(memcmp(tb[0].data(), tb[1].data(), tiny * sizeof(float)) == 0);
+  // One quantization per input, no requantize on this path: error bound is
+  // one half-step of each rank's block absmax (~19.5/127/2 each).
+  bool tiny_ok = true;
+  for (uint64_t i = 0; i < tiny; ++i) {
+    const float want = 3.f * 0.25f * static_cast<float>(i);
+    tiny_ok = tiny_ok && std::abs(tb[0][i] - want) < 0.2f;
+  }
+  CHECK(tiny_ok);
+}
+
+static void test_native_allgather_broadcast() {
+  const int ws = 3;
+  auto es = engine_mesh(ws, 2);
+  // Ragged allgather with opaque metadata.
+  std::vector<std::string> payloads(ws), metas(ws);
+  for (int r = 0; r < ws; ++r) {
+    payloads[r] = std::string(100 + 37 * r, static_cast<char>('a' + r));
+    metas[r] = "{\"rank\":" + std::to_string(r) + "}";
+  }
+  std::vector<int> oks(ws, 0);
+  std::vector<std::thread> ts;
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back([&, r] {
+      oks[r] = es[r]->allgather(metas[r], payloads[r].data(),
+                                payloads[r].size(), 8000);
+    });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  bool ag_ok = true;
+  for (int r = 0; r < ws; ++r)
+    for (int p = 0; p < ws; ++p) {
+      if (p == r) continue;  // own slot is the caller's job
+      ag_ok = ag_ok && es[r]->result_meta(p) == metas[p] &&
+              es[r]->result_payload(p) == payloads[p];
+    }
+  CHECK(ag_ok);
+
+  // Broadcast from a non-zero root.
+  const int root = 1;
+  std::string blob(4096 + 11, 'x');
+  ts.clear();
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back([&, r] {
+      if (r == root)
+        oks[r] = es[r]->broadcast("bmeta", blob.data(), blob.size(), root,
+                                  8000);
+      else
+        oks[r] = es[r]->broadcast("", nullptr, 0, root, 8000);
+    });
+  for (auto& t : ts) t.join();
+  for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  for (int r = 0; r < ws; ++r) {
+    if (r == root) continue;
+    CHECK(es[r]->result_meta(root) == std::string("bmeta"));
+    CHECK(es[r]->result_payload(root) == blob);
+  }
+}
+
+static void test_native_abort_unblocks() {
+  const int ws = 2;
+  auto es = engine_mesh(ws, 2);
+  // Rank 0 enters an allreduce alone; rank 1 never joins. Abort must
+  // unblock it promptly (the socket-PG abort semantics, not a timeout).
+  std::vector<float> buf(4096, 1.f);
+  std::thread killer([&] {
+    sleep_ms(200);
+    es[0]->abort("test abort");
+  });
+  const int64_t t0 = now_ms();
+  bool ok = es[0]->allreduce(buf.data(), buf.size(), TFT_DT_F32, TFT_OP_SUM,
+                             60 * 1000);
+  killer.join();
+  CHECK(!ok);
+  CHECK(now_ms() - t0 < 5000);  // did not wait out the 60s timeout
+  CHECK(es[0]->last_error().find("aborted") != std::string::npos);
+}
+
 int main() {
   test_split_host_port();
   test_json();
@@ -797,8 +1069,13 @@ int main() {
   test_manager_leave();
   test_operator_drain_request();
   test_operator_drain_all();
+  test_drain_all_reaches_heartbeat_only_replica();
   test_lighthouse_quorum_timeout();
   test_manager_e2e();
+  test_native_ring_allreduce();
+  test_native_q8_allreduce();
+  test_native_allgather_broadcast();
+  test_native_abort_unblocks();
   fprintf(stderr, "%d checks, %d failures\n", g_checks, g_failures);
   return g_failures == 0 ? 0 : 1;
 }

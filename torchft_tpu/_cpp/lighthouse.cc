@@ -176,8 +176,13 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     // A drained replica's manager may have one heartbeat in flight when its
     // leave lands; the tombstone keeps it from resurrecting the entry (which
     // would stall the survivors' next quorum until heartbeat expiry).
-    if (!state_.left.count(replica_id))
+    if (!state_.left.count(replica_id)) {
       state_.heartbeats[replica_id] = now_ms();
+      // Heartbeats carry the manager address so drain_all can reach a
+      // replica that heartbeats but never registered a quorum.
+      const std::string addr = req.get("address").as_str();
+      if (!addr.empty()) state_.heartbeat_addrs[replica_id] = addr;
+    }
     resp["ok"] = Json::of(true);
     return resp;
   }
@@ -191,6 +196,7 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     {
       std::lock_guard<std::mutex> lk(mu_);
       state_.heartbeats.erase(replica_id);
+      state_.heartbeat_addrs.erase(replica_id);
       state_.participants.erase(replica_id);
       state_.left.insert(replica_id);
     }
@@ -272,6 +278,13 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
       }
       for (const auto& kv : state_.participants)
         members[kv.first] = kv.second.first.address;
+      // Heartbeat-only replicas (heartbeating but never registered a
+      // quorum) were a drain_all blind spot: they appear in neither
+      // prev_quorum nor participants. Their heartbeat-carried addresses
+      // close it; registered addresses win when both exist.
+      for (const auto& kv : state_.heartbeat_addrs)
+        if (!members.count(kv.first) && !state_.left.count(kv.first))
+          members[kv.first] = kv.second;
     }
     Json sent = Json::object();
     int n_sent = 0;

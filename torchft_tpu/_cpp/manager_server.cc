@@ -81,6 +81,9 @@ void ManagerServer::heartbeat_loop() {
       Json req = Json::object();
       req["type"] = Json::of("heartbeat");
       req["replica_id"] = Json::of(opts_.replica_id);
+      // Carry our address: lets the lighthouse drain_all reach us even if
+      // we never managed to register a quorum (drain_all blind spot).
+      req["address"] = Json::of(address());
       Json resp;
       if (!call_json(fd, req, &resp, 5000)) {
         close(fd);

@@ -198,10 +198,15 @@ bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
   int64_t deadline = now_ms() + timeout_ms;
   size_t off = 0;
   while (off < len) {
-    if (!wait_fd(fd, POLLOUT, deadline)) return false;
-    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
+    // Optimistic fast path: MSG_DONTWAIT keeps the call non-blocking on a
+    // blocking fd, so we only pay a poll() when the socket buffer is full.
+    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (n < 0) {
-      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (!wait_fd(fd, POLLOUT, deadline)) return false;
+        continue;
+      }
       return false;
     }
     off += static_cast<size_t>(n);
@@ -212,16 +217,23 @@ bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
 static bool read_all(int fd, char* data, size_t len, int64_t deadline) {
   size_t off = 0;
   while (off < len) {
-    if (!wait_fd(fd, POLLIN, deadline)) return false;
-    ssize_t n = recv(fd, data + off, len - off, 0);
+    ssize_t n = recv(fd, data + off, len - off, MSG_DONTWAIT);
     if (n < 0) {
-      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (!wait_fd(fd, POLLIN, deadline)) return false;
+        continue;
+      }
       return false;
     }
     if (n == 0) return false;  // peer closed
     off += static_cast<size_t>(n);
   }
   return true;
+}
+
+bool read_exact(int fd, char* data, size_t len, int64_t timeout_ms) {
+  return read_all(fd, data, len, now_ms() + timeout_ms);
 }
 
 bool send_frame(int fd, const std::string& payload, int64_t timeout_ms) {

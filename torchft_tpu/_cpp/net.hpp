@@ -77,4 +77,10 @@ std::string read_http_request(int fd, int timeout_ms);
 // Writes all bytes. Returns false on error.
 bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms);
 
+// Reads exactly `len` bytes (raw, no framing). Returns false on
+// error/timeout/peer close. The bulk-transfer twin of write_all, used by the
+// collective engine for striped tensor payloads whose sizes both sides
+// already know (no per-chunk frame header on the hot path).
+bool read_exact(int fd, char* data, size_t len, int64_t timeout_ms);
+
 }  // namespace tft

@@ -58,6 +58,11 @@ struct LighthouseState {
   std::map<std::string, std::pair<QuorumMember, int64_t>> participants;
   // replica_id -> last heartbeat ms
   std::map<std::string, int64_t> heartbeats;
+  // replica_id -> manager address carried by heartbeat messages. A replica
+  // that heartbeats but never registered a quorum (e.g. wedged before its
+  // first quorum RPC) is invisible in participants/prev_quorum; this map is
+  // what lets an operator drain_all still reach it.
+  std::map<std::string, std::string> heartbeat_addrs;
   // Replicas that drained via a graceful "leave": a tombstone so a heartbeat
   // already in flight when the leave landed can't resurrect the entry and
   // stall the survivors' next quorum on heartbeat expiry. Cleared when the

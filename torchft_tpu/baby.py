@@ -116,9 +116,11 @@ def _baby_worker(
     """Child main: configure a real socket PG, then replay ops from the
     command pipe in issue order (reference worker loop:
     process_group.py:1441-1605). Runs until "exit" or SIGKILL."""
-    from torchft_tpu.process_group import ProcessGroupSocket
+    from torchft_tpu.process_group import make_process_group
 
-    pg = ProcessGroupSocket(timeout=timeout)
+    # Factory, not a hardcoded class: TORCHFT_PG is inherited across the
+    # process boundary, so baby groups ride the same backend as the parent.
+    pg = make_process_group(timeout=timeout)
     try:
         pg.configure(store_addr, rank, world_size)
     except Exception as e:  # noqa: BLE001 - parent maps this to configure fail

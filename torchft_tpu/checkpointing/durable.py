@@ -30,10 +30,58 @@ bitwise checks):
 
 from __future__ import annotations
 
+import json
 import logging
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 logger = logging.getLogger(__name__)
+
+
+def structure_fingerprint(state: Any) -> Dict[str, Any]:
+    """Structural identity of a pytree: treedef string plus per-leaf
+    shape/dtype. Persisted alongside every snapshot so a restore into a
+    *different* model/optimizer structure fails loudly at the door instead
+    of silently re-hanging leaves onto the wrong slots (rehang_like matches
+    by flattened order only — same leaf count, different architecture would
+    otherwise restore garbage)."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+
+    def leaf_sig(x: Any) -> Dict[str, Any]:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return {"shape": list(x.shape), "dtype": str(np.dtype(x.dtype))}
+        return {"shape": [], "dtype": type(x).__name__}
+
+    return {"treedef": str(treedef), "leaves": [leaf_sig(x) for x in leaves]}
+
+
+def check_fingerprint(saved: Dict[str, Any], live: Dict[str, Any]) -> None:
+    """Raises ``ValueError`` describing the first divergences between a
+    snapshot's saved fingerprint and the live restore target's."""
+    problems = []
+    if saved.get("treedef") != live.get("treedef"):
+        problems.append(
+            "treedef mismatch:\n"
+            f"  saved: {saved.get('treedef')}\n"
+            f"  live:  {live.get('treedef')}"
+        )
+    a, b = saved.get("leaves", []), live.get("leaves", [])
+    if len(a) != len(b):
+        problems.append(f"leaf count mismatch: saved {len(a)} vs live {len(b)}")
+    for i, (sa, sb) in enumerate(zip(a, b)):
+        if sa != sb:
+            problems.append(f"leaf {i}: saved {sa} vs live {sb}")
+            if len(problems) >= 6:
+                problems.append("... (further leaf mismatches elided)")
+                break
+    if problems:
+        raise ValueError(
+            "durable checkpoint structure mismatch — refusing to restore "
+            "into a different model/optimizer structure:\n"
+            + "\n".join(problems)
+        )
 
 
 class DurableCheckpointer:
@@ -105,6 +153,36 @@ class DurableCheckpointer:
         import orbax.checkpoint as ocp
 
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._write_fingerprint(step, state)
+
+    # -- structure fingerprints -------------------------------------------
+
+    def _fingerprint_path(self, step: int):
+        return self._dir / "fingerprints" / f"{step}.json"
+
+    def _write_fingerprint(self, step: int, state: Any) -> None:
+        try:
+            fp = structure_fingerprint(state)
+            fpdir = self._dir / "fingerprints"
+            fpdir.mkdir(parents=True, exist_ok=True)
+            self._fingerprint_path(step).write_text(json.dumps(fp))
+            # Prune sidecars for steps orbax retention already collected.
+            live = {str(s) for s in self._mgr.all_steps()} | {str(step)}
+            for f in fpdir.iterdir():
+                if f.name.endswith(".json") and f.name[:-5] not in live:
+                    f.unlink()
+        except Exception as e:  # noqa: BLE001 - sidecar must never fail a save
+            logger.warning("could not write structure fingerprint: %s", e)
+
+    def _load_fingerprint(self, step: int) -> Optional[Dict[str, Any]]:
+        path = self._fingerprint_path(step)
+        try:
+            if not path.exists():
+                return None  # pre-fingerprint snapshot
+            return json.loads(path.read_text())
+        except Exception as e:  # noqa: BLE001 - torn/unreadable sidecar
+            logger.warning("unreadable structure fingerprint %s: %s", path, e)
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -127,6 +205,9 @@ class DurableCheckpointer:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")
         if abstract_state is None:
             return self._mgr.restore(step)
+        saved_fp = self._load_fingerprint(step)
+        if saved_fp is not None:
+            check_fingerprint(saved_fp, structure_fingerprint(abstract_state))
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )

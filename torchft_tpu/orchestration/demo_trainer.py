@@ -26,7 +26,7 @@ from typing import Dict
 import numpy as np
 
 from torchft_tpu.manager import Manager
-from torchft_tpu.process_group import ProcessGroupSocket
+from torchft_tpu.process_group import make_process_group
 
 
 def main() -> int:
@@ -46,7 +46,7 @@ def main() -> int:
     }
 
     manager = Manager(
-        pg=ProcessGroupSocket(timeout=15.0),
+        pg=make_process_group(timeout=15.0),
         state_dict=lambda: {k: v.copy() for k, v in params.items()},
         load_state_dict=lambda s: params.update(
             {k: np.asarray(v) for k, v in s.items()}

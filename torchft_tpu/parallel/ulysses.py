@@ -61,7 +61,10 @@ def ulysses_attention_shard(
     from torchft_tpu.models.llama import dense_attention
     from torchft_tpu.ops.flash_attention import flash_attention, supports
 
-    sp = jax.lax.axis_size(axis_name)
+    # jax.lax.psum(1, axis) is the portable axis-size spelling (same idiom
+    # as ring_attention.py); jax.lax.axis_size is not present in all
+    # supported jax versions.
+    sp = int(jax.lax.psum(1, axis_name))
     if sp == 1:
         # Degenerate axis: same auto-flash heuristic as the sp>1 branch,
         # so an sp=1 mesh doesn't silently materialize S^2 dense scores.

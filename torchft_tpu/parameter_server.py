@@ -107,8 +107,12 @@ class ParameterServer:
                     # fall through to the session-over branch there.
                     except (TimeoutError, _futures.TimeoutError):
                         continue  # idle-but-live: keep the session open
-                    except Exception:  # connection closed: session over
-                        return
+                    # CancelledError descends from BaseException (3.8+), so
+                    # a bare Exception clause misses it: an aborted pg
+                    # (executor shutdown with cancel_futures=True) would
+                    # crash the session thread instead of ending cleanly.
+                    except (_futures.CancelledError, Exception):
+                        return  # connection closed/aborted: session over
                 response = self.forward(session_id, request)
                 pg.send([np.asarray(response)], dst=1, tag="ps.resp").wait(
                     self._timeout

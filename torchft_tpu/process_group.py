@@ -20,6 +20,8 @@ analog, SURVEY.md hard-part #2).
 from __future__ import annotations
 
 import enum
+import json
+import os
 import queue as queue_mod
 import socket
 import struct
@@ -148,6 +150,10 @@ class _PeerConn:
         # Collective-tag prefixes this peer told us it abandoned (dies with
         # the connection at reconfigure; bounded by aborts per generation).
         self._aborted: Dict[str, str] = {}
+        # Cross-plane fail-fast hook: ProcessGroupNative installs a callback
+        # so an abort arriving on the python mesh can poison the native
+        # engine too (whose collectives block in C, not on these queues).
+        self.on_abort: Optional[Callable[[str, str], None]] = None
         self.dead: Optional[Exception] = None
         self._reader = threading.Thread(
             target=self._read_loop, name=f"pg-peer-{peer}", daemon=True
@@ -184,7 +190,22 @@ class _PeerConn:
                         for t, q in self._queues.items():
                             if t == tag or t.startswith(tag + "."):
                                 q.put(err)
+                        cb = self.on_abort
+                        if cb is not None:
+                            cb(tag, header.get("error", ""))
                         continue
+                    # Fresh data under a tombstoned tag means the peer started
+                    # a NEW collective reusing it (long-lived p2p tags, e.g.
+                    # the parameter server's fixed session tags). The abort
+                    # belonged to the previous generation; letting it stick
+                    # would fail every future collective under this tag.
+                    if self._aborted:
+                        for p in [
+                            p
+                            for p in self._aborted
+                            if tag == p or tag.startswith(p + ".")
+                        ]:
+                            del self._aborted[p]
                     q = self._queues.get(tag)
                     if q is None:
                         q = self._queues[tag] = queue_mod.Queue()
@@ -680,6 +701,301 @@ class ProcessGroupSocket(ProcessGroup):
 
 
 # ---------------------------------------------------------------------------
+# Native backend
+# ---------------------------------------------------------------------------
+
+
+def _pack_arrays(arrays: List[np.ndarray]) -> Tuple[str, bytes]:
+    """(meta_json, payload) wire form for the native allgather/broadcast:
+    self-describing per-array headers plus concatenated raw bytes, the same
+    dtype-string round trip as _PeerConn's JSON frame headers."""
+    metas = [
+        {"dtype": str(a.dtype), "shape": list(a.shape), "nbytes": int(a.nbytes)}
+        for a in arrays
+    ]
+    payload = b"".join(np.ascontiguousarray(a).tobytes() for a in arrays)
+    return json.dumps(metas), payload
+
+
+def _unpack_arrays(meta: str, data: bytearray) -> List[np.ndarray]:
+    out: List[np.ndarray] = []
+    off = 0
+    view = memoryview(data)
+    for m in json.loads(meta):
+        nb = int(m["nbytes"])
+        out.append(
+            np.frombuffer(view[off : off + nb], dtype=np.dtype(m["dtype"]))
+            .reshape(m["shape"])
+        )
+        off += nb
+    return out
+
+
+class ProcessGroupNative(ProcessGroupSocket):
+    """Socket PG with the hot collectives offloaded to the C++ pipelined
+    engine (``_cpp/collectives.cc`` via ``_native``): chunked ring allreduce,
+    allgather and broadcast run over a dedicated striped-TCP mesh with
+    receive-reduce pipelining, releasing the GIL for the whole transfer.
+
+    Everything else — rendezvous store protocol, tag sequencing, the
+    executor/Work surface, flight recorder, abort fan-out, p2p send/recv,
+    reduce_scatter/alltoall — is inherited: the python mesh stays up as the
+    control plane and the fallback data plane (non-native dtypes such as
+    bfloat16 take the inherited ring). ``configure``/``abort``/``errored``
+    semantics are identical, so Manager, DDP, DiLoCo and the wrapper zoo work
+    unchanged; select it with ``TORCHFT_PG=native``.
+
+    Wire compression: ``wire="int8"`` (or ``TORCHFT_PG_WIRE=int8``) routes
+    fp32 SUM/AVG allreduces through the engine's int8 blockwise codec, which
+    mirrors :mod:`torchft_tpu.collectives`' quantization layout bit-for-bit.
+    """
+
+    def __init__(
+        self,
+        timeout: float = 60.0,
+        n_streams: Optional[int] = None,
+        pipeline_bytes: Optional[int] = None,
+        wire: Optional[str] = None,
+    ) -> None:
+        super().__init__(timeout=timeout)
+        from torchft_tpu import _native
+
+        _native._load()  # fail at construction, not first collective
+        self._native = _native
+        self._engine: Optional[Any] = None
+        self._n_streams = int(
+            n_streams
+            if n_streams is not None
+            else os.environ.get("TORCHFT_NATIVE_STREAMS", "4")
+        )
+        self._pipeline_bytes = int(
+            pipeline_bytes
+            if pipeline_bytes is not None
+            else os.environ.get("TORCHFT_NATIVE_PIPELINE_BYTES", str(1 << 20))
+        )
+        self._wire = (
+            wire if wire is not None else os.environ.get("TORCHFT_PG_WIRE", "fp32")
+        ).lower()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        engine = None
+        store = None
+        if world_size > 1:
+            # Listen + advertise BEFORE the python-mesh rendezvous: naddr_r
+            # is published ahead of addr_r on every rank, so once the socket
+            # mesh is up (it reads addr_*), every naddr_* is in the store —
+            # the inherited rendezvous doubles as the publication barrier.
+            engine = self._native.NativeEngine(
+                self._n_streams, self._pipeline_bytes
+            )
+            try:
+                port = engine.listen("0.0.0.0")
+                addr, _, prefix = store_addr.partition("/")
+                store = StoreClient(addr, prefix=prefix, timeout=self._timeout)
+                from torchft_tpu.coordination import advertise_host
+
+                store.set(f"naddr_{rank}", f"{advertise_host()}:{port}")
+            except Exception:
+                engine.close()
+                if store is not None:
+                    store.close()
+                raise
+        try:
+            # Also tears down the previous generation's engine via the
+            # overridden _abort_locked.
+            super().configure(store_addr, rank, world_size)
+        except Exception:
+            if engine is not None:
+                engine.close()
+            if store is not None:
+                store.close()
+            raise
+        if engine is None:
+            return
+        try:
+            peers = [
+                store.get_str(f"naddr_{r}", timeout=self._timeout)
+                for r in range(world_size)
+            ]
+            engine.connect(rank, world_size, peers, self._timeout)
+        except Exception as e:
+            engine.close()
+            self.abort(_dump=False)
+            self._errored = e
+            raise RuntimeError(
+                f"rank {rank}: native data plane rendezvous failed: {e}"
+            ) from e
+        finally:
+            store.close()
+        with self._configure_lock:
+            self._engine = engine
+        for conn in self._peers.values():
+            conn.on_abort = self._on_peer_abort
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "pg_native_mesh",
+                rank=rank,
+                world=world_size,
+                streams=self._n_streams,
+                wire=self._wire,
+            )
+
+    def _abort_locked(self) -> None:
+        engine, self._engine = self._engine, None
+        if engine is not None:
+            engine.abort("pg abort")
+            # close() waits for in-flight native calls to drain before
+            # freeing the C++ object; do that off-thread so abort/configure
+            # never block behind a collective that is still unwinding.
+            threading.Thread(
+                target=engine.close, name="pg-native-close", daemon=True
+            ).start()
+        super()._abort_locked()
+
+    def _on_peer_abort(self, tag: str, msg: str) -> None:
+        # A peer abandoned a collective: our next/current native collective
+        # with it can only time out, so fail it now. p2p tags are exempt —
+        # they never touch the engine and can be benign/retryable (e.g. the
+        # parameter server's session tags).
+        if tag.startswith("p2p."):
+            return
+        engine = self._engine
+        if engine is not None:
+            engine.abort(f"collective {tag!r} aborted by a peer: {msg}")
+
+    def getBackendName(self) -> str:
+        return "torchft-native"
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _accounted(self, engine: Any, fn: Callable[[], None]) -> None:
+        tx0, rx0 = engine.bytes_tx(), engine.bytes_rx()
+        try:
+            fn()
+        finally:
+            add_bytes("pg_wire_tx", engine.bytes_tx() - tx0)
+            add_bytes("pg_wire_rx", engine.bytes_rx() - rx0)
+
+    # -- collectives -------------------------------------------------------
+
+    def _allreduce(
+        self, arrays: List[np.ndarray], op: ReduceOp, tag: str
+    ) -> List[np.ndarray]:
+        engine = self._engine
+        if self._world <= 1 or engine is None:
+            return super()._allreduce(arrays, op, tag)
+        for i, arr in enumerate(arrays):
+            if not self._native_allreduce_one(engine, arr, op):
+                # Dtype outside the engine's set (f16/bf16/fp8): the
+                # inherited python ring still carries it.
+                self._ring_allreduce_flat(arr, op, f"{tag}.{i}")
+        if op == ReduceOp.AVG:
+            for arr in arrays:
+                arr /= self._world
+        return arrays
+
+    def _native_allreduce_one(
+        self, engine: Any, arr: np.ndarray, op: ReduceOp
+    ) -> bool:
+        name = str(arr.dtype)
+        use_q8 = (
+            self._wire == "int8"
+            and name == "float32"
+            and op in (ReduceOp.SUM, ReduceOp.AVG)
+        )
+        if not use_q8 and name not in self._native.DTYPE_CODES:
+            return False
+        carr = np.ascontiguousarray(arr)
+        flat = carr.reshape(-1)
+        if use_q8:
+            self._accounted(
+                engine, lambda: engine.allreduce_q8(flat, self._timeout)
+            )
+        else:
+            code = {
+                ReduceOp.SUM: self._native.OP_SUM,
+                ReduceOp.AVG: self._native.OP_SUM,
+                ReduceOp.MAX: self._native.OP_MAX,
+                ReduceOp.MIN: self._native.OP_MIN,
+            }[op]
+            self._accounted(
+                engine, lambda: engine.allreduce(flat, code, self._timeout)
+            )
+        if carr is not arr:  # non-contiguous input: write the copy back
+            arr[...] = flat.reshape(arr.shape)
+        return True
+
+    def allgather(self, tensors: Any) -> Work:
+        arrays = _as_list(tensors)
+        engine = self._engine
+        if self._world <= 1 or engine is None:
+            return super().allgather(tensors)
+        tag = self._next_tag()
+
+        def run() -> List[List[np.ndarray]]:
+            meta, payload = _pack_arrays(arrays)
+            self._accounted(
+                engine, lambda: engine.allgather(meta, payload, self._timeout)
+            )
+            out: List[Optional[List[np.ndarray]]] = [None] * self._world
+            out[self._rank] = [a.copy() for a in arrays]
+            for p in range(self._world):
+                if p == self._rank:
+                    continue
+                pmeta, pdata = engine.result(p)
+                out[p] = _unpack_arrays(pmeta, pdata)
+            return out  # type: ignore[return-value]
+
+        return self._submit(
+            run,
+            op="allgather",
+            nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
+        )
+
+    def broadcast(self, tensors: Any, root: int = 0) -> Work:
+        arrays = _as_list(tensors)
+        engine = self._engine
+        if self._world <= 1 or engine is None:
+            return super().broadcast(tensors, root)
+        tag = self._next_tag()
+
+        def run() -> List[np.ndarray]:
+            if self._rank == root:
+                meta, payload = _pack_arrays(arrays)
+                self._accounted(
+                    engine,
+                    lambda: engine.broadcast(
+                        meta, payload, root, self._timeout
+                    ),
+                )
+                return arrays
+            self._accounted(
+                engine, lambda: engine.broadcast("", b"", root, self._timeout)
+            )
+            pmeta, pdata = engine.result(root)
+            received = _unpack_arrays(pmeta, pdata)
+            if len(received) != len(arrays):
+                raise RuntimeError(
+                    f"broadcast arity mismatch: root sent {len(received)} "
+                    f"arrays, expected {len(arrays)}"
+                )
+            for a, r in zip(arrays, received):
+                np.copyto(a, r.reshape(a.shape).astype(a.dtype, copy=False))
+            return arrays
+
+        return self._submit(
+            run,
+            op="broadcast",
+            nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
+        )
+
+
+# ---------------------------------------------------------------------------
 # Wrappers
 # ---------------------------------------------------------------------------
 
@@ -878,3 +1194,27 @@ class ManagedProcessGroup(ProcessGroup):
 
     def getBackendName(self) -> str:
         return "torchft-managed"
+
+
+# ---------------------------------------------------------------------------
+# Backend selection
+# ---------------------------------------------------------------------------
+
+
+def make_process_group(timeout: float = 60.0) -> ProcessGroup:
+    """Constructs the replica-axis data plane selected by ``TORCHFT_PG``:
+    ``socket`` (default, pure-python mesh), ``native`` (C++ pipelined engine),
+    or ``dummy`` (no-op test double). The env var — not a code change — is the
+    switch so train scripts, drills and the process launcher all pick the
+    backend uniformly, including across fork/spawn boundaries."""
+    backend = os.environ.get("TORCHFT_PG", "socket").strip().lower() or "socket"
+    if backend == "socket":
+        return ProcessGroupSocket(timeout=timeout)
+    if backend == "native":
+        return ProcessGroupNative(timeout=timeout)
+    if backend == "dummy":
+        return ProcessGroupDummy()
+    raise ValueError(
+        f"unknown TORCHFT_PG backend {backend!r} "
+        "(expected socket, native, or dummy)"
+    )

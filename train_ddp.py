@@ -44,7 +44,7 @@ from torchft_tpu import telemetry
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper
-from torchft_tpu.process_group import ProcessGroupSocket
+from torchft_tpu.process_group import make_process_group
 
 
 class Net(nn.Module):
@@ -196,7 +196,7 @@ def main() -> int:
 
 
     manager = Manager(
-        pg=ProcessGroupSocket(timeout=30.0),
+        pg=make_process_group(timeout=30.0),
         min_replica_size=args.min_replicas,
         replica_id=f"train_ddp_{replica_group}",
         group_rank=0,

@@ -43,7 +43,7 @@ from torchft_tpu.coordination import RequestAborted
 from torchft_tpu.local_sgd import DiLoCo, partition_fragments
 from torchft_tpu.manager import Manager
 from torchft_tpu.models import Transformer, llama_debug
-from torchft_tpu.process_group import ProcessGroupSocket
+from torchft_tpu.process_group import make_process_group
 
 
 def main() -> int:
@@ -161,7 +161,7 @@ def main() -> int:
         return (keys, get, set_)
 
     manager = Manager(
-        pg=ProcessGroupSocket(timeout=30.0),
+        pg=make_process_group(timeout=30.0),
         min_replica_size=args.min_replicas,
         use_async_quorum=False,  # DiLoCo requires sync quorum (local_sgd.py:616-620)
         replica_id=f"train_diloco_{replica_group}",

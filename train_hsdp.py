@@ -109,7 +109,7 @@ def main() -> int:
         init_train_state,
         make_grad_step,
     )
-    from torchft_tpu.process_group import ProcessGroupSocket
+    from torchft_tpu.process_group import make_process_group
 
     group = os.environ.get("REPLICA_GROUP_ID", "0")
     n_dev = len(jax.devices())
@@ -202,7 +202,7 @@ def main() -> int:
         params = jax.device_put(state_dict["params"], shardings.params)
         opt_state = jax.device_put(state_dict["opt_state"], shardings.opt_state)
 
-    pg = ProcessGroupSocket(timeout=30.0)
+    pg = make_process_group(timeout=30.0)
     checkpoint_transport = None
     if sharded_heal:
         from torchft_tpu.checkpointing.pg_transport import PGTransport
