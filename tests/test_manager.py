@@ -49,7 +49,7 @@ def make_manager(pg=None, quorum_result=None, **kwargs):
         client._quorum.return_value = quorum_result or make_quorum_result()
         # Echo the local vote by default.
         client.should_commit.side_effect = (
-            lambda rank, step, ok, timeout=None: ok
+            lambda rank, step, ok, timeout=None, trace_id="": ok
         )
         client.drain_status.return_value = False
         manager = Manager(

@@ -311,3 +311,77 @@ def test_manager_journal_feeds_obs_report(tmp_path, monkeypatch):
     row = timeline[0][obs_report._replica_key(events[0])]
     assert row["committed"] is True
     assert row["total_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Trace-id correlation: the manager mints one id per quorum generation,
+# stamps every journal event with it, echoes the previous generation's id
+# on the quorum RPC, and pushes the new id into the process group.
+# ---------------------------------------------------------------------------
+
+
+def test_manager_trace_ids_across_generations(tmp_path, monkeypatch):
+    import re
+
+    from torchft_tpu import telemetry
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    from tests.test_manager import make_manager, make_quorum_result
+
+    path = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    telemetry.reset_event_log()
+    try:
+        import numpy as np
+
+        m = make_manager()
+        try:
+            # Generation 1 (quorum_id=1, max_step=0) -> trace "q1.s0".
+            m.start_quorum()
+            m.allreduce(np.ones(4, np.float32)).wait()
+            assert m.should_commit()
+            # Simulate a kill+heal: the next quorum round returns a new
+            # generation at a later step -> a fresh id, never reused.
+            m._test_client._quorum.return_value = make_quorum_result(
+                quorum_id=2, max_step=5
+            )
+            m.start_quorum()
+            m.allreduce(np.ones(4, np.float32)).wait()
+            assert m.should_commit()
+            assert m._trace_id == "q2.s5"
+            # The id was pushed into the process group as well (the native
+            # backend forwards it to the engine from the same hook).
+            assert m._pg._trace_id == "q2.s5"
+            # The quorum RPC carries the PREVIOUS generation's id — the
+            # transition edge — and empty on the very first quorum.
+            rpc_traces = [
+                c.kwargs["trace_id"]
+                for c in m._test_client._quorum.call_args_list
+            ]
+            assert rpc_traces == ["", "q1.s0"]
+            # should_commit RPCs carry the id of the generation they gate.
+            gate_traces = [
+                c.kwargs["trace_id"]
+                for c in m._test_client.should_commit.call_args_list
+            ]
+            assert gate_traces == ["q1.s0", "q2.s5"]
+        finally:
+            m.shutdown()
+    finally:
+        telemetry.reset_event_log()
+
+    rows = [json.loads(l) for l in open(path)]
+    by_trace = {}
+    for r in rows:
+        if r.get("trace"):
+            by_trace.setdefault(r["trace"], set()).add(r["event"])
+    assert set(by_trace) == {"q1.s0", "q2.s5"}
+    for tid, events in by_trace.items():
+        assert re.fullmatch(r"q\d+\.s\d+", tid)
+        # Each generation's id joins the full control-plane step cycle.
+        assert {"quorum_ready", "allreduce_issue", "allreduce_complete",
+                "commit_gate"} <= events
+    # The first quorum_start predates any mint: it must carry no id at all
+    # (absent, not empty) so tools never group it under a bogus key.
+    first = next(r for r in rows if r["event"] == "quorum_start")
+    assert "trace" not in first

@@ -386,3 +386,115 @@ def test_flight_recorder_index_tracks_eviction():
     fr.complete(seqs[-1], error="boom")
     assert fr.snapshot()[-1]["status"] == "error"
     assert fr.snapshot()[-2]["status"] == "issued"
+
+
+# ---------------------------------------------------------------------------
+# Histogram percentile edge cases + externally-timed spans
+# ---------------------------------------------------------------------------
+
+
+def test_hist_percentile_all_zero_buckets_is_zero():
+    empty = [0] * telemetry._HIST_NBUCKETS
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert telemetry._hist_percentile(empty, q) == 0.0
+
+
+def test_hist_percentile_single_bucket_any_quantile():
+    """Every quantile of a single occupied bucket is that bucket's upper
+    bound — including q=0, which used to fall through to bucket 0's bound
+    when the occupied bucket had an empty prefix."""
+    buckets = [0] * telemetry._HIST_NBUCKETS
+    buckets[10] = 7
+    for q in (0.0, 0.5, 1.0):
+        assert (
+            telemetry._hist_percentile(buckets, q)
+            == telemetry._HIST_BOUNDS[10]
+        )
+
+
+def test_hist_percentile_skips_empty_prefix_at_low_q():
+    """Regression: with target <= 0 the ``cum >= target`` check held
+    vacuously at the first (empty) bucket and reported _HIST_BOUNDS[0]."""
+    buckets = [0] * telemetry._HIST_NBUCKETS
+    buckets[5] = 1
+    buckets[20] = 1
+    got = telemetry._hist_percentile(buckets, 1e-9)
+    assert got == telemetry._HIST_BOUNDS[5]
+    assert got != telemetry._HIST_BOUNDS[0]
+    # And the top quantile reaches the highest occupied bucket.
+    assert telemetry._hist_percentile(buckets, 1.0) == (
+        telemetry._HIST_BOUNDS[20]
+    )
+
+
+def test_hist_percentile_overflow_bucket_reports_last_bound():
+    buckets = [0] * telemetry._HIST_NBUCKETS
+    buckets[-1] = 3  # overflow bucket has no upper bound of its own
+    assert telemetry._hist_percentile(buckets, 0.5) == (
+        telemetry._HIST_BOUNDS[-1]
+    )
+
+
+def test_observe_span_feeds_percentiles():
+    telemetry.reset_span_stats()
+    try:
+        for _ in range(10):
+            telemetry.observe_span("test::ext", 0.004)
+        s = telemetry.span_stats()["test::ext"]
+        assert s["count"] == 10
+        assert s["max_s"] == pytest.approx(0.004)
+        pcts = telemetry.span_percentiles("test::ext")["test::ext"]
+        assert 0.004 <= pcts["p50"] <= 0.008
+    finally:
+        telemetry.reset_span_stats()
+
+
+# ---------------------------------------------------------------------------
+# Event journal: trace field + atomic multi-writer appends
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_trace_field(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    log = telemetry.EventLog(path, replica_id="r0")
+    log.emit("quorum_ready", step=1, trace="q3.s17", quorum_id=3)
+    log.emit("quorum_start", step=1)  # no trace -> key absent, not null
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["trace"] == "q3.s17"
+    assert lines[0]["attrs"] == {"quorum_id": 3}  # trace is NOT an attr
+    assert "trace" not in lines[1]
+
+
+def test_event_log_multi_writer_appends_do_not_interleave(tmp_path):
+    """Two EventLog instances (as two processes would) share one journal
+    path; O_APPEND + single os.write per line must keep every line whole."""
+    path = str(tmp_path / "shared.jsonl")
+    logs = [
+        telemetry.EventLog(path, replica_id=f"w{i}") for i in range(2)
+    ]
+    n_per = 200
+    payload = "x" * 512  # large enough that torn writes would show
+
+    def writer(i):
+        for k in range(n_per):
+            logs[i].emit("ev", step=k, k=k, pad=payload)
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for log in logs:
+        log.close()
+    lines = open(path).readlines()
+    assert len(lines) == 2 * n_per
+    seen = {"w0": set(), "w1": set()}
+    for line in lines:
+        rec = json.loads(line)  # raises if any line is torn/interleaved
+        assert rec["attrs"]["pad"] == payload
+        seen[rec["replica_id"]].add(rec["attrs"]["k"])
+    assert seen["w0"] == set(range(n_per))
+    assert seen["w1"] == set(range(n_per))

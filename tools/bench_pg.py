@@ -80,7 +80,12 @@ def _worker(args: argparse.Namespace) -> int:
 
 
 def _run_backend(
-    backend: str, world: int, sizes: str, iters: int, timeout: float
+    backend: str,
+    world: int,
+    sizes: str,
+    iters: int,
+    timeout: float,
+    extra_env: dict | None = None,
 ) -> list:
     from torchft_tpu.store import TCPStoreServer
 
@@ -103,7 +108,11 @@ def _run_backend(
                 subprocess.Popen(
                     cmd,
                     cwd=REPO,
-                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                    env={
+                        **os.environ,
+                        "JAX_PLATFORMS": "cpu",
+                        **(extra_env or {}),
+                    },
                 )
             )
         deadline = time.monotonic() + timeout * 4
@@ -157,8 +166,14 @@ def main() -> int:
     }
     for backend in ("socket", "native"):
         print(f"== bench {backend}: world={args.world} sizes={args.sizes} ==")
+        # The native run pins the flight-recorder ring to its default so
+        # the headline number reflects the shipping (recorder-on) config.
         rows = _run_backend(
-            backend, args.world, args.sizes, args.iters, args.timeout
+            backend, args.world, args.sizes, args.iters, args.timeout,
+            extra_env=(
+                {"TORCHFT_NATIVE_FR_RING": "256"}
+                if backend == "native" else None
+            ),
         )
         report["backends"][backend] = rows
         for r in rows:
@@ -178,6 +193,32 @@ def main() -> int:
     speedup = rate("native") / rate("socket")
     report["largest_size_mib"] = largest
     report["native_over_socket"] = speedup
+
+    # Flight-recorder overhead at the largest size: the recorder-on number
+    # is the native run above (ring pinned to its default 256); one extra
+    # recorder-off pass isolates the ring-write cost. Budget: < 5%.
+    print(f"== bench native (fr ring off): {largest} MiB ==")
+    off_rows = _run_backend(
+        "native", args.world, str(largest), args.iters, args.timeout,
+        extra_env={"TORCHFT_NATIVE_FR_RING": "0"},
+    )
+    on_best = next(
+        r["best_s"]
+        for r in report["backends"]["native"]
+        if r["size_mib"] == largest
+    )
+    off_best = off_rows[0]["best_s"]
+    overhead_pct = (on_best / off_best - 1.0) * 100.0
+    report["fr_overhead"] = {
+        "size_mib": largest,
+        "recorder_on_best_s": on_best,
+        "recorder_off_best_s": off_best,
+        "overhead_pct": overhead_pct,
+    }
+    print(
+        f"  fr recorder on {on_best * 1e3:9.1f} ms  "
+        f"off {off_best * 1e3:9.1f} ms  overhead {overhead_pct:+.1f}%"
+    )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(

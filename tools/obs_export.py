@@ -31,7 +31,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import obs_report  # noqa: E402
 from torchft_tpu.coordination import LighthouseClient  # noqa: E402
 from torchft_tpu.telemetry import EventLog  # noqa: E402
 
@@ -106,13 +108,99 @@ def render_prometheus(sample: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def latest_native_counters(
+    events: list,
+) -> Dict[str, Dict[str, Any]]:
+    """Latest ``native_counters`` journal event per replica (the native PG
+    drains one after every collective, so the last one carries the
+    engine's cumulative per-peer counters for this incarnation)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        if ev.get("event") == "native_counters":
+            out[obs_report._replica_key(ev)] = ev.get("attrs") or {}
+    return out
+
+
+def render_native_prometheus(
+    counters: Dict[str, Dict[str, Any]],
+) -> str:
+    """Prometheus gauges from the native engine's always-on counters:
+    per-peer goodput, MSG_DONTWAIT spin totals, and flight-recorder ring
+    drops. Peer bandwidth divides bytes by busy time PER STREAM
+    (``busy_ns / n_streams``): busy_ns sums over n_streams concurrent
+    stripe jobs, so the raw quotient would understate wall bandwidth by
+    roughly that factor."""
+    if not counters:
+        return ""
+    lines = []
+
+    def header(name: str, help_: str) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+
+    def esc(s: Any) -> str:
+        return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
+    for name, key, help_ in (
+        ("torchft_exporter_native_spin_total", "spin_total",
+         "MSG_DONTWAIT EAGAIN->poll misses across all engine transfers."),
+        ("torchft_exporter_native_fr_dropped", "dropped",
+         "Flight records overwritten before any snapshot drained them."),
+        ("torchft_exporter_native_fr_seq", "seq",
+         "Collectives recorded by the engine flight recorder."),
+        ("torchft_exporter_native_bytes_tx", "bytes_tx",
+         "Bytes sent on the native data plane."),
+        ("torchft_exporter_native_bytes_rx", "bytes_rx",
+         "Bytes received on the native data plane."),
+    ):
+        header(name, help_)
+        for rid in sorted(counters):
+            lines.append(
+                f'{name}{{replica="{esc(rid)}"}} '
+                f"{int(counters[rid].get(key, 0))}"
+            )
+
+    header("torchft_exporter_native_peer_gib_s",
+           "Per-peer stripe goodput, GiB per busy second "
+           "(bytes / (busy_ns / n_streams)).")
+    for rid in sorted(counters):
+        c = counters[rid]
+        streams = max(int(c.get("n_streams", 1)), 1)
+        for p in c.get("peers") or []:
+            for dirn, bkey, nskey in (
+                ("tx", "tx_bytes", "tx_busy_ns"),
+                ("rx", "rx_bytes", "rx_busy_ns"),
+            ):
+                busy = int(p.get(nskey, 0))
+                gib_s = (
+                    int(p.get(bkey, 0)) / (1 << 30) / (busy / streams / 1e9)
+                    if busy > 0 else 0.0
+                )
+                lines.append(
+                    f'torchft_exporter_native_peer_gib_s{{'
+                    f'replica="{esc(rid)}",peer="{p.get("peer")}",'
+                    f'dir="{dirn}"}} {gib_s:.4f}'
+                )
+    header("torchft_exporter_native_peer_spins",
+           "Per-peer MSG_DONTWAIT spin count.")
+    for rid in sorted(counters):
+        for p in counters[rid].get("peers") or []:
+            lines.append(
+                f'torchft_exporter_native_peer_spins{{'
+                f'replica="{esc(rid)}",peer="{p.get("peer")}"}} '
+                f"{int(p.get('spins', 0))}"
+            )
+    return "\n".join(lines) + "\n"
+
+
 class _Exporter:
     """Holds the latest sample; the HTTP handler and poll loop share it."""
 
-    def __init__(self) -> None:
+    def __init__(self, journal_paths: Optional[list] = None) -> None:
         self._lock = threading.Lock()
         self._sample: Optional[Dict[str, Any]] = None
         self._error: str = "no scrape yet"
+        self._journal_paths = list(journal_paths or [])
 
     def update(self, sample: Dict[str, Any]) -> None:
         with self._lock:
@@ -127,6 +215,15 @@ class _Exporter:
         with self._lock:
             sample, error = self._sample, self._error
         body = render_prometheus(sample) if sample is not None else ""
+        if self._journal_paths:
+            try:
+                body += render_native_prometheus(
+                    latest_native_counters(
+                        obs_report.load_events(self._journal_paths)
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - journal is best-effort
+                print(f"native gauge scan failed: {e}", file=sys.stderr)
         up = 1 if (sample is not None and not error) else 0
         body += ("# HELP torchft_exporter_up Last scrape succeeded.\n"
                  "# TYPE torchft_exporter_up gauge\n"
@@ -163,6 +260,11 @@ def main(argv: Optional[list] = None) -> int:
                    help="poll interval seconds (default 5)")
     p.add_argument("--journal-file", default="",
                    help="append lighthouse_status events to this JSONL file")
+    p.add_argument("--journal", action="append", default=[],
+                   metavar="PATH",
+                   help="journal file/dir to scan for native engine "
+                        "counters (per-peer GiB/s, spins, ring drops); "
+                        "repeatable")
     p.add_argument("--port", type=int, default=0,
                    help="serve Prometheus text on this port (0 = off)")
     p.add_argument("--once", action="store_true",
@@ -170,10 +272,14 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--max-scrapes", type=int, default=0,
                    help="exit after N successful scrapes (0 = run forever)")
     args = p.parse_args(argv)
-    if not args.lighthouse:
-        p.error("--lighthouse or $TORCHFT_LIGHTHOUSE is required")
+    if not args.lighthouse and not args.journal:
+        p.error("--lighthouse / $TORCHFT_LIGHTHOUSE or --journal is required")
 
-    client = LighthouseClient(args.lighthouse, connect_timeout=10.0)
+    client = (
+        LighthouseClient(args.lighthouse, connect_timeout=10.0)
+        if args.lighthouse
+        else None
+    )
     journal = (
         EventLog(args.journal_file, replica_id="exporter")
         if args.journal_file
@@ -181,13 +287,24 @@ def main(argv: Optional[list] = None) -> int:
     )
 
     if args.once:
-        sample = scrape(client)
-        if journal is not None:
-            journal.emit("lighthouse_status", **sample)
-        sys.stdout.write(render_prometheus(sample))
+        if client is not None:
+            sample = scrape(client)
+            if journal is not None:
+                journal.emit("lighthouse_status", **sample)
+            sys.stdout.write(render_prometheus(sample))
+        if args.journal:
+            sys.stdout.write(
+                render_native_prometheus(
+                    latest_native_counters(
+                        obs_report.load_events(args.journal)
+                    )
+                )
+            )
         return 0
 
-    exporter = _Exporter()
+    if client is None:
+        p.error("serving mode needs --lighthouse / $TORCHFT_LIGHTHOUSE")
+    exporter = _Exporter(journal_paths=args.journal)
     server = None
     if args.port:
         server = ThreadingHTTPServer(

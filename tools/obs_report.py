@@ -185,6 +185,57 @@ def detect_stalls(
     return stalls
 
 
+def native_stall_attribution(
+    events: List[Dict[str, Any]],
+) -> Dict[str, Dict[str, Any]]:
+    """Per replica: which peer/stripe lane bounded its native collectives.
+
+    Each ``native_collective`` journal event (drained from the C++
+    engine's flight recorder) carries per-lane nanosecond windows; the
+    lane with the longest wall time bounded that collective. Counting the
+    winner across records names the peer (and direction) a stalled
+    allreduce is actually waiting on, with the bandwidth that lane
+    achieved — "slow because peer 2's recv stripe ran at 0.3 GiB/s", not
+    just "allreduce was slow"."""
+    agg: Dict[Tuple[str, Any, Any, Any], Dict[str, Any]] = {}
+    totals: Dict[str, int] = {}
+    for ev in events:
+        if ev.get("event") != "native_collective":
+            continue
+        attrs = ev.get("attrs") or {}
+        lanes = attrs.get("lanes") or []
+        if not lanes:
+            continue
+        rid = _replica_key(ev)
+        totals[rid] = totals.get(rid, 0) + 1
+        slow = max(
+            lanes,
+            key=lambda ln: int(ln.get("t1_ns", 0)) - int(ln.get("t0_ns", 0)),
+        )
+        wall = max(int(slow.get("t1_ns", 0)) - int(slow.get("t0_ns", 0)), 1)
+        key = (rid, slow.get("peer"), slow.get("stripe"), slow.get("dir"))
+        a = agg.setdefault(key, {"count": 0, "bytes": 0, "wall_ns": 0})
+        a["count"] += 1
+        a["bytes"] += int(slow.get("bytes", 0))
+        a["wall_ns"] += wall
+    per_replica: Dict[str, Dict[str, Any]] = {}
+    for (rid, peer, stripe, d), a in agg.items():
+        cur = per_replica.get(rid)
+        if cur is not None and a["count"] <= cur["count"]:
+            continue
+        per_replica[rid] = {
+            "peer": peer,
+            "stripe": stripe,
+            "dir": d,
+            "count": a["count"],
+            "records": totals.get(rid, 0),
+            "gib_s": round(
+                (a["bytes"] / (1 << 30)) / (a["wall_ns"] / 1e9), 4
+            ),
+        }
+    return per_replica
+
+
 def goodput_rollup(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregates the per-replica ``goodput`` shutdown events (the dict
     ``Manager.goodput()`` returns) into a run-level rollup. The LAST
@@ -214,6 +265,7 @@ def render_text(
     timeline: Dict[int, Dict[str, Dict[str, Any]]],
     stalls: List[Dict[str, Any]],
     goodput: Dict[str, Any],
+    native: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> str:
     out = []
     out.append(
@@ -248,6 +300,18 @@ def render_text(
                 f"  step {s['step']}: replica {s['replica']} waited "
                 f"{s['quorum_wait_s']}s (threshold {s['threshold_s']}s)"
             )
+    if native:
+        out.append("")
+        out.append("native stall attribution (slowest stripe lane per "
+                   "collective, majority winner):")
+        for rid in sorted(native):
+            a = native[rid]
+            out.append(
+                f"  replica {rid}: bounded by peer {a['peer']} "
+                f"stripe {a['stripe']} ({a['dir']}) in "
+                f"{a['count']}/{a['records']} collectives "
+                f"at {a['gib_s']} GiB/s"
+            )
     if goodput:
         out.append("")
         out.append(
@@ -280,6 +344,7 @@ def main(argv: Optional[list] = None) -> int:
     timeline = build_timeline(events)
     stalls = detect_stalls(timeline, args.stall_pct, args.stall_min_s)
     goodput = goodput_rollup(events)
+    native = native_stall_attribution(events)
 
     if args.json:
         report = {
@@ -295,12 +360,13 @@ def main(argv: Optional[list] = None) -> int:
             },
             "stalls": stalls,
             "goodput": goodput,
+            "native_stall_attribution": native,
             "num_events": len(events),
         }
         json.dump(report, sys.stdout, indent=1, default=str)
         print()
     else:
-        print(render_text(timeline, stalls, goodput))
+        print(render_text(timeline, stalls, goodput, native))
     return 0
 
 

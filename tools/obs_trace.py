@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Chrome-trace exporter: converts step-event journals (and optional raw
+engine flight-recorder snapshots) into Chrome ``trace_event`` JSON that
+loads in Perfetto / ``chrome://tracing``.
+
+Track layout: one *process* per replica; inside it, a ``control-plane``
+thread carries quorum / heal / allreduce / commit spans (reconstructed
+from each event's ``elapsed_s``), a ``collectives`` thread carries the
+per-collective ``pg_collective`` spans, a ``native engine`` thread
+carries the C++ flight records (``native_collective`` events, stamped
+with CLOCK_REALTIME nanoseconds by the engine, so they land on the same
+axis as the Python journal's ``time.time()``), and one sub-thread per
+``peer/stripe/direction`` lane shows the striped-TCP transfers that made
+up each record.
+
+Correlation: every span's ``args.trace`` carries the step-scoped trace id
+(``q<quorum_id>.s<max_step>``) the Manager minted; spans sharing an id
+are additionally joined by Chrome flow arrows across replicas and planes.
+
+Usage::
+
+    python tools/obs_trace.py /tmp/journal/ -o trace.json
+    python tools/obs_trace.py a.jsonl b.jsonl --check        # schema gate
+    python tools/obs_trace.py journal/ --snapshot r0=fr0.json -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import obs_report  # noqa: E402
+
+# Journal events whose `elapsed_s` attr spans a phase worth drawing.
+_SPAN_EVENTS = {
+    "quorum_ready": "quorum",
+    "heal_send_done": "heal_send",
+    "heal_done": "heal",
+    "allreduce_complete": "allreduce",
+    "commit_gate": "commit",
+    "pg_configure": "pg_configure",
+}
+# Point-in-time markers (no duration in the journal).
+_INSTANT_EVENTS = {
+    "quorum_start", "quorum_abort", "heal_start", "heal_send_start",
+    "heal_failed", "pg_abort", "pg_configure_failed", "pg_native_mesh",
+}
+_DIR_NAMES = {0: "send", 1: "recv", 2: "recv_reduce"}
+
+
+def _flow_id(trace_id: str) -> int:
+    """Stable non-zero id for Chrome flow binding (same trace id on every
+    replica -> same arrow chain)."""
+    return (zlib.crc32(trace_id.encode()) & 0x7FFFFFFF) or 1
+
+
+class _Tracks:
+    """Allocates stable pid/tid integers and emits name metadata."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+        self._pids: Dict[str, int] = {}
+        self._tids: Dict[Tuple[str, str], int] = {}
+
+    def pid(self, replica: str) -> int:
+        if replica not in self._pids:
+            self._pids[replica] = len(self._pids) + 1
+            self.events.append({
+                "ph": "M", "name": "process_name", "pid": self._pids[replica],
+                "tid": 0, "args": {"name": f"replica {replica}"},
+            })
+        return self._pids[replica]
+
+    def tid(self, replica: str, track: str) -> int:
+        key = (replica, track)
+        if key not in self._tids:
+            tid = sum(1 for (r, _t) in self._tids if r == replica) + 1
+            self._tids[key] = tid
+            self.events.append({
+                "ph": "M", "name": "thread_name", "pid": self.pid(replica),
+                "tid": tid, "args": {"name": track},
+            })
+        return self._tids[key]
+
+
+def _native_record_events(
+    tr: _Tracks,
+    replica: str,
+    rec: Dict[str, Any],
+    trace: Optional[str],
+    base_us: float,
+) -> List[Dict[str, Any]]:
+    """Spans for one engine flight record: the record itself on the
+    ``native engine`` track, each lane on its ``peer/stripe/dir``
+    sub-track."""
+    out: List[Dict[str, Any]] = []
+    t0 = rec.get("t_start_ns", 0) / 1e3 - base_us
+    t1 = rec.get("t_end_ns", 0) / 1e3 - base_us
+    if t1 < t0:
+        t1 = t0
+    pid = tr.pid(replica)
+    name = str(rec.get("op", "?"))
+    out.append({
+        "ph": "X", "name": name, "cat": "native",
+        "pid": pid, "tid": tr.tid(replica, "native engine"),
+        "ts": t0, "dur": max(t1 - t0, 1.0),
+        "args": {
+            "trace": trace, "tag": rec.get("tag", ""),
+            "status": rec.get("status", ""), "bytes": rec.get("nbytes",
+                                                              rec.get("bytes", 0)),
+            "lanes_dropped": rec.get("lanes_dropped", 0),
+            "cause": rec.get("cause", ""),
+        },
+    })
+    for lane in rec.get("lanes") or []:
+        lt0 = lane.get("t0_ns", 0) / 1e3 - base_us
+        lt1 = lane.get("t1_ns", 0) / 1e3 - base_us
+        if lt1 < lt0:
+            lt1 = lt0
+        d = lane.get("dir", 0)  # engine snapshots carry the name string
+        if not isinstance(d, str):
+            d = _DIR_NAMES.get(int(d), "?")
+        track = f"peer{lane.get('peer')} stripe{lane.get('stripe')} {d}"
+        args = {
+            "trace": trace, "bytes": lane.get("bytes", 0),
+            "spins": lane.get("spins", 0),
+        }
+        if lane.get("reduce_ns"):
+            # wire time = lane duration minus time inside reduce_into
+            args["reduce_us"] = lane["reduce_ns"] / 1e3
+        out.append({
+            "ph": "X", "name": f"{name} {d}", "cat": "native-lane",
+            "pid": pid, "tid": tr.tid(replica, track),
+            "ts": lt0, "dur": max(lt1 - lt0, 1.0), "args": args,
+        })
+    return out
+
+
+def build_trace(
+    events: List[Dict[str, Any]],
+    snapshots: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Folds journal events (plus optional {replica: fr_snapshot dict})
+    into a Chrome trace_event document."""
+    tr = _Tracks()
+    spans: List[Dict[str, Any]] = []
+    # One time base for the whole trace keeps Chrome's µs values small.
+    t_bases = [float(e["ts"]) for e in events if "ts" in e]
+    for snap in (snapshots or {}).values():
+        for rec in snap.get("records", []):
+            if rec.get("t_start_ns"):
+                t_bases.append(rec["t_start_ns"] / 1e9)
+    base_s = min(t_bases) if t_bases else 0.0
+    base_us = base_s * 1e6
+
+    flows: Dict[str, List[Dict[str, Any]]] = {}
+
+    for ev in events:
+        name = ev.get("event", "")
+        replica = obs_report._replica_key(ev)
+        trace = ev.get("trace")
+        attrs = ev.get("attrs") or {}
+        ts_us = float(ev.get("ts", 0.0)) * 1e6 - base_us
+        pid = tr.pid(replica)
+        if name in _SPAN_EVENTS:
+            dur = max(float(attrs.get("elapsed_s") or 0.0), 0.0) * 1e6
+            span = {
+                "ph": "X", "name": _SPAN_EVENTS[name], "cat": "control",
+                "pid": pid, "tid": tr.tid(replica, "control-plane"),
+                "ts": ts_us - dur, "dur": max(dur, 1.0),
+                "args": {"trace": trace, "step": ev.get("step"), **attrs},
+            }
+            spans.append(span)
+            if trace:
+                flows.setdefault(trace, []).append(span)
+        elif name == "pg_collective":
+            dur = max(float(attrs.get("elapsed_s") or 0.0), 0.0) * 1e6
+            spans.append({
+                "ph": "X",
+                "name": f"{attrs.get('op', '?')} {attrs.get('tag', '')}",
+                "cat": "collective",
+                "pid": pid, "tid": tr.tid(replica, "collectives"),
+                "ts": ts_us - dur, "dur": max(dur, 1.0),
+                "args": {"trace": trace, **attrs},
+            })
+        elif name == "native_collective":
+            spans.extend(
+                _native_record_events(tr, replica, attrs, trace, base_us)
+            )
+        elif name in _INSTANT_EVENTS:
+            spans.append({
+                "ph": "i", "name": name, "cat": "control", "s": "t",
+                "pid": pid, "tid": tr.tid(replica, "control-plane"),
+                "ts": ts_us,
+                "args": {"trace": trace, "step": ev.get("step"), **attrs},
+            })
+
+    for replica, snap in (snapshots or {}).items():
+        for rec in snap.get("records", []):
+            tag = str(rec.get("tag", ""))
+            trace, sep, _ = tag.partition("|")
+            spans.extend(
+                _native_record_events(
+                    tr, replica, rec, trace if sep else None, base_us
+                )
+            )
+
+    # Flow arrows joining each trace id's spans across replicas/planes,
+    # in time order: start -> step... -> finish.
+    flow_events: List[Dict[str, Any]] = []
+    for trace_id, chain in flows.items():
+        if len(chain) < 2:
+            continue
+        chain.sort(key=lambda s: s["ts"])
+        fid = _flow_id(trace_id)
+        for i, span in enumerate(chain):
+            ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+            fe = {
+                "ph": ph, "name": trace_id, "cat": "trace-id", "id": fid,
+                "pid": span["pid"], "tid": span["tid"],
+                "ts": span["ts"] + span["dur"] / 2,
+            }
+            if ph == "f":
+                fe["bp"] = "e"
+            flow_events.append(fe)
+
+    return {
+        "traceEvents": tr.events + spans + flow_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"base_unix_s": base_s, "generator": "obs_trace.py"},
+    }
+
+
+def validate_trace(trace: Any) -> List[str]:
+    """Minimal structural validation of a Chrome trace document (stdlib
+    only — the CI gate must not depend on a jsonschema package). Returns
+    a list of problems; empty means valid."""
+    errs: List[str] = []
+    if not isinstance(trace, dict):
+        return ["document is not an object"]
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "i", "s", "t", "f", "b", "e"):
+            errs.append(f"{where}: bad ph {ph!r}")
+            continue
+        for field in ("pid", "tid"):
+            if not isinstance(ev.get(field), int):
+                errs.append(f"{where}: {field} not an int")
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errs.append(f"{where}: missing name")
+        if ph == "X":
+            for field in ("ts", "dur"):
+                v = ev.get(field)
+                if not isinstance(v, (int, float)):
+                    errs.append(f"{where}: {field} not a number")
+                elif field == "dur" and v < 0:
+                    errs.append(f"{where}: negative dur")
+        elif ph in ("i", "s", "t", "f"):
+            if not isinstance(ev.get("ts"), (int, float)):
+                errs.append(f"{where}: ts not a number")
+        elif ph == "M":
+            args = ev.get("args")
+            if not (isinstance(args, dict) and isinstance(args.get("name"), str)):
+                errs.append(f"{where}: metadata without args.name")
+        if len(errs) > 50:
+            errs.append("... (truncated)")
+            break
+    return errs
+
+
+def _parse_snapshot_arg(spec: str) -> Tuple[str, Dict[str, Any]]:
+    replica, _, path = spec.partition("=")
+    if not path:
+        raise argparse.ArgumentTypeError(
+            f"--snapshot wants replica=path, got {spec!r}"
+        )
+    with open(path) as fh:
+        return replica, json.load(fh)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+",
+                   help="journal files or directories of *.jsonl")
+    p.add_argument("-o", "--output", default="",
+                   help="write the trace here (default: stdout)")
+    p.add_argument("--snapshot", action="append", default=[],
+                   metavar="REPLICA=PATH",
+                   help="raw engine fr_snapshot JSON to merge, labeled "
+                        "with the replica it came from (repeatable)")
+    p.add_argument("--check", action="store_true",
+                   help="validate the generated trace; nonzero exit on "
+                        "schema problems")
+    args = p.parse_args(argv)
+
+    events = obs_report.load_events(args.paths)
+    if not events:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    snapshots = dict(_parse_snapshot_arg(s) for s in args.snapshot)
+    trace = build_trace(events, snapshots or None)
+
+    if args.check:
+        errs = validate_trace(trace)
+        if errs:
+            for e in errs:
+                print(f"invalid trace: {e}", file=sys.stderr)
+            return 2
+
+    out = json.dumps(trace)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(out)
+        n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        print(f"wrote {args.output}: {len(trace['traceEvents'])} events "
+              f"({n} spans)")
+    else:
+        sys.stdout.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Trace-lane smoke: a 2-replica ``TORCHFT_PG=native`` kill+heal mini-drill
+with the journal on, converted to a Chrome trace and schema-checked.
+
+Asserts the whole observability chain end-to-end: the Manager mints
+step-scoped trace ids, both replicas stamp the SAME id on their journal
+events, the native engine's flight records surface as per-peer stripe
+sub-tracks, the kill forces a new quorum generation (so the id set has at
+least two generations), and ``tools/obs_trace.py`` renders it all into a
+structurally valid ``trace_event`` document with quorum / heal /
+allreduce / commit spans. Run directly or via
+``bash tools/suite_gate.sh trace``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import obs_report  # noqa: E402
+import obs_trace  # noqa: E402
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
+from torchft_tpu.orchestration import (  # noqa: E402
+    ReplicaGroupRunner,
+    render_topology,
+)
+from torchft_tpu.orchestration.punisher import kill_one  # noqa: E402
+
+# Long enough that the kill (2 s in) lands mid-run with plenty of steps
+# left for the relaunch to rejoin and heal before the trainer finishes.
+STEPS = 150
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="obs_trace_smoke_")
+    journal_dir = os.path.join(workdir, "journal")
+    log_dir = os.path.join(workdir, "logs")
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=10000,
+        quorum_tick_ms=50, heartbeat_timeout_ms=3000,
+    )
+    specs = render_topology(
+        [
+            sys.executable, "-m", "torchft_tpu.orchestration.demo_trainer",
+            "--steps", str(STEPS), "--dim", "64", "--min-replicas", "2",
+            "--step-sleep", "0.05",
+        ],
+        num_replica_groups=2,
+        lighthouse_addr=lighthouse.address(),
+        env={"JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1",
+             "TORCHFT_PG": "native"},
+        journal_dir=journal_dir,
+    )
+    runner = ReplicaGroupRunner(specs, max_restarts=5, log_dir=log_dir)
+    t0 = time.time()
+    runner.start()
+    try:
+        time.sleep(2.0)
+        assert kill_one(runner) is not None, "punisher found nothing to kill"
+        ok = runner.run_until_done(timeout=240)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    assert ok, f"drill did not finish cleanly (logs in {log_dir})"
+    assert sum(runner.restarts.values()) >= 1, "kill did not force a restart"
+
+    events = obs_report.load_events([journal_dir])
+    assert events, f"no journal events under {journal_dir}"
+    trace = obs_trace.build_trace(events)
+    errs = obs_trace.validate_trace(trace)
+    assert not errs, f"invalid Chrome trace: {errs[:5]}"
+    out_path = os.path.join(workdir, "trace.json")
+    rc = obs_trace.main([journal_dir, "-o", out_path, "--check"])
+    assert rc == 0, f"obs_trace --check failed with rc={rc}"
+    assert os.path.getsize(out_path) > 0
+
+    evs = trace["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    for want in ("quorum", "heal", "allreduce", "commit"):
+        assert want in names, f"no {want!r} span in trace (have {names})"
+
+    # Both replicas present as processes, with native stripe sub-tracks.
+    pids = {e["pid"] for e in spans}
+    assert len(pids) >= 2, f"expected spans from 2 replicas, pids={pids}"
+    lane_tracks = [
+        e for e in evs
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+        and "stripe" in e["args"]["name"]
+    ]
+    assert lane_tracks, "no per-peer stripe sub-tracks in the trace"
+    native_spans = [e for e in spans if e.get("cat") == "native"]
+    assert native_spans, "no native engine flight-record spans"
+
+    # Trace-id correlation: at least one id joins spans on BOTH replicas,
+    # and the kill+heal produced more than one quorum generation.
+    by_trace: dict = {}
+    for e in spans:
+        tid = (e.get("args") or {}).get("trace")
+        if tid:
+            by_trace.setdefault(tid, set()).add(e["pid"])
+    assert by_trace, "no span carries a trace id"
+    shared = [t for t, ps in by_trace.items() if len(ps) >= 2]
+    assert shared, f"no trace id spans both replicas: {by_trace}"
+    quorum_gens = {t.split(".")[0] for t in by_trace}
+    assert len(quorum_gens) >= 2, (
+        f"kill+heal should span quorum generations, got {sorted(by_trace)}"
+    )
+
+    print(
+        f"trace smoke OK: {len(evs)} trace events, {len(spans)} spans, "
+        f"{len(by_trace)} trace ids ({len(shared)} cross-replica, "
+        f"generations={sorted(quorum_gens)}), "
+        f"{len(lane_tracks)} stripe tracks, wall={time.time() - t0:.1f}s\n"
+        f"trace written to {out_path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

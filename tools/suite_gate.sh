@@ -16,12 +16,21 @@
 #                                       # asserted through tools/obs_report.py
 #        bash tools/suite_gate.sh pg    # data-plane micro-bench: socket vs
 #                                       # native allreduce -> BENCH_PG_*.json
+#        bash tools/suite_gate.sh trace # flight-recorder/trace smoke:
+#                                       # 2-replica native kill+heal drill ->
+#                                       # obs_trace.py Chrome trace, schema-
+#                                       # checked with trace-id assertions
 set -u
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "obs" ]; then
   echo "== obs smoke: 2-replica journaled demo -> obs_report =="
   exec timeout 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+fi
+
+if [ "${1:-}" = "trace" ]; then
+  echo "== trace smoke: native kill+heal drill -> obs_trace Chrome trace =="
+  exec timeout 600 env JAX_PLATFORMS=cpu python tools/obs_trace_smoke.py
 fi
 
 if [ "${1:-}" = "pg" ]; then

@@ -195,9 +195,13 @@ struct CollectiveEngine::Waiter {
 // CollectiveEngine
 // ---------------------------------------------------------------------------
 
-CollectiveEngine::CollectiveEngine(int n_streams, int64_t pipeline_bytes)
+CollectiveEngine::CollectiveEngine(int n_streams, int64_t pipeline_bytes,
+                                   int fr_capacity)
     : n_streams_(std::max(1, n_streams)),
-      pipeline_bytes_(std::max<int64_t>(64 * 1024, pipeline_bytes)) {}
+      pipeline_bytes_(std::max<int64_t>(64 * 1024, pipeline_bytes)),
+      fr_cap_(std::max(0, fr_capacity)) {
+  if (fr_cap_ > 0) fr_ring_ = std::make_unique<FlightRec[]>(fr_cap_);
+}
 
 CollectiveEngine::~CollectiveEngine() {
   abort("engine destroyed");
@@ -240,6 +244,7 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
   world_ = world;
   results_.assign(world, {});
   peer_fds_.assign(world, {});
+  peer_counters_ = std::make_unique<PeerCounters[]>(world);
   if (world <= 1) {
     pool_ = std::make_unique<TaskPool>(1);
     return true;
@@ -339,9 +344,234 @@ void CollectiveEngine::stripe_range(uint64_t units, int s, uint64_t* off,
   *len = split_size(units, n_streams_, s);
 }
 
+// ---------------------------------------------------------------------------
+// Flight recorder
+// ---------------------------------------------------------------------------
+
+void CollectiveEngine::set_trace(const std::string& tag) {
+  std::lock_guard<std::mutex> lk(trace_mu_);
+  const size_t n = std::min(tag.size(), sizeof(trace_tag_) - 1);
+  memcpy(trace_tag_, tag.data(), n);
+  trace_tag_[n] = '\0';
+}
+
+FlightRec* CollectiveEngine::fr_begin(int32_t op_code, int32_t dtype,
+                                      int32_t red_op, uint64_t bytes) {
+  if (fr_cap_ <= 0) return nullptr;
+  const uint64_t seq = fr_seq_.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (seq > static_cast<uint64_t>(fr_cap_))
+    fr_dropped_.fetch_add(1, std::memory_order_relaxed);
+  FlightRec* rec = &fr_ring_[(seq - 1) % fr_cap_];
+  // seq=0 marks the slot torn while we reset it; a concurrent snapshot
+  // skips it instead of reporting a half-old half-new record.
+  rec->seq.store(0, std::memory_order_release);
+  rec->op = op_code;
+  rec->dtype = dtype;
+  rec->red_op = red_op;
+  rec->bytes = bytes;
+  rec->t_start_ns = now_realtime_ns();
+  rec->t_end_ns = 0;
+  rec->cause[0] = '\0';
+  {
+    std::lock_guard<std::mutex> lk(trace_mu_);
+    memcpy(rec->tag, trace_tag_, sizeof(rec->tag));
+  }
+  memset(rec->step_ns, 0, sizeof(rec->step_ns));
+  rec->nsteps.store(0, std::memory_order_relaxed);
+  rec->lane_n.store(0, std::memory_order_relaxed);
+  rec->status.store(0, std::memory_order_relaxed);
+  rec->seq.store(seq, std::memory_order_release);
+  return rec;
+}
+
+void CollectiveEngine::fr_end(FlightRec* rec, bool ok) {
+  if (rec == nullptr) return;
+  rec->t_end_ns = now_realtime_ns();
+  int32_t st = 1;
+  if (!ok) {
+    const std::string err = last_error();
+    const size_t n = std::min(err.size(), sizeof(rec->cause) - 1);
+    memcpy(rec->cause, err.data(), n);
+    rec->cause[n] = '\0';
+    if (aborted_.load())
+      st = 4;
+    else if (err.rfind("timeout", 0) == 0)
+      st = 3;
+    else
+      st = 2;
+  }
+  rec->status.store(st, std::memory_order_release);
+}
+
+void CollectiveEngine::fr_step(FlightRec* rec) {
+  if (rec == nullptr) return;
+  const uint32_t i = rec->nsteps.fetch_add(1, std::memory_order_relaxed);
+  if (i < kFrMaxSteps) rec->step_ns[i] = now_realtime_ns();
+}
+
+void CollectiveEngine::fr_job(FlightRec* rec, int peer, int stripe, int dir,
+                              uint64_t bytes, uint64_t t0_ns,
+                              uint64_t spins_before, uint64_t reduce_ns) {
+  const uint64_t t1 = now_realtime_ns();
+  const uint64_t spins = net_spin_count() - spins_before;
+  spin_total_.fetch_add(spins, std::memory_order_relaxed);
+  if (peer_counters_ && peer >= 0 && peer < world_) {
+    PeerCounters& pc = peer_counters_[peer];
+    if (dir == 0) {
+      pc.tx_bytes.fetch_add(bytes, std::memory_order_relaxed);
+      pc.tx_busy_ns.fetch_add(t1 - t0_ns, std::memory_order_relaxed);
+    } else {
+      pc.rx_bytes.fetch_add(bytes, std::memory_order_relaxed);
+      pc.rx_busy_ns.fetch_add(t1 - t0_ns, std::memory_order_relaxed);
+    }
+    pc.spins.fetch_add(spins, std::memory_order_relaxed);
+  }
+  if (rec == nullptr) return;
+  const uint32_t li = rec->lane_n.fetch_add(1, std::memory_order_relaxed);
+  if (li >= static_cast<uint32_t>(kFrMaxLanes)) return;
+  FlightLane& L = rec->lanes[li];
+  L.peer = static_cast<int16_t>(peer);
+  L.stripe = static_cast<int8_t>(stripe);
+  L.dir = static_cast<int8_t>(dir);
+  L.spins = static_cast<uint32_t>(spins);
+  L.bytes = bytes;
+  L.t0_ns = t0_ns;
+  L.t1_ns = t1;
+  L.reduce_ns = reduce_ns;
+}
+
+namespace {
+
+// Snapshot strings may be read torn (the ring wraps under the reader): keep
+// only printable ASCII so the emitted JSON always parses.
+std::string fr_sanitize(const char* s, size_t cap) {
+  std::string out;
+  for (size_t i = 0; i < cap && s[i] != '\0'; ++i) {
+    const unsigned char c = static_cast<unsigned char>(s[i]);
+    out += (c >= 0x20 && c < 0x7f) ? static_cast<char>(c) : '?';
+  }
+  return out;
+}
+
+const char* fr_op_name(int32_t op) {
+  switch (op) {
+    case 0:
+      return "allreduce";
+    case 1:
+      return "allreduce_q8";
+    case 2:
+      return "allgather";
+    case 3:
+      return "broadcast";
+  }
+  return "unknown";
+}
+
+const char* fr_status_name(int32_t st) {
+  switch (st) {
+    case 0:
+      return "in_flight";
+    case 1:
+      return "ok";
+    case 2:
+      return "error";
+    case 3:
+      return "timeout";
+    case 4:
+      return "aborted";
+  }
+  return "unknown";
+}
+
+const char* fr_dir_name(int8_t dir) {
+  return dir == 0 ? "send" : (dir == 1 ? "recv" : "recv_reduce");
+}
+
+Json fr_u64(uint64_t v) { return Json::of(static_cast<int64_t>(v)); }
+
+}  // namespace
+
+std::string CollectiveEngine::fr_snapshot(uint64_t since_seq) const {
+  Json root = Json::object();
+  const uint64_t hi = fr_seq_.load(std::memory_order_acquire);
+  root["seq"] = fr_u64(hi);
+  root["capacity"] = Json::of(fr_cap_);
+  root["dropped"] = fr_u64(fr_dropped_.load(std::memory_order_relaxed));
+  root["spin_total"] = fr_u64(spin_total_.load(std::memory_order_relaxed));
+  root["bytes_tx"] = fr_u64(bytes_tx_.load());
+  root["bytes_rx"] = fr_u64(bytes_rx_.load());
+  root["world"] = Json::of(world_);
+  root["n_streams"] = Json::of(n_streams_);
+  Json peers = Json::array();
+  if (peer_counters_) {
+    for (int p = 0; p < world_; ++p) {
+      if (p == rank_) continue;
+      const PeerCounters& pc = peer_counters_[p];
+      Json jp = Json::object();
+      jp["peer"] = Json::of(p);
+      jp["tx_bytes"] = fr_u64(pc.tx_bytes.load(std::memory_order_relaxed));
+      jp["rx_bytes"] = fr_u64(pc.rx_bytes.load(std::memory_order_relaxed));
+      jp["tx_busy_ns"] = fr_u64(pc.tx_busy_ns.load(std::memory_order_relaxed));
+      jp["rx_busy_ns"] = fr_u64(pc.rx_busy_ns.load(std::memory_order_relaxed));
+      jp["spins"] = fr_u64(pc.spins.load(std::memory_order_relaxed));
+      peers.push(std::move(jp));
+    }
+  }
+  root["peers"] = std::move(peers);
+  Json recs = Json::array();
+  if (fr_cap_ > 0 && hi > 0) {
+    const uint64_t lo0 = hi > static_cast<uint64_t>(fr_cap_)
+                             ? hi - static_cast<uint64_t>(fr_cap_)
+                             : 0;
+    for (uint64_t s = std::max(since_seq, lo0) + 1; s <= hi; ++s) {
+      const FlightRec& r = fr_ring_[(s - 1) % fr_cap_];
+      if (r.seq.load(std::memory_order_acquire) != s) continue;  // wrapped
+      Json jr = Json::object();
+      jr["seq"] = fr_u64(s);
+      jr["op"] = Json::of(fr_op_name(r.op));
+      jr["dtype"] = Json::of(r.dtype);
+      jr["red_op"] = Json::of(r.red_op);
+      jr["status"] =
+          Json::of(fr_status_name(r.status.load(std::memory_order_acquire)));
+      jr["bytes"] = fr_u64(r.bytes);
+      jr["t_start_ns"] = fr_u64(r.t_start_ns);
+      jr["t_end_ns"] = fr_u64(r.t_end_ns);
+      jr["tag"] = Json::of(fr_sanitize(r.tag, sizeof(r.tag)));
+      jr["cause"] = Json::of(fr_sanitize(r.cause, sizeof(r.cause)));
+      const uint32_t nsteps = std::min<uint32_t>(
+          r.nsteps.load(std::memory_order_relaxed), kFrMaxSteps);
+      Json steps = Json::array();
+      for (uint32_t i = 0; i < nsteps; ++i) steps.push(fr_u64(r.step_ns[i]));
+      jr["step_ns"] = std::move(steps);
+      const uint32_t claimed = r.lane_n.load(std::memory_order_relaxed);
+      const uint32_t nlanes = std::min<uint32_t>(claimed, kFrMaxLanes);
+      jr["lanes_dropped"] = Json::of(static_cast<int64_t>(claimed - nlanes));
+      Json lanes = Json::array();
+      for (uint32_t i = 0; i < nlanes; ++i) {
+        const FlightLane& L = r.lanes[i];
+        Json jl = Json::object();
+        jl["peer"] = Json::of(static_cast<int>(L.peer));
+        jl["stripe"] = Json::of(static_cast<int>(L.stripe));
+        jl["dir"] = Json::of(fr_dir_name(L.dir));
+        jl["spins"] = Json::of(static_cast<int64_t>(L.spins));
+        jl["bytes"] = fr_u64(L.bytes);
+        jl["t0_ns"] = fr_u64(L.t0_ns);
+        jl["t1_ns"] = fr_u64(L.t1_ns);
+        jl["reduce_ns"] = fr_u64(L.reduce_ns);
+        lanes.push(std::move(jl));
+      }
+      jr["lanes"] = std::move(lanes);
+      recs.push(std::move(jr));
+    }
+  }
+  root["records"] = std::move(recs);
+  return root.dump();
+}
+
 void CollectiveEngine::send_stripes(int peer, const char* data,
                                     uint64_t nbytes, uint64_t esize,
-                                    int64_t deadline_ms, Waiter* w) {
+                                    int64_t deadline_ms, Waiter* w,
+                                    FlightRec* rec) {
   if (nbytes == 0) return;
   const uint64_t units = nbytes / esize;
   for (int s = 0; s < n_streams_; ++s) {
@@ -352,11 +582,14 @@ void CollectiveEngine::send_stripes(int peer, const char* data,
     const char* p = data + uoff * esize;
     const uint64_t len = ulen * esize;
     w->add(1);
-    pool_->submit([this, fd, p, len, deadline_ms, w] {
+    pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
+      const uint64_t t0 = now_realtime_ns();
+      const uint64_t sp0 = net_spin_count();
       const int64_t remaining = deadline_ms - now_ms();
       const bool ok = remaining > 0 && !aborted_.load() &&
                       write_all(fd, p, len, remaining);
       if (ok) bytes_tx_ += len;
+      fr_job(rec, peer, s, /*dir=*/0, ok ? len : 0, t0, sp0, 0);
       w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
               "stripe send failed");
     });
@@ -365,7 +598,7 @@ void CollectiveEngine::send_stripes(int peer, const char* data,
 
 void CollectiveEngine::recv_stripes(int peer, char* data, uint64_t nbytes,
                                     uint64_t esize, int64_t deadline_ms,
-                                    Waiter* w) {
+                                    Waiter* w, FlightRec* rec) {
   if (nbytes == 0) return;
   const uint64_t units = nbytes / esize;
   for (int s = 0; s < n_streams_; ++s) {
@@ -376,11 +609,14 @@ void CollectiveEngine::recv_stripes(int peer, char* data, uint64_t nbytes,
     char* p = data + uoff * esize;
     const uint64_t len = ulen * esize;
     w->add(1);
-    pool_->submit([this, fd, p, len, deadline_ms, w] {
+    pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
+      const uint64_t t0 = now_realtime_ns();
+      const uint64_t sp0 = net_spin_count();
       const int64_t remaining = deadline_ms - now_ms();
       const bool ok = remaining > 0 && !aborted_.load() &&
                       read_exact(fd, p, len, remaining);
       if (ok) bytes_rx_ += len;
+      fr_job(rec, peer, s, /*dir=*/1, ok ? len : 0, t0, sp0, 0);
       w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
               "stripe recv failed");
     });
@@ -396,9 +632,11 @@ namespace {
 template <typename T>
 bool recv_reduce_stripe(int fd, T* dst, uint64_t elems, int32_t op,
                         uint64_t block_elems, int64_t deadline_ms,
-                        std::atomic<uint64_t>* bytes_rx) {
+                        std::atomic<uint64_t>* bytes_rx,
+                        uint64_t* reduce_ns_out) {
   std::vector<T> scratch(std::min(elems, block_elems));
   uint64_t done = 0;
+  uint64_t reduce_ns = 0;
   while (done < elems) {
     const uint64_t m = std::min(block_elems, elems - done);
     const int64_t remaining = deadline_ms - now_ms();
@@ -407,9 +645,14 @@ bool recv_reduce_stripe(int fd, T* dst, uint64_t elems, int32_t op,
                     m * sizeof(T), remaining))
       return false;
     *bytes_rx += m * sizeof(T);
+    // Per-chunk wire-vs-reduce split for the flight recorder: the lane's
+    // total minus reduce_ns is time blocked on the wire.
+    const uint64_t r0 = now_realtime_ns();
     reduce_into<T>(dst + done, scratch.data(), m, op);
+    reduce_ns += now_realtime_ns() - r0;
     done += m;
   }
+  if (reduce_ns_out != nullptr) *reduce_ns_out = reduce_ns;
   return true;
 }
 
@@ -417,7 +660,8 @@ bool recv_reduce_stripe(int fd, T* dst, uint64_t elems, int32_t op,
 
 void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
                                            int32_t dtype, int32_t op,
-                                           int64_t deadline_ms, Waiter* w) {
+                                           int64_t deadline_ms, Waiter* w,
+                                           FlightRec* rec) {
   if (count == 0) return;
   const uint64_t esize = dtype_size(dtype);
   const uint64_t block_elems =
@@ -428,33 +672,38 @@ void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
     if (ulen == 0) continue;
     const int fd = peer_fds_[peer][s];
     w->add(1);
-    pool_->submit([this, fd, dst, uoff, ulen, dtype, op, block_elems,
-                   deadline_ms, w] {
+    pool_->submit([this, peer, s, fd, dst, uoff, ulen, esize, dtype, op,
+                   block_elems, deadline_ms, w, rec] {
+      const uint64_t t0 = now_realtime_ns();
+      const uint64_t sp0 = net_spin_count();
+      uint64_t reduce_ns = 0;
       bool ok = false;
       if (!aborted_.load()) {
         switch (dtype) {
           case TFT_DT_F32:
             ok = recv_reduce_stripe<float>(fd, static_cast<float*>(dst) + uoff,
                                            ulen, op, block_elems, deadline_ms,
-                                           &bytes_rx_);
+                                           &bytes_rx_, &reduce_ns);
             break;
           case TFT_DT_F64:
             ok = recv_reduce_stripe<double>(
                 fd, static_cast<double*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_);
+                deadline_ms, &bytes_rx_, &reduce_ns);
             break;
           case TFT_DT_I32:
             ok = recv_reduce_stripe<int32_t>(
                 fd, static_cast<int32_t*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_);
+                deadline_ms, &bytes_rx_, &reduce_ns);
             break;
           case TFT_DT_I64:
             ok = recv_reduce_stripe<int64_t>(
                 fd, static_cast<int64_t*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_);
+                deadline_ms, &bytes_rx_, &reduce_ns);
             break;
         }
       }
+      fr_job(rec, peer, s, /*dir=*/2, ok ? ulen * esize : 0, t0, sp0,
+             reduce_ns);
       w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
               "stripe recv-reduce failed");
     });
@@ -463,7 +712,8 @@ void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
 
 template <typename T>
 bool CollectiveEngine::ring_allreduce_t(T* data, uint64_t count, int32_t dtype,
-                                        int32_t op, int64_t deadline_ms) {
+                                        int32_t op, int64_t deadline_ms,
+                                        FlightRec* rec) {
   const int ws = world_, r = rank_;
   const int right = (r + 1) % ws;
   const int left = (r - 1 + ws) % ws;
@@ -479,13 +729,14 @@ bool CollectiveEngine::ring_allreduce_t(T* data, uint64_t count, int32_t dtype,
     const int ri = ring_idx(r - step - 1);
     Waiter w;
     send_stripes(right, reinterpret_cast<const char*>(data + coff(si)),
-                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w);
+                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w, rec);
     recv_reduce_stripes(left, data + coff(ri), clen(ri), dtype, op,
-                        deadline_ms, &w);
+                        deadline_ms, &w, rec);
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") + std::string(
                       "allreduce reduce-scatter step ") +
                   std::to_string(step) + ": " + w.err);
+    fr_step(rec);
   }
   // Allgather: circulate the fully reduced chunks.
   for (int step = 0; step < ws - 1; ++step) {
@@ -493,13 +744,14 @@ bool CollectiveEngine::ring_allreduce_t(T* data, uint64_t count, int32_t dtype,
     const int ri = ring_idx(r - step);
     Waiter w;
     send_stripes(right, reinterpret_cast<const char*>(data + coff(si)),
-                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w);
+                 clen(si) * sizeof(T), sizeof(T), deadline_ms, &w, rec);
     recv_stripes(left, reinterpret_cast<char*>(data + coff(ri)),
-                 clen(ri) * sizeof(T), sizeof(T), deadline_ms, &w);
+                 clen(ri) * sizeof(T), sizeof(T), deadline_ms, &w, rec);
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
                   std::string("allreduce allgather step ") +
                   std::to_string(step) + ": " + w.err);
+    fr_step(rec);
   }
   return true;
 }
@@ -510,21 +762,31 @@ bool CollectiveEngine::allreduce(void* data, uint64_t count, int32_t dtype,
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
   const int64_t deadline = now_ms() + timeout_ms;
+  FlightRec* rec = fr_begin(0, dtype, op, count * dtype_size(dtype));
+  bool ok = false;
   switch (dtype) {
     case TFT_DT_F32:
-      return ring_allreduce_t<float>(static_cast<float*>(data), count, dtype,
-                                     op, deadline);
+      ok = ring_allreduce_t<float>(static_cast<float*>(data), count, dtype,
+                                   op, deadline, rec);
+      break;
     case TFT_DT_F64:
-      return ring_allreduce_t<double>(static_cast<double*>(data), count, dtype,
-                                      op, deadline);
+      ok = ring_allreduce_t<double>(static_cast<double*>(data), count, dtype,
+                                    op, deadline, rec);
+      break;
     case TFT_DT_I32:
-      return ring_allreduce_t<int32_t>(static_cast<int32_t*>(data), count,
-                                       dtype, op, deadline);
+      ok = ring_allreduce_t<int32_t>(static_cast<int32_t*>(data), count,
+                                     dtype, op, deadline, rec);
+      break;
     case TFT_DT_I64:
-      return ring_allreduce_t<int64_t>(static_cast<int64_t*>(data), count,
-                                       dtype, op, deadline);
+      ok = ring_allreduce_t<int64_t>(static_cast<int64_t*>(data), count,
+                                     dtype, op, deadline, rec);
+      break;
+    default:
+      ok = fail("allreduce: unsupported dtype code " + std::to_string(dtype));
+      break;
   }
-  return fail("allreduce: unsupported dtype code " + std::to_string(dtype));
+  fr_end(rec, ok);
+  return ok;
 }
 
 bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
@@ -532,6 +794,14 @@ bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
   if (world_ <= 1) return true;
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
+  FlightRec* rec = fr_begin(1, TFT_DT_F32, TFT_OP_SUM, count * sizeof(float));
+  const bool ok = allreduce_q8_inner(data, count, timeout_ms, rec);
+  fr_end(rec, ok);
+  return ok;
+}
+
+bool CollectiveEngine::allreduce_q8_inner(float* data, uint64_t count,
+                                          int64_t timeout_ms, FlightRec* rec) {
   const int64_t deadline = now_ms() + timeout_ms;
   const int ws = world_, me = rank_;
   const uint64_t blocks = (count + kQBlock - 1) / kQBlock;
@@ -593,13 +863,14 @@ bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
       if (p == me) continue;
       out[p] = pack(scales.data() + boff(p), q.data() + boff(p) * kQBlock,
                     blen(p));
-      send_stripes(p, out[p].data(), out[p].size(), 1, deadline, &w);
+      send_stripes(p, out[p].data(), out[p].size(), 1, deadline, &w, rec);
       in[p].resize(my_blocks * (sizeof(float) + kQBlock));
-      recv_stripes(p, in[p].data(), in[p].size(), 1, deadline, &w);
+      recv_stripes(p, in[p].data(), in[p].size(), 1, deadline, &w, rec);
     }
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
                   std::string("q8 alltoall: ") + w.err);
+    fr_step(rec);
   }
 
   // Local fp32 reduce of my chunk, rank order 0..ws-1 (alltoall output
@@ -623,13 +894,15 @@ bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
     Waiter w;
     for (int p = 0; p < ws; ++p) {
       if (p == me) continue;
-      send_stripes(p, mine.data(), mine.size(), 1, deadline, &w);
+      send_stripes(p, mine.data(), mine.size(), 1, deadline, &w, rec);
       gathered[p].resize(blen(p) * (sizeof(float) + kQBlock));
-      recv_stripes(p, gathered[p].data(), gathered[p].size(), 1, deadline, &w);
+      recv_stripes(p, gathered[p].data(), gathered[p].size(), 1, deadline, &w,
+                   rec);
     }
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
                   std::string("q8 allgather: ") + w.err);
+    fr_step(rec);
   }
 
   // Decode the assembled (q_final, s_final) straight into the caller's
@@ -657,6 +930,15 @@ bool CollectiveEngine::allgather(const std::string& meta, const void* data,
   if (world_ <= 1) return true;
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
+  FlightRec* rec = fr_begin(2, -1, -1, nbytes);
+  const bool ok = allgather_inner(meta, data, nbytes, timeout_ms, rec);
+  fr_end(rec, ok);
+  return ok;
+}
+
+bool CollectiveEngine::allgather_inner(const std::string& meta,
+                                       const void* data, uint64_t nbytes,
+                                       int64_t timeout_ms, FlightRec* rec) {
   const int64_t deadline = now_ms() + timeout_ms;
   // Phase A: fixed-size headers + meta on stripe 0 of every peer link. The
   // barrier before phase B guarantees the header precedes stripe-0 payload
@@ -711,6 +993,7 @@ bool CollectiveEngine::allgather(const std::string& meta, const void* data,
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
                   std::string("allgather headers: ") + w.err);
+    fr_step(rec);
   }
   // Phase B: striped payloads, all peers in full flight.
   {
@@ -718,14 +1001,15 @@ bool CollectiveEngine::allgather(const std::string& meta, const void* data,
     for (int p = 0; p < world_; ++p) {
       if (p == rank_) continue;
       send_stripes(p, static_cast<const char*>(data), nbytes, 1, deadline,
-                   &w);
+                   &w, rec);
       recv_stripes(p, results_[p].second.empty() ? nullptr
                                                  : &results_[p].second[0],
-                   results_[p].second.size(), 1, deadline, &w);
+                   results_[p].second.size(), 1, deadline, &w, rec);
     }
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
                   std::string("allgather payloads: ") + w.err);
+    fr_step(rec);
   }
   return true;
 }
@@ -739,6 +1023,16 @@ bool CollectiveEngine::broadcast(const std::string& meta, const void* data,
   if (pool_ == nullptr) return fail("engine not connected");
   if (root < 0 || root >= world_)
     return fail("broadcast: bad root " + std::to_string(root));
+  FlightRec* rec = fr_begin(3, -1, -1, nbytes);
+  const bool ok = broadcast_inner(meta, data, nbytes, root, timeout_ms, rec);
+  fr_end(rec, ok);
+  return ok;
+}
+
+bool CollectiveEngine::broadcast_inner(const std::string& meta,
+                                       const void* data, uint64_t nbytes,
+                                       int root, int64_t timeout_ms,
+                                       FlightRec* rec) {
   const int64_t deadline = now_ms() + timeout_ms;
   if (rank_ == root) {
     char hdr[12];
@@ -773,7 +1067,7 @@ bool CollectiveEngine::broadcast(const std::string& meta, const void* data,
     for (int p = 0; p < world_; ++p) {
       if (p == rank_) continue;
       send_stripes(p, static_cast<const char*>(data), nbytes, 1, deadline,
-                   &w);
+                   &w, rec);
     }
     if (!w.wait_all())
       return fail((w.timed_out ? "timeout: " : "") +
@@ -808,7 +1102,7 @@ bool CollectiveEngine::broadcast(const std::string& meta, const void* data,
   recv_stripes(root,
                results_[root].second.empty() ? nullptr
                                              : &results_[root].second[0],
-               peer_nbytes, 1, deadline, &w);
+               peer_nbytes, 1, deadline, &w, rec);
   if (!w.wait_all())
     return fail((w.timed_out ? "timeout: " : "") +
                 std::string("broadcast payload: ") + w.err);
@@ -836,8 +1130,9 @@ int32_t rc_for(tft::CollectiveEngine* e, bool ok) {
 
 extern "C" {
 
-void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes) {
-  return new tft::CollectiveEngine(n_streams, pipeline_bytes);
+void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes,
+                      int32_t fr_capacity) {
+  return new tft::CollectiveEngine(n_streams, pipeline_bytes, fr_capacity);
 }
 
 void tft_coll_destroy(void* h) { delete eng(h); }
@@ -923,6 +1218,23 @@ void tft_coll_last_error(void* h, char* out, int64_t cap) {
   const int64_t n = std::min<int64_t>(cap - 1, e.size());
   memcpy(out, e.data(), n);
   out[n] = '\0';
+}
+
+void tft_coll_set_trace(void* h, const char* tag) {
+  eng(h)->set_trace(tag ? tag : "");
+}
+
+uint64_t tft_coll_fr_seq(void* h) { return eng(h)->fr_seq(); }
+
+int64_t tft_coll_fr_snapshot(void* h, uint64_t since_seq, char* out,
+                             int64_t cap) {
+  const std::string snap = eng(h)->fr_snapshot(since_seq);
+  if (out != nullptr && cap > 0) {
+    const int64_t n = std::min<int64_t>(cap - 1, snap.size());
+    memcpy(out, snap.data(), n);
+    out[n] = '\0';
+  }
+  return static_cast<int64_t>(snap.size());
 }
 
 }  // extern "C"

@@ -63,6 +63,61 @@ enum : int32_t {
   TFT_OP_MIN = 2,
 };
 
+// ---------------------------------------------------------------------------
+// Flight recorder: a fixed-size ring of per-collective records written on the
+// hot path with no allocation and no locks. One collective runs at a time per
+// engine, so a record has a single writer for its scalar fields; the striped
+// transfer jobs claim disjoint lane slots via one fetch_add each. Snapshots
+// (fr_snapshot) read the ring concurrently: records whose seq no longer
+// matches their slot (wrapped mid-read) are skipped, in-flight records are
+// reported as such — a torn lane costs a garbage number in a diagnostic
+// record, never memory unsafety.
+// ---------------------------------------------------------------------------
+
+constexpr int kFrTagLen = 64;
+constexpr int kFrCauseLen = 96;
+constexpr int kFrMaxLanes = 32;  // (peer, stripe, direction) legs per record
+constexpr int kFrMaxSteps = 16;  // ring-step completion stamps per record
+
+// One striped transfer leg. Written by exactly one pool job.
+struct FlightLane {
+  int16_t peer = -1;
+  int8_t stripe = 0;
+  int8_t dir = 0;          // 0 = send, 1 = recv, 2 = recv-reduce
+  uint32_t spins = 0;      // MSG_DONTWAIT misses (EAGAIN -> poll) in this leg
+  uint64_t bytes = 0;
+  uint64_t t0_ns = 0;      // CLOCK_REALTIME, aligns with journal time.time()
+  uint64_t t1_ns = 0;
+  uint64_t reduce_ns = 0;  // recv-reduce only: ns folding blocks into dst
+};
+
+struct FlightRec {
+  std::atomic<uint64_t> seq{0};    // 1-based; 0 = slot never written
+  int32_t op = 0;                  // 0 allreduce 1 q8 2 allgather 3 broadcast
+  int32_t dtype = -1;
+  int32_t red_op = -1;
+  std::atomic<int32_t> status{0};  // 0 in-flight 1 ok 2 error 3 timeout 4 abort
+  uint64_t bytes = 0;
+  uint64_t t_start_ns = 0;
+  uint64_t t_end_ns = 0;
+  char tag[kFrTagLen] = {0};       // trace tag in force when the op started
+  char cause[kFrCauseLen] = {0};   // abort/poison/error cause on failure
+  std::atomic<uint32_t> nsteps{0};
+  uint64_t step_ns[kFrMaxSteps] = {0};  // per-chunk ring-step completion
+  std::atomic<uint32_t> lane_n{0};      // lanes claimed (may exceed kFrMaxLanes)
+  FlightLane lanes[kFrMaxLanes];
+};
+
+// Cumulative per-peer link counters, always on (plain atomic adds): feed the
+// Prometheus exporter's per-peer bandwidth gauges even when the ring is off.
+struct PeerCounters {
+  std::atomic<uint64_t> tx_bytes{0};
+  std::atomic<uint64_t> rx_bytes{0};
+  std::atomic<uint64_t> tx_busy_ns{0};  // summed over stripe jobs (overlapping)
+  std::atomic<uint64_t> rx_busy_ns{0};
+  std::atomic<uint64_t> spins{0};
+};
+
 // Fixed-size worker pool for concurrent striped send/recv jobs. Sized so
 // every stripe to and from every peer can progress at once — a smaller pool
 // could fill up with blocked senders and deadlock the mesh.
@@ -83,7 +138,9 @@ class TaskPool {
 
 class CollectiveEngine {
  public:
-  CollectiveEngine(int n_streams, int64_t pipeline_bytes);
+  // fr_capacity: flight-recorder ring slots; 0 disables recording (the
+  // per-peer counters stay on either way).
+  CollectiveEngine(int n_streams, int64_t pipeline_bytes, int fr_capacity = 0);
   ~CollectiveEngine();
 
   // Binds the data-plane listener. Returns the port, or -1 (last_error set).
@@ -120,6 +177,18 @@ class CollectiveEngine {
   uint64_t bytes_rx() const { return bytes_rx_.load(); }
   std::string last_error() const;
 
+  // -- flight recorder ----------------------------------------------------
+  // Tag stamped onto every subsequent record (trace id + collective tag,
+  // e.g. "q3.s17|c4"). Callable between collectives from any thread.
+  void set_trace(const std::string& tag);
+  // Highest record seq allocated so far (0 if recording is off/idle).
+  uint64_t fr_seq() const { return fr_seq_.load(); }
+  // Records evicted by ring wrap since creation.
+  uint64_t fr_dropped() const { return fr_dropped_.load(); }
+  // JSON snapshot of records with seq > since_seq plus cumulative counters.
+  // Safe to call from any thread while a collective is in flight.
+  std::string fr_snapshot(uint64_t since_seq) const;
+
  private:
   struct Waiter;
 
@@ -134,18 +203,39 @@ class CollectiveEngine {
   // Enqueue striped transfer jobs against `peer`; each job reports into *w.
   // `esize` keeps stripe boundaries on element boundaries (both ends must
   // pass the same esize or the slices would interleave mid-element).
+  // `rec` (nullable) collects per-stripe flight-recorder lanes.
   void send_stripes(int peer, const char* data, uint64_t nbytes,
-                    uint64_t esize, int64_t deadline_ms, Waiter* w);
+                    uint64_t esize, int64_t deadline_ms, Waiter* w,
+                    FlightRec* rec = nullptr);
   void recv_stripes(int peer, char* data, uint64_t nbytes, uint64_t esize,
-                    int64_t deadline_ms, Waiter* w);
+                    int64_t deadline_ms, Waiter* w, FlightRec* rec = nullptr);
   // Striped receive that reduces into dst in pipeline_bytes sub-blocks
   // (dst[i] = dst[i] OP incoming[i]) instead of storing raw bytes.
   void recv_reduce_stripes(int peer, void* dst, uint64_t count, int32_t dtype,
-                           int32_t op, int64_t deadline_ms, Waiter* w);
+                           int32_t op, int64_t deadline_ms, Waiter* w,
+                           FlightRec* rec = nullptr);
 
   template <typename T>
   bool ring_allreduce_t(T* data, uint64_t count, int32_t dtype, int32_t op,
-                        int64_t deadline_ms);
+                        int64_t deadline_ms, FlightRec* rec);
+
+  bool allreduce_q8_inner(float* data, uint64_t count, int64_t timeout_ms,
+                          FlightRec* rec);
+  bool allgather_inner(const std::string& meta, const void* data,
+                       uint64_t nbytes, int64_t timeout_ms, FlightRec* rec);
+  bool broadcast_inner(const std::string& meta, const void* data,
+                       uint64_t nbytes, int root, int64_t timeout_ms,
+                       FlightRec* rec);
+
+  // Flight-recorder plumbing (all no-ops when recording is off / rec null).
+  FlightRec* fr_begin(int32_t op_code, int32_t dtype, int32_t red_op,
+                      uint64_t bytes);
+  void fr_end(FlightRec* rec, bool ok);
+  void fr_step(FlightRec* rec);  // stamp the next ring-step completion
+  // Completion of one stripe job: updates the per-peer counters and, when
+  // recording, claims a lane on `rec`.
+  void fr_job(FlightRec* rec, int peer, int stripe, int dir, uint64_t bytes,
+              uint64_t t0_ns, uint64_t spins_before, uint64_t reduce_ns);
 
   int n_streams_;
   int64_t pipeline_bytes_;
@@ -161,6 +251,17 @@ class CollectiveEngine {
   std::atomic<uint64_t> bytes_rx_{0};
   mutable std::mutex err_mu_;
   std::string last_error_;
+
+  // Flight recorder state. The ring is a raw array (not std::vector) because
+  // FlightRec holds atomics and is neither copyable nor movable.
+  int fr_cap_ = 0;
+  std::unique_ptr<FlightRec[]> fr_ring_;
+  std::atomic<uint64_t> fr_seq_{0};
+  std::atomic<uint64_t> fr_dropped_{0};
+  std::atomic<uint64_t> spin_total_{0};
+  std::unique_ptr<PeerCounters[]> peer_counters_;  // sized world_ at connect
+  mutable std::mutex trace_mu_;
+  char trace_tag_[kFrTagLen] = {0};
 };
 
 }  // namespace tft
@@ -170,7 +271,9 @@ class CollectiveEngine {
 // 0 = ok, 1 = error (see tft_coll_last_error), 2 = timeout.
 // ---------------------------------------------------------------------------
 extern "C" {
-void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes);
+// fr_capacity: flight-recorder ring slots (0 = recording off).
+void* tft_coll_create(int32_t n_streams, int64_t pipeline_bytes,
+                      int32_t fr_capacity);
 void tft_coll_destroy(void* h);
 int32_t tft_coll_listen(void* h, const char* host);  // port or -1
 // peers_json: JSON array of "host:port", one per rank (self ignored).
@@ -193,4 +296,14 @@ uint64_t tft_coll_bytes_tx(void* h);
 uint64_t tft_coll_bytes_rx(void* h);
 // Copies the last error into out (NUL-terminated, truncated to cap).
 void tft_coll_last_error(void* h, char* out, int64_t cap);
+// Tag stamped onto subsequent flight records (trace id + collective tag).
+void tft_coll_set_trace(void* h, const char* tag);
+// Highest flight-record seq allocated so far.
+uint64_t tft_coll_fr_seq(void* h);
+// JSON snapshot of flight records with seq > since_seq plus engine counters.
+// Returns the full serialized length (excluding NUL); writes up to cap-1
+// bytes plus a NUL when cap > 0 — callers re-call with a larger buffer when
+// the return value >= cap. Safe concurrently with an in-flight collective.
+int64_t tft_coll_fr_snapshot(void* h, uint64_t since_seq, char* out,
+                             int64_t cap);
 }

@@ -855,11 +855,13 @@ static void test_drain_all_reaches_heartbeat_only_replica() {
 // --------------------------------------------------------------------------
 
 static std::vector<std::unique_ptr<CollectiveEngine>> engine_mesh(
-    int ws, int streams, int64_t pipeline_bytes = 1 << 20) {
+    int ws, int streams, int64_t pipeline_bytes = 1 << 20,
+    int fr_capacity = 0) {
   std::vector<std::unique_ptr<CollectiveEngine>> es;
   std::vector<std::string> addrs(ws);
   for (int i = 0; i < ws; ++i) {
-    es.push_back(std::make_unique<CollectiveEngine>(streams, pipeline_bytes));
+    es.push_back(std::make_unique<CollectiveEngine>(streams, pipeline_bytes,
+                                                    fr_capacity));
     int p = es[i]->listen("127.0.0.1");
     CHECK(p > 0);
     addrs[i] = "127.0.0.1:" + std::to_string(p);
@@ -1033,6 +1035,111 @@ static void test_native_allgather_broadcast() {
   }
 }
 
+static void test_native_flight_recorder() {
+  const int ws = 2;
+  const int cap = 4;
+  auto es = engine_mesh(ws, 2, 1 << 20, cap);
+  for (int r = 0; r < ws; ++r) es[r]->set_trace("q1.s1|c0");
+  // Run more collectives than the ring holds: the oldest must be evicted
+  // (dropped counter), the newest cap records must survive with their seqs.
+  const int n_ops = 6;
+  const uint64_t n = 4096;
+  for (int i = 0; i < n_ops; ++i) {
+    std::vector<std::vector<float>> bufs(ws);
+    std::vector<int> oks(ws, 0);
+    std::vector<std::thread> ts;
+    for (int r = 0; r < ws; ++r) bufs[r].assign(n, 1.0f * (r + 1));
+    for (int r = 0; r < ws; ++r)
+      ts.emplace_back([&, r] {
+        oks[r] = es[r]->allreduce(bufs[r].data(), n, TFT_DT_F32, TFT_OP_SUM,
+                                  8000);
+      });
+    for (auto& t : ts) t.join();
+    for (int r = 0; r < ws; ++r) CHECK(oks[r]);
+  }
+  CHECK_EQ(static_cast<long long>(es[0]->fr_seq()), n_ops);
+  CHECK_EQ(static_cast<long long>(es[0]->fr_dropped()), n_ops - cap);
+  Json snap;
+  CHECK(Json::parse(es[0]->fr_snapshot(0), &snap));
+  CHECK_EQ(snap.get("seq").as_int(), n_ops);
+  CHECK_EQ(snap.get("capacity").as_int(), cap);
+  CHECK_EQ(snap.get("dropped").as_int(), n_ops - cap);
+  const auto& recs = snap.get("records").arr;
+  CHECK_EQ(static_cast<long long>(recs.size()), cap);
+  for (size_t i = 0; i < recs.size(); ++i) {
+    const Json& r = recs[i];
+    // Surviving seqs are the newest `cap`: n_ops-cap+1 .. n_ops, in order.
+    CHECK_EQ(r.get("seq").as_int(),
+             static_cast<int64_t>(n_ops - cap + 1 + i));
+    CHECK(r.get("op").as_str() == "allreduce");
+    CHECK(r.get("status").as_str() == "ok");
+    CHECK(r.get("tag").as_str() == "q1.s1|c0");
+    CHECK_EQ(r.get("bytes").as_int(), static_cast<int64_t>(n * 4));
+    CHECK(r.get("t_end_ns").as_int() >= r.get("t_start_ns").as_int());
+    // ws=2 ring: 1 reduce-scatter + 1 allgather step stamp.
+    CHECK_EQ(static_cast<long long>(r.get("step_ns").arr.size()), 2);
+    CHECK(!r.get("lanes").arr.empty());
+    bool saw_reduce = false;
+    for (const auto& lane : r.get("lanes").arr) {
+      CHECK_EQ(lane.get("peer").as_int(), 1);
+      CHECK(lane.get("t1_ns").as_int() >= lane.get("t0_ns").as_int());
+      if (lane.get("dir").as_str() == "recv_reduce") saw_reduce = true;
+    }
+    CHECK(saw_reduce);
+  }
+  // Per-peer counters present and plausible.
+  CHECK_EQ(static_cast<long long>(snap.get("peers").arr.size()), ws - 1);
+  CHECK(snap.get("peers").arr[0].get("tx_bytes").as_int() > 0);
+  CHECK(snap.get("peers").arr[0].get("rx_bytes").as_int() > 0);
+  // Incremental drain: since_seq = seq returns no records.
+  Json empty_snap;
+  CHECK(Json::parse(es[0]->fr_snapshot(es[0]->fr_seq()), &empty_snap));
+  CHECK(empty_snap.get("records").arr.empty());
+
+  // Snapshot is safe while a collective is in flight: hammer it from a
+  // second thread during allreduces; every snapshot must stay parseable.
+  std::atomic<bool> stop{false};
+  std::atomic<int> parsed{0};
+  std::thread sampler([&] {
+    while (!stop.load()) {
+      Json s;
+      if (Json::parse(es[0]->fr_snapshot(0), &s)) parsed.fetch_add(1);
+    }
+  });
+  for (int i = 0; i < 10; ++i) {
+    std::vector<std::vector<float>> bufs(ws);
+    std::vector<std::thread> ts;
+    for (int r = 0; r < ws; ++r) bufs[r].assign(1 << 16, 2.0f);
+    for (int r = 0; r < ws; ++r)
+      ts.emplace_back([&, r] {
+        es[r]->allreduce(bufs[r].data(), bufs[r].size(), TFT_DT_F32,
+                         TFT_OP_SUM, 8000);
+      });
+    for (auto& t : ts) t.join();
+  }
+  stop.store(true);
+  sampler.join();
+  CHECK(parsed.load() > 0);
+
+  // Recording off (capacity 0): no records, snapshot still well-formed.
+  auto off = engine_mesh(ws, 2);
+  std::vector<std::vector<float>> bufs(ws);
+  std::vector<std::thread> ts;
+  for (int r = 0; r < ws; ++r) bufs[r].assign(256, 1.0f);
+  for (int r = 0; r < ws; ++r)
+    ts.emplace_back([&, r] {
+      off[r]->allreduce(bufs[r].data(), bufs[r].size(), TFT_DT_F32, TFT_OP_SUM,
+                        8000);
+    });
+  for (auto& t : ts) t.join();
+  CHECK_EQ(static_cast<long long>(off[0]->fr_seq()), 0);
+  Json off_snap;
+  CHECK(Json::parse(off[0]->fr_snapshot(0), &off_snap));
+  CHECK(off_snap.get("records").arr.empty());
+  // The always-on per-peer counters still tick with the ring off.
+  CHECK(off_snap.get("peers").arr[0].get("tx_bytes").as_int() > 0);
+}
+
 static void test_native_abort_unblocks() {
   const int ws = 2;
   auto es = engine_mesh(ws, 2);
@@ -1075,6 +1182,7 @@ int main() {
   test_native_ring_allreduce();
   test_native_q8_allreduce();
   test_native_allgather_broadcast();
+  test_native_flight_recorder();
   test_native_abort_unblocks();
   fprintf(stderr, "%d checks, %d failures\n", g_checks, g_failures);
   return g_failures == 0 ? 0 : 1;

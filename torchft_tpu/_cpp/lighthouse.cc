@@ -161,6 +161,9 @@ void Lighthouse::handle_conn(int fd) {
     } else {
       int64_t timeout = req.get("timeout_ms").as_int(60000);
       resp = handle_request(req, now_ms() + timeout);
+      // Echo the caller's trace id so both planes of a step share one id
+      // (the Python Manager mints it; responses carry it for correlation).
+      if (req.has("trace_id")) resp["trace_id"] = req.get("trace_id");
     }
     if (!send_frame(fd, resp.dump(), 30000)) break;
   }

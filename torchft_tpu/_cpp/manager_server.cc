@@ -108,6 +108,9 @@ void ManagerServer::handle_conn(int fd) {
     } else {
       int64_t timeout = req.get("timeout_ms").as_int(60000);
       resp = handle_request(req, now_ms() + timeout);
+      // Echo the caller's trace id so both planes of a step share one id
+      // (the Python Manager mints it; responses carry it for correlation).
+      if (req.has("trace_id")) resp["trace_id"] = req.get("trace_id");
     }
     if (!send_frame(fd, resp.dump(), 30000)) break;
   }
@@ -179,8 +182,8 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
   return resp;
 }
 
-std::optional<Quorum> ManagerServer::lighthouse_quorum(const QuorumMember& me,
-                                                       int64_t deadline_ms) {
+std::optional<Quorum> ManagerServer::lighthouse_quorum(
+    const QuorumMember& me, int64_t deadline_ms, const std::string& trace_id) {
   // Retry with per-attempt deadline slices (manager.rs:250-306): each attempt
   // gets total/(retries+1); sleeps at least 100ms between attempts.
   int64_t attempts = std::max<int64_t>(1, opts_.quorum_retries + 1);
@@ -199,6 +202,7 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(const QuorumMember& me,
       req["type"] = Json::of("quorum");
       req["timeout_ms"] = Json::of(attempt_deadline - now_ms());
       req["requester"] = me.to_json();
+      if (!trace_id.empty()) req["trace_id"] = Json::of(trace_id);
       Json resp;
       bool ok = call_json(fd, req, &resp, attempt_deadline - now_ms());
       close(fd);
@@ -255,6 +259,7 @@ bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
 Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
   int64_t rank = req.get("group_rank").as_int();
   bool init_sync = req.get("init_sync").as_bool(true);
+  const std::string trace_id = req.get("trace_id").as_str();
   Json resp = Json::object();
   if (draining_) {
     // A post-leave quorum registration would clear our lighthouse tombstone
@@ -300,7 +305,7 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
       me.commit_failures = std::max(me.commit_failures, kv.second.commit_failures);
     }
     lk.unlock();
-    auto q = lighthouse_quorum(me, deadline_ms);
+    auto q = lighthouse_quorum(me, deadline_ms, trace_id);
     lk.lock();
     if (q) {
       current_quorum_ = q;

@@ -80,8 +80,11 @@ class ManagerServer {
   Json quorum_rpc(const Json& req, int64_t deadline_ms);
   Json should_commit_rpc(const Json& req, int64_t deadline_ms);
   // Calls the lighthouse Quorum RPC with retries; returns nullopt on failure.
+  // `trace_id` (may be empty) is forwarded so the lighthouse leg of the
+  // step's control-plane path carries the same correlation id.
   std::optional<Quorum> lighthouse_quorum(const QuorumMember& me,
-                                          int64_t deadline_ms);
+                                          int64_t deadline_ms,
+                                          const std::string& trace_id);
 
   ManagerOpts opts_;
   int port_ = 0;

@@ -9,6 +9,7 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <chrono>
@@ -21,6 +22,19 @@ int64_t now_ms() {
              std::chrono::system_clock::now().time_since_epoch())
       .count();
 }
+
+uint64_t now_realtime_ns() {
+  timespec ts{};
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+// Thread-local: a stripe job deltas this around one transfer; no other
+// thread's misses can leak into the reading.
+static thread_local uint64_t g_spin_count = 0;
+
+uint64_t net_spin_count() { return g_spin_count; }
 
 void sleep_ms(int64_t ms) {
   std::this_thread::sleep_for(std::chrono::milliseconds(ms));
@@ -204,6 +218,7 @@ bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
     if (n < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        ++g_spin_count;
         if (!wait_fd(fd, POLLOUT, deadline)) return false;
         continue;
       }
@@ -221,6 +236,7 @@ static bool read_all(int fd, char* data, size_t len, int64_t deadline) {
     if (n < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        ++g_spin_count;
         if (!wait_fd(fd, POLLIN, deadline)) return false;
         continue;
       }

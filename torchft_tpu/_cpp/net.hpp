@@ -18,6 +18,16 @@ namespace tft {
 // same clock consistently).
 int64_t now_ms();
 
+// Wall-clock nanoseconds (CLOCK_REALTIME), chosen over CLOCK_MONOTONIC so
+// timestamps recorded in the data plane align with the Python journal's
+// time.time() records for cross-plane trace assembly.
+uint64_t now_realtime_ns();
+
+// Count of MSG_DONTWAIT misses (EAGAIN -> poll waits) taken by the calling
+// thread inside write_all/read_exact since thread start. Thread-local so a
+// transfer job can delta it around one stripe without synchronization.
+uint64_t net_spin_count();
+
 // Starts a detached watchdog thread that _exit(2)s this process as soon as
 // getppid() != parent_pid (poll every 500 ms). Used by the control-plane
 // binaries (--parent-pid): a server orphaned by `kill -9` of its trainer

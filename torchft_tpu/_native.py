@@ -43,7 +43,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_char_p,
     )
     lib.tft_coll_create.restype = P
-    lib.tft_coll_create.argtypes = [I32, I64]
+    lib.tft_coll_create.argtypes = [I32, I64, I32]
     lib.tft_coll_destroy.restype = None
     lib.tft_coll_destroy.argtypes = [P]
     lib.tft_coll_listen.restype = I32
@@ -74,6 +74,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tft_coll_bytes_rx.argtypes = [P]
     lib.tft_coll_last_error.restype = None
     lib.tft_coll_last_error.argtypes = [P, P, I64]
+    lib.tft_coll_set_trace.restype = None
+    lib.tft_coll_set_trace.argtypes = [P, CP]
+    lib.tft_coll_fr_seq.restype = U64
+    lib.tft_coll_fr_seq.argtypes = [P]
+    lib.tft_coll_fr_snapshot.restype = I64
+    lib.tft_coll_fr_snapshot.argtypes = [P, U64, P, I64]
 
 
 def _load() -> ctypes.CDLL:
@@ -114,13 +120,19 @@ class NativeEngine:
     surface so ProcessGroupNative's callers can't tell the planes apart.
     """
 
-    def __init__(self, n_streams: int = 4, pipeline_bytes: int = 1 << 20) -> None:
+    def __init__(
+        self,
+        n_streams: int = 4,
+        pipeline_bytes: int = 1 << 20,
+        fr_capacity: int = 256,
+    ) -> None:
         self._lib = _load()
         self._handle: Optional[int] = self._lib.tft_coll_create(
-            int(n_streams), int(pipeline_bytes)
+            int(n_streams), int(pipeline_bytes), int(fr_capacity)
         )
         if not self._handle:
             raise RuntimeError("tft_coll_create failed")
+        self._fr_capacity = int(fr_capacity)
         self._mu = threading.Condition()
         self._inflight = 0
         self._closed = False
@@ -317,3 +329,42 @@ class NativeEngine:
             if self._handle is None:
                 return 0
             return int(self._lib.tft_coll_bytes_rx(self._handle))
+
+    # -- flight recorder ---------------------------------------------------
+
+    def set_trace(self, tag: str) -> None:
+        """Tag stamped onto subsequent flight records (trace id + collective
+        tag). Cheap; callable per-collective."""
+        with self._mu:
+            if self._handle is None or self._closed:
+                return
+            h = self._handle
+        self._lib.tft_coll_set_trace(h, tag.encode(errors="replace"))
+
+    def fr_seq(self) -> int:
+        with self._mu:
+            if self._handle is None:
+                return 0
+            return int(self._lib.tft_coll_fr_seq(self._handle))
+
+    def fr_snapshot(self, since_seq: int = 0) -> dict:
+        """Flight-recorder snapshot: records with seq > since_seq plus the
+        engine's cumulative counters. Safe to call from any thread while a
+        collective is in flight (the C++ side tolerates torn in-flight
+        records)."""
+        import json
+
+        h = self._begin()
+        try:
+            # One generous guess sized from the ring; grow on the rare race
+            # where records land between the sizing call and the copy.
+            cap = 8192 + 4096 * max(1, self._fr_capacity)
+            for _ in range(4):
+                buf = ctypes.create_string_buffer(cap)
+                need = self._lib.tft_coll_fr_snapshot(h, int(since_seq), buf, cap)
+                if need < cap:
+                    return json.loads(buf.value.decode(errors="replace"))
+                cap = int(need) + 65536
+            raise RuntimeError("native fr_snapshot: buffer kept growing")
+        finally:
+            self._end()

@@ -629,20 +629,25 @@ class ManagerClient:
         timeout: float,
         init_sync: bool = True,
         commit_failures: int = 0,
+        trace_id: str = "",
     ) -> QuorumResult:
-        resp = self._client.call(
-            {
-                "type": "quorum",
-                "group_rank": group_rank,
-                "step": step,
-                "checkpoint_metadata": checkpoint_metadata,
-                "shrink_only": shrink_only,
-                "init_sync": init_sync,
-                "commit_failures": commit_failures,
-                "timeout_ms": int(timeout * 1000),
-            },
-            timeout + 5.0,
-        )
+        req = {
+            "type": "quorum",
+            "group_rank": group_rank,
+            "step": step,
+            "checkpoint_metadata": checkpoint_metadata,
+            "shrink_only": shrink_only,
+            "init_sync": init_sync,
+            "commit_failures": commit_failures,
+            "timeout_ms": int(timeout * 1000),
+        }
+        # Correlation id for the step's control-plane path: the manager
+        # server echoes it on the response and forwards it on its own
+        # lighthouse quorum RPC, so packet captures / server logs of both
+        # hops can be joined to the journal without guessing by timestamp.
+        if trace_id:
+            req["trace_id"] = trace_id
+        resp = self._client.call(req, timeout + 5.0)
         quorum = Quorum.from_json(resp["quorum"]) if "quorum" in resp else None
         result = QuorumResult.from_json(resp["result"], quorum)
         result.drain_requested = bool(resp.get("drain_requested", False))
@@ -669,16 +674,24 @@ class ManagerClient:
         return resp["checkpoint_metadata"]
 
     def should_commit(
-        self, group_rank: int, step: int, should_commit: bool, timeout: float
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: float,
+        trace_id: str = "",
     ) -> bool:
+        req = {
+            "type": "should_commit",
+            "group_rank": group_rank,
+            "step": step,
+            "should_commit": should_commit,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if trace_id:
+            req["trace_id"] = trace_id  # echoed by the server, see _quorum
         resp = self._client.call(
-            {
-                "type": "should_commit",
-                "group_rank": group_rank,
-                "step": step,
-                "should_commit": should_commit,
-                "timeout_ms": int(timeout * 1000),
-            },
+            req,
             timeout + 5.0,
             retry=False,  # a resent vote would poison the next barrier round
         )

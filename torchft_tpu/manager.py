@@ -163,6 +163,14 @@ class Manager:
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._quorum_id = -1
+        # Step-scoped trace id, minted at quorum_ready as
+        # "q{quorum_id}.s{max_step}": deterministic, so every replica in the
+        # same quorum generation computes the SAME id with no extra RPC, and
+        # a new generation (kill/heal/join) gets a new id. Stamped on every
+        # journal event, forwarded on control-plane RPCs, and pushed into
+        # the native engine's collective tags — one id joins
+        # quorum -> heal -> allreduce -> commit across planes and replicas.
+        self._trace_id = ""
         self._drained = False
         self._drain_requested = False
         # Drain-abort of a blocked sync quorum (see abort_pending_quorum):
@@ -376,7 +384,11 @@ class Manager:
         log = get_event_log()
         if log is not None:
             log.emit(
-                event, step=self._step, replica_id=self._replica_id, **attrs
+                event,
+                step=self._step,
+                replica_id=self._replica_id,
+                trace=self._trace_id or None,
+                **attrs,
             )
 
     def start_quorum(
@@ -437,6 +449,12 @@ class Manager:
                     timeout=timeout,
                     init_sync=self._init_sync,
                     commit_failures=self._commit_failures,
+                    # The PREVIOUS generation's id: the quorum RPC is the
+                    # transition between generations, so its wire frames
+                    # carry the id of the step that triggered it (empty on
+                    # the very first quorum). The NEW id is minted below
+                    # from the result.
+                    trace_id=self._trace_id,
                 )
             finally:
                 self._quorum_rpc_pending = False
@@ -461,6 +479,16 @@ class Manager:
 
         quorum_id_changed = result.quorum_id != self._quorum_id
         heal = result.heal and allow_heal
+        # Mint the step-scoped trace id for this quorum generation. Every
+        # replica derives the same value from the shared quorum result, so
+        # cross-replica correlation needs no extra agreement round.
+        self._trace_id = f"q{result.quorum_id}.s{result.max_step}"
+        set_trace = getattr(self._pg, "set_trace_id", None)
+        if set_trace is not None:
+            try:
+                set_trace(self._trace_id)
+            except Exception:  # noqa: BLE001 - tracing must never fail a step
+                pass
         self._journal(
             "quorum_ready",
             quorum_id=result.quorum_id,
@@ -805,6 +833,7 @@ class Manager:
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """Distributed commit gate (reference: manager.py:760-836)."""
         gated_step = self._step  # _should_commit_inner increments on commit
+        t_gate0 = time.monotonic()
         answer = self._should_commit_inner(timeout)
         log = get_event_log()
         if log is not None:
@@ -812,8 +841,10 @@ class Manager:
                 "commit_gate",
                 step=gated_step,
                 replica_id=self._replica_id,
+                trace=self._trace_id or None,
                 committed=bool(answer),
                 num_participants=self.num_participants(),
+                elapsed_s=time.monotonic() - t_gate0,
             )
         metrics = get_metrics_logger()
         if metrics is not None:
@@ -851,6 +882,7 @@ class Manager:
                 self._step,
                 local_ok,
                 timeout=timeout if timeout is not None else self._timeout,
+                trace_id=self._trace_id,
             )
         except Exception as e:
             self._logger.exception(f"should_commit RPC failed: {e}")

@@ -34,7 +34,12 @@ import numpy as np
 
 from torchft_tpu import _net
 from torchft_tpu.store import StoreClient
-from torchft_tpu.telemetry import add_bytes, flight_recorder, get_event_log
+from torchft_tpu.telemetry import (
+    add_bytes,
+    flight_recorder,
+    get_event_log,
+    observe_span,
+)
 from torchft_tpu.work import DummyWork, ErrorWork, FutureWork, Work
 
 import logging
@@ -111,6 +116,13 @@ class ProcessGroup:
     def errored(self) -> Optional[Exception]:
         """Latched async error, if any (reference: process_group.py:361-368)."""
         return None
+
+    def set_trace_id(self, trace_id: str) -> None:
+        """Step-scoped correlation id (the Manager mints one per quorum
+        generation). Stamped on this group's journal events; the native
+        backend additionally pushes it into the C++ engine so every
+        flight record carries it."""
+        self._trace_id = trace_id
 
     def set_timeout(self, timeout: float) -> None:
         raise NotImplementedError
@@ -350,6 +362,7 @@ class ProcessGroupSocket(ProcessGroup):
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._configure_lock = threading.Lock()
+        self._trace_id = ""
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -506,14 +519,18 @@ class ProcessGroupSocket(ProcessGroup):
                 self._errored or RuntimeError("process group not configured")
             )
         seq = flight_recorder.record(
-            op, nbytes=nbytes, rank=self._rank, world=self._world
+            op, tag=tag or "", nbytes=nbytes, rank=self._rank, world=self._world
         )
 
         def guarded() -> Any:
+            t0 = time.monotonic()
             try:
                 result = fn()
             except Exception as e:
                 flight_recorder.complete(seq, error=str(e))
+                self._journal_collective(
+                    op, nbytes, tag, time.monotonic() - t0, ok=False
+                )
                 # Tell live peers we abandoned this collective so their
                 # pending tag waits fail NOW: one rank wedged on a dead
                 # peer's tag holds everyone else's next quorum hostage
@@ -530,6 +547,9 @@ class ProcessGroupSocket(ProcessGroup):
                     self._errored = e
                 raise
             flight_recorder.complete(seq)
+            self._journal_collective(
+                op, nbytes, tag, time.monotonic() - t0, ok=True
+            )
             return result
 
         try:
@@ -542,6 +562,28 @@ class ProcessGroupSocket(ProcessGroup):
         """Best-effort abort fan-out to every live peer connection."""
         for conn in list(self._peers.values()):
             conn.send_abort(tag, str(exc))
+
+    def _journal_collective(
+        self, op: str, nbytes: int, tag: Optional[str], dt: float, ok: bool
+    ) -> None:
+        """One journal line + one span sample per completed collective,
+        IDENTICAL across backends (socket and native both route through
+        _submit), so journals from differently-configured replicas can be
+        diffed byte-for-byte per tag. No-ops beyond a span add unless the
+        journal is enabled."""
+        observe_span(f"pg::{self.getBackendName()}::{op}", dt)
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "pg_collective",
+                trace=self._trace_id or None,
+                backend=self.getBackendName(),
+                op=op,
+                nbytes=int(nbytes),
+                tag=tag or "",
+                elapsed_s=dt,
+                ok=ok,
+            )
 
     # -- collectives -------------------------------------------------------
 
@@ -609,7 +651,12 @@ class ProcessGroupSocket(ProcessGroup):
                 ]
             return out  # type: ignore[return-value]
 
-        return self._submit(run, op="allgather", tag=tag)
+        return self._submit(
+            run,
+            op="allgather",
+            nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
+        )
 
     def broadcast(self, tensors: Any, root: int = 0) -> Work:
         arrays = _as_list(tensors)
@@ -627,7 +674,12 @@ class ProcessGroupSocket(ProcessGroup):
                 np.copyto(a, received.reshape(a.shape).astype(a.dtype, copy=False))
             return arrays
 
-        return self._submit(run, op="broadcast", tag=tag)
+        return self._submit(
+            run,
+            op="broadcast",
+            nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
+        )
 
     def reduce_scatter(
         self, inputs: Sequence[Any], op: ReduceOp = ReduceOp.SUM
@@ -650,7 +702,12 @@ class ProcessGroupSocket(ProcessGroup):
                 acc /= self._world
             return acc
 
-        return self._submit(run, op="reduce_scatter", tag=tag)
+        return self._submit(
+            run,
+            op="reduce_scatter",
+            nbytes=sum(a.nbytes for a in arrays),
+            tag=tag,
+        )
 
     def alltoall(self, inputs: Sequence[Any]) -> Work:
         arrays = _as_list(inputs)
@@ -756,6 +813,7 @@ class ProcessGroupNative(ProcessGroupSocket):
         n_streams: Optional[int] = None,
         pipeline_bytes: Optional[int] = None,
         wire: Optional[str] = None,
+        fr_capacity: Optional[int] = None,
     ) -> None:
         super().__init__(timeout=timeout)
         from torchft_tpu import _native
@@ -776,6 +834,16 @@ class ProcessGroupNative(ProcessGroupSocket):
         self._wire = (
             wire if wire is not None else os.environ.get("TORCHFT_PG_WIRE", "fp32")
         ).lower()
+        # Engine flight-record ring size (records). 0 disables recording
+        # (the always-on per-peer byte/busy counters remain); the default
+        # keeps the last 256 collectives, enough to cover a full commit
+        # window at a few records per step.
+        self._fr_capacity = int(
+            fr_capacity
+            if fr_capacity is not None
+            else os.environ.get("TORCHFT_NATIVE_FR_RING", "256")
+        )
+        self._fr_last_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -788,7 +856,7 @@ class ProcessGroupNative(ProcessGroupSocket):
             # mesh is up (it reads addr_*), every naddr_* is in the store —
             # the inherited rendezvous doubles as the publication barrier.
             engine = self._native.NativeEngine(
-                self._n_streams, self._pipeline_bytes
+                self._n_streams, self._pipeline_bytes, self._fr_capacity
             )
             try:
                 port = engine.listen("0.0.0.0")
@@ -831,6 +899,9 @@ class ProcessGroupNative(ProcessGroupSocket):
             store.close()
         with self._configure_lock:
             self._engine = engine
+            self._fr_last_seq = 0  # fresh engine, fresh record sequence
+        if self._trace_id:
+            engine.set_trace(self._trace_id)
         for conn in self._peers.values():
             conn.on_abort = self._on_peer_abort
         log = get_event_log()
@@ -846,6 +917,15 @@ class ProcessGroupNative(ProcessGroupSocket):
     def _abort_locked(self) -> None:
         engine, self._engine = self._engine, None
         if engine is not None:
+            # Drain completed flight records BEFORE aborting: the engine's
+            # snapshot is safe against in-flight collectives, and the abort
+            # cause lands in the in-flight record's own fr_end on the
+            # worker thread — but this engine object is gone after close(),
+            # so this is the last chance to journal what it saw.
+            try:
+                self._drain_flight_records(engine)
+            except Exception:  # noqa: BLE001 - telemetry never blocks abort
+                pass
             engine.abort("pg abort")
             # close() waits for in-flight native calls to drain before
             # freeing the C++ object; do that off-thread so abort/configure
@@ -871,6 +951,71 @@ class ProcessGroupNative(ProcessGroupSocket):
 
     # -- telemetry ---------------------------------------------------------
 
+    def set_trace_id(self, trace_id: str) -> None:
+        super().set_trace_id(trace_id)
+        engine = self._engine
+        if engine is not None:
+            engine.set_trace(trace_id)
+
+    def _stamp_trace(self, engine: Any, tag: str) -> None:
+        """Engine flight records carry ``"<trace_id>|<collective tag>"``
+        (e.g. ``q3.s17|c4``): the prefix joins the record to the step's
+        control-plane journal events, the suffix to the specific
+        ``pg_collective`` line. Runs on the single pg-exec thread, so the
+        stamp can't race a concurrent collective's."""
+        engine.set_trace(f"{self._trace_id}|{tag}" if self._trace_id else tag)
+
+    def _drain_flight_records(self, engine: Any) -> None:
+        """Moves completed engine flight records into the step-event
+        journal as ``native_collective`` events (plus one
+        ``native_counters`` summary for the exporter). Incremental: only
+        records past the last drained seq are fetched. The snapshot RPC is
+        skipped entirely when the journal is disabled, so benchmarks
+        without TORCHFT_JOURNAL_* pay only the engine-side (pure C++)
+        recording cost."""
+        log = get_event_log()
+        if log is None:
+            return
+        try:
+            snap = engine.fr_snapshot(self._fr_last_seq)
+        except Exception:  # noqa: BLE001 - telemetry must not fail a step
+            return
+        recs = snap.get("records", [])
+        for r in recs:
+            seq = int(r.get("seq", 0))
+            if seq > self._fr_last_seq:
+                self._fr_last_seq = seq
+            tag = r.get("tag", "")
+            trace, sep, ctag = tag.partition("|")
+            if not sep:
+                trace, ctag = "", tag
+            log.emit(
+                "native_collective",
+                trace=trace or None,
+                op=r.get("op"),
+                status=r.get("status"),
+                tag=ctag,
+                nbytes=int(r.get("bytes", 0)),
+                t_start_ns=int(r.get("t_start_ns", 0)),
+                t_end_ns=int(r.get("t_end_ns", 0)),
+                step_ns=r.get("step_ns", []),
+                lanes=r.get("lanes", []),
+                lanes_dropped=int(r.get("lanes_dropped", 0)),
+                cause=r.get("cause", ""),
+            )
+        log.emit(
+            "native_counters",
+            trace=self._trace_id or None,
+            seq=int(snap.get("seq", 0)),
+            dropped=int(snap.get("dropped", 0)),
+            spin_total=int(snap.get("spin_total", 0)),
+            bytes_tx=int(snap.get("bytes_tx", 0)),
+            bytes_rx=int(snap.get("bytes_rx", 0)),
+            world=int(snap.get("world", 0)),
+            n_streams=int(snap.get("n_streams", 0)),
+            peers=snap.get("peers", []),
+        )
+
     def _accounted(self, engine: Any, fn: Callable[[], None]) -> None:
         tx0, rx0 = engine.bytes_tx(), engine.bytes_rx()
         try:
@@ -887,11 +1032,15 @@ class ProcessGroupNative(ProcessGroupSocket):
         engine = self._engine
         if self._world <= 1 or engine is None:
             return super()._allreduce(arrays, op, tag)
-        for i, arr in enumerate(arrays):
-            if not self._native_allreduce_one(engine, arr, op):
-                # Dtype outside the engine's set (f16/bf16/fp8): the
-                # inherited python ring still carries it.
-                self._ring_allreduce_flat(arr, op, f"{tag}.{i}")
+        self._stamp_trace(engine, tag)
+        try:
+            for i, arr in enumerate(arrays):
+                if not self._native_allreduce_one(engine, arr, op):
+                    # Dtype outside the engine's set (f16/bf16/fp8): the
+                    # inherited python ring still carries it.
+                    self._ring_allreduce_flat(arr, op, f"{tag}.{i}")
+        finally:
+            self._drain_flight_records(engine)
         if op == ReduceOp.AVG:
             for arr in arrays:
                 arr /= self._world
@@ -937,9 +1086,14 @@ class ProcessGroupNative(ProcessGroupSocket):
 
         def run() -> List[List[np.ndarray]]:
             meta, payload = _pack_arrays(arrays)
-            self._accounted(
-                engine, lambda: engine.allgather(meta, payload, self._timeout)
-            )
+            self._stamp_trace(engine, tag)
+            try:
+                self._accounted(
+                    engine,
+                    lambda: engine.allgather(meta, payload, self._timeout),
+                )
+            finally:
+                self._drain_flight_records(engine)
             out: List[Optional[List[np.ndarray]]] = [None] * self._world
             out[self._rank] = [a.copy() for a in arrays]
             for p in range(self._world):
@@ -964,18 +1118,26 @@ class ProcessGroupNative(ProcessGroupSocket):
         tag = self._next_tag()
 
         def run() -> List[np.ndarray]:
+            self._stamp_trace(engine, tag)
             if self._rank == root:
                 meta, payload = _pack_arrays(arrays)
+                try:
+                    self._accounted(
+                        engine,
+                        lambda: engine.broadcast(
+                            meta, payload, root, self._timeout
+                        ),
+                    )
+                finally:
+                    self._drain_flight_records(engine)
+                return arrays
+            try:
                 self._accounted(
                     engine,
-                    lambda: engine.broadcast(
-                        meta, payload, root, self._timeout
-                    ),
+                    lambda: engine.broadcast("", b"", root, self._timeout),
                 )
-                return arrays
-            self._accounted(
-                engine, lambda: engine.broadcast("", b"", root, self._timeout)
-            )
+            finally:
+                self._drain_flight_records(engine)
             pmeta, pdata = engine.result(root)
             received = _unpack_arrays(pmeta, pdata)
             if len(received) != len(arrays):

@@ -122,13 +122,22 @@ def span_stats() -> Dict[str, Dict[str, float]]:
 
 
 def _hist_percentile(buckets: List[int], q: float) -> float:
-    """Upper-bound estimate of the q-quantile from bucket counts."""
+    """Upper-bound estimate of the q-quantile from bucket counts.
+
+    Edge cases (regression-tested): all-zero buckets -> 0.0 (no samples is
+    not "the first boundary"); a run of empty leading buckets must never
+    satisfy the target (``cum >= target`` holds vacuously at target <= 0,
+    which used to report bucket 0's bound for q ~ 0 even when every sample
+    sat in a much higher bucket); a single occupied bucket returns that
+    bucket's upper bound for every q."""
     total = sum(buckets)
     if total == 0:
         return 0.0
     target = q * total
     cum = 0
     for i, c in enumerate(buckets):
+        if c == 0:
+            continue  # an empty prefix can't contain any quantile
         cum += c
         if cum >= target:
             if i < len(_HIST_BOUNDS):
@@ -160,6 +169,16 @@ def span_percentiles(
 
 def reset_span_stats() -> None:
     _SPAN_STATS.reset()
+
+
+def observe_span(name: str, dt: float) -> None:
+    """Record an externally-timed duration into the span histogram.
+
+    For call sites that already hold a wall-clock delta (e.g. a process
+    group timing its own collective) and want it in the same
+    ``span_stats``/``span_percentiles`` tables as ``span()``-wrapped
+    regions, without nesting a context manager."""
+    _SPAN_STATS.add(name, dt)
 
 
 class _ByteCounters:
@@ -378,9 +397,16 @@ class EventLog:
     heal start/done, allreduce issue/complete, commit verdicts, PG
     configure/abort, checkpoint send/recv) with enough attributes that
     ``tools/obs_report.py`` can merge journals from every replica into a
-    step-aligned timeline. Lock-cheap: one json.dumps + write + flush per
+    step-aligned timeline. Lock-cheap: one json.dumps + one os.write per
     event, and events only fire at control-plane frequency (a handful per
     step), never per-microbatch.
+
+    The journal file is opened ``O_APPEND`` and each record is a *single*
+    ``os.write`` of one complete line: POSIX atomic appends mean several
+    replica processes can share one journal file (``TORCHFT_JOURNAL_FILE``
+    pointing everyone at the same path) without interleaving partial
+    lines. The in-process lock still serializes threads sharing this
+    EventLog instance.
     """
 
     def __init__(self, path: str, replica_id: Optional[str] = None) -> None:
@@ -395,7 +421,9 @@ class EventLog:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._fh: Optional[Any] = open(path, "a")
+        self._fd: int = os.open(
+            path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+        )
         atexit.register(self.close)
 
     def emit(
@@ -403,6 +431,7 @@ class EventLog:
         event: str,
         step: Optional[int] = None,
         replica_id: Optional[str] = None,
+        trace: Optional[str] = None,
         **attrs: Any,
     ) -> None:
         rec: Dict[str, Any] = {
@@ -411,28 +440,30 @@ class EventLog:
             "step": None if step is None else int(step),
             "event": event,
         }
+        if trace:
+            rec["trace"] = trace
         if attrs:
             rec["attrs"] = attrs
         try:
             line = json.dumps(rec, default=str)
         except Exception:
             return  # never let journaling break the train loop
+        data = (line + "\n").encode("utf-8", errors="replace")
         with self._lock:
-            if self._fh is None:
+            if self._fd < 0:
                 return
             try:
-                self._fh.write(line + "\n")
-                self._fh.flush()
+                os.write(self._fd, data)
             except Exception:
                 pass
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
+            if self._fd >= 0:
                 try:
-                    self._fh.close()
+                    os.close(self._fd)
                 finally:
-                    self._fh = None
+                    self._fd = -1
 
 
 _EVENT_LOG: Optional[EventLog] = None
