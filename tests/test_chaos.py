@@ -1,0 +1,581 @@
+"""Chaos-plane tests: the seeded fault-injection grammar (chaos.py),
+per-(rule, site) visit scheduling, fixed-seed determinism, the
+zero-overhead off path, journal attribution, the _FramedClient retry /
+backoff policy, and Python-vs-native (C++) schedule parity through the
+socket and native process groups."""
+
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu import _native, chaos, telemetry
+from torchft_tpu.process_group import (
+    ProcessGroupNative,
+    ProcessGroupSocket,
+    ReduceOp,
+)
+from torchft_tpu.store import TCPStoreServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Every test starts and ends with chaos disarmed on both planes."""
+    monkeypatch.delenv("TORCHFT_CHAOS", raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+    if _native.is_available():
+        _native.chaos_init(" ")  # blank spec disarms the C++ mirror
+
+
+def _run_parallel(fns, timeout=60):
+    with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = TCPStoreServer()
+    yield server
+    server.shutdown()
+
+
+def _rule(kind="stall", plane="data", **kw):
+    return chaos.parse_rule(
+        ":".join([f"{kind}@{plane}"] + [f"{k}={v}" for k, v in kw.items()]), 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    seed, rules = chaos.parse_spec(
+        "seed:7,spec:reset@ctrl:match=quorum:after=2:count=1;"
+        "stall@data:peer=1:ms=250:every=4;"
+        "partial_write@any:frac=0.25:step=3-9;"
+        "rpc_drop@ctrl:p=0.5"
+    )
+    assert seed == 7
+    assert [r.kind for r in rules] == [
+        "reset", "stall", "partial_write", "rpc_drop",
+    ]
+    assert rules[0].match == "quorum" and rules[0].after == 2
+    assert rules[0].count == 1 and rules[0].index == 0
+    assert rules[1].peer == "1" and rules[1].ms == 250 and rules[1].every == 4
+    assert rules[2].plane == "any" and rules[2].frac == 0.25
+    assert (rules[2].step_lo, rules[2].step_hi) == (3, 9)
+    assert rules[3].p == 0.5 and rules[3].index == 3
+
+
+def test_spec_roundtrip():
+    text = (
+        "seed:42,spec:stall@data:peer=0:ms=60:every=5:count=4;"
+        "ckpt_truncate@heal:match=5:count=1:frac=0.5;"
+        "rpc_delay@ctrl:step=2-:p=0.25:after=1:ms=120"
+    )
+    seed, rules = chaos.parse_spec(text)
+    again_seed, again = chaos.parse_spec(chaos.Chaos(seed, rules).spec())
+    assert again_seed == seed
+    assert [r.spec() for r in again] == [r.spec() for r in rules]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "reset@ctrl",  # missing seed prefix
+        "seed:x,spec:reset@ctrl",  # non-integer seed
+        "seed:1,spec:",  # no rules
+        "seed:1,spec:;;",  # no rules after split
+        "seed:1,spec:bogus@ctrl",  # unknown kind
+        "seed:1,spec:reset@nowhere",  # unknown plane
+        "seed:1,spec:reset",  # missing @plane
+        "seed:1,spec:reset@ctrl:p=1.5",  # p outside [0,1]
+        "seed:1,spec:reset@ctrl:frac=-1",  # frac outside [0,1]
+        "seed:1,spec:reset@ctrl:junk",  # param without '='
+        "seed:1,spec:reset@ctrl:zz=1",  # unknown param
+        "seed:1,spec:reset@ctrl:after=x",  # non-integer param
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec(bad)
+
+
+def test_bad_env_spec_fails_init(monkeypatch):
+    monkeypatch.setenv("TORCHFT_CHAOS", "seed:1,spec:bogus@ctrl")
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.init_from_env(force=True)
+
+
+# ---------------------------------------------------------------------------
+# Schedule semantics (after / every / count / filters / windows)
+# ---------------------------------------------------------------------------
+
+
+def test_after_every_count_schedule():
+    st = chaos.Chaos(1, [_rule(after=2, every=3, count=2)])
+    fired = [
+        v
+        for v in range(12)
+        if st.pick("stall", "data", "send:0") is not None
+    ]
+    assert fired == [2, 5]  # skip 2 visits, then every 3rd, capped at 2
+
+
+def test_count_default_unlimited():
+    st = chaos.Chaos(1, [_rule()])
+    assert all(
+        st.pick("stall", "data", "send:0") is not None for _ in range(20)
+    )
+
+
+def test_visit_counters_are_per_site():
+    st = chaos.Chaos(1, [_rule(after=1)])
+    assert st.pick("stall", "data", "send:0") is None  # site A visit 0
+    assert st.pick("stall", "data", "send:1") is None  # site B visit 0
+    assert st.pick("stall", "data", "send:0").visit == 1
+    assert st.pick("stall", "data", "send:1").visit == 1
+
+
+def test_first_match_wins_but_all_counters_bump():
+    rules = [_rule(count=1), _rule()]
+    rules[1].index = 1
+    st = chaos.Chaos(1, rules)
+    first = st.pick("stall", "data", "send:0")
+    assert first.rule == 0
+    second = st.pick("stall", "data", "send:0")
+    # Rule 0 is exhausted; rule 1 fires — and its visit counter advanced
+    # during the first pick even though rule 0 won it.
+    assert second.rule == 1 and second.visit == 1
+
+
+def test_nonmatching_pick_does_not_bump_counter():
+    st = chaos.Chaos(1, [_rule(kind="rpc_drop", plane="ctrl", match="quorum")])
+    for _ in range(3):  # heartbeats must not perturb the quorum schedule
+        assert st.pick("rpc_drop", "ctrl", "rpc:x", match="heartbeat") is None
+    inj = st.pick("rpc_drop", "ctrl", "rpc:x", match="quorum")
+    assert inj is not None and inj.visit == 0
+
+
+def test_peer_filter_is_substring():
+    st = chaos.Chaos(1, [_rule(peer="10.0.0.2")])
+    assert st.pick("stall", "data", "s", peer="10.0.0.1") is None
+    assert st.pick("stall", "data", "s", peer="10.0.0.2:1234") is not None
+
+
+def test_step_window():
+    st = chaos.Chaos(1, [_rule(step="5-7")])
+    # Windowed rules never fire (nor count visits) while the step is
+    # unknown — pre-quorum traffic stays uninjected.
+    assert st.pick("stall", "data", "s") is None
+    assert st.pick("stall", "data", "s", step=4) is None
+    inj = st.pick("stall", "data", "s", step=5)
+    assert inj is not None and inj.visit == 0
+    assert st.pick("stall", "data", "s", step=8) is None
+    assert st.pick("stall", "data", "s", step=7) is not None
+
+
+def test_plane_any_matches_everything():
+    st = chaos.Chaos(1, [_rule(plane="any")])
+    for plane in ("ctrl", "data", "heal", "srv"):
+        assert st.pick("stall", plane, f"s:{plane}") is not None
+
+
+def test_set_step_notifies_listeners_once():
+    seen = []
+    chaos.on_step_change(seen.append)
+    chaos.on_step_change(seen.append)  # deduped
+    chaos.set_step(9)
+    assert seen == [9]
+    assert chaos.current_step() == 9
+
+
+# ---------------------------------------------------------------------------
+# Determinism
+# ---------------------------------------------------------------------------
+
+
+def test_decision_hash_golden_values():
+    # Pinned bit-for-bit; _cpp/chaos.hpp mirrors these (cpp_tests asserts
+    # the same constants on the C++ side).
+    assert chaos.fnv1a64("send:127.0.0.1") == 0xCA311A7E93CF8538
+    assert chaos.splitmix64(0) == 0xE220A8397B1DCDAF
+    assert (
+        chaos.decision_hash(1337, 2, chaos.fnv1a64("send:127.0.0.1"), 7)
+        == 0xD9B33F96D17241D1
+    )
+
+
+def _fired_visits(seed, p, n=300, site="send:0"):
+    st = chaos.Chaos(seed, [_rule(p=p)])
+    return [
+        v for v in range(n) if st.pick("stall", "data", site) is not None
+    ]
+
+
+def test_probabilistic_rule_same_seed_identical():
+    a = _fired_visits(42, 0.3)
+    b = _fired_visits(42, 0.3)
+    assert a == b
+    assert 0.15 < len(a) / 300 < 0.45  # roughly honours p
+
+
+def test_probabilistic_rule_seed_changes_schedule():
+    assert _fired_visits(1, 0.3) != _fired_visits(2, 0.3)
+
+
+def test_deterministic_across_thread_interleaving():
+    """Concurrent visits at one site may race for visit numbers, but the
+    *set* of fired visits depends only on (seed, rule, site, visit)."""
+
+    def run():
+        st = chaos.Chaos(9, [_rule(p=0.4)])
+        fired = []
+
+        def worker():
+            for _ in range(50):
+                inj = st.pick("stall", "data", "send:0")
+                if inj is not None:
+                    fired.append(inj.visit)
+
+        _run_parallel([worker] * 4)
+        return sorted(fired)
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# Off path: zero overhead, no state
+# ---------------------------------------------------------------------------
+
+
+def test_unset_env_means_no_state():
+    assert chaos.init_from_env(force=True) is None
+    assert chaos.active() is None
+    assert chaos._STATE is None and chaos._INITED
+    assert chaos.maybe("stall", "data", "s") is None
+    assert chaos.maybe_stall("data", "s") is None
+    chaos.check_connect("data", "peer")  # must not raise
+
+
+def test_env_round_trip(monkeypatch):
+    monkeypatch.setenv(
+        "TORCHFT_CHAOS", "seed:11,spec:reset@data:match=c1:count=1"
+    )
+    st = chaos.init_from_env(force=True)
+    assert st is not None and st.seed == 11
+    assert chaos.active() is st
+    # Second init without force is a no-op even if the env changes.
+    monkeypatch.setenv("TORCHFT_CHAOS", "seed:12,spec:stall@data")
+    assert chaos.init_from_env() is st
+
+
+def test_scope_nests_and_restores():
+    assert chaos._scope_ctx() is None
+    with chaos.scope("ctrl", peer="a", match="quorum"):
+        assert chaos._scope_ctx() == ("ctrl", "a", "quorum")
+        with chaos.scope("data", peer="b"):
+            assert chaos._scope_ctx() == ("data", "b", None)
+        assert chaos._scope_ctx() == ("ctrl", "a", "quorum")
+    assert chaos._scope_ctx() is None
+
+
+# ---------------------------------------------------------------------------
+# Journal attribution
+# ---------------------------------------------------------------------------
+
+
+def test_injection_journaled(tmp_path, monkeypatch):
+    path = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    telemetry.reset_event_log()
+    try:
+        chaos.install(7, [_rule(kind="rpc_delay", plane="ctrl", ms=1)])
+        chaos.set_step(3)
+        inj = chaos.maybe(
+            "rpc_delay", "ctrl", "rpc:quorum", peer="lh", match="quorum"
+        )
+        assert inj is not None
+    finally:
+        telemetry.reset_event_log()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    [ev] = [e for e in events if e["event"] == "chaos_inject"]
+    assert ev["step"] == 3
+    attrs = ev["attrs"]
+    assert attrs["kind"] == "rpc_delay" and attrs["plane"] == "ctrl"
+    assert attrs["site"] == "rpc:quorum" and attrs["rule"] == 0
+    assert attrs["visit"] == 0 and attrs["seq"] == 1
+    assert attrs["peer"] == "lh" and attrs["match"] == "quorum"
+
+
+# ---------------------------------------------------------------------------
+# Control plane: rpc faults + retry/backoff journal (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_drop_is_retried_and_journaled(tmp_path, monkeypatch):
+    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+    path = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    telemetry.reset_event_log()
+    server = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    try:
+        chaos.install(
+            3,
+            [
+                chaos.parse_rule(
+                    "rpc_drop@ctrl:match=status:count=1", 0
+                )
+            ],
+        )
+        client = LighthouseClient(server.address())
+        status = client.status()  # first attempt dropped, retry succeeds
+        assert "replicas" in status or isinstance(status, dict)
+        assert chaos.active().injections_fired() == 1
+    finally:
+        server.shutdown()
+        telemetry.reset_event_log()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    retries = [e for e in events if e["event"] == "rpc_retry"]
+    assert len(retries) == 1
+    assert retries[0]["attrs"]["rpc"] == "status"
+    assert retries[0]["attrs"]["attempt"] == 1
+    assert "chaos" in retries[0]["attrs"]["error"]
+    # The injection itself is journaled too, with ctrl-plane attribution.
+    [inj] = [e for e in events if e["event"] == "chaos_inject"]
+    assert inj["attrs"]["kind"] == "rpc_drop"
+    assert inj["attrs"]["match"] == "status"
+
+
+def test_rpc_delay_bounded_by_call_budget(tmp_path, monkeypatch):
+    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+    server = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    try:
+        # A 10 s delay rule must not extend a 1.5 s call budget: the sleep
+        # is clipped to the remaining deadline and the RPC then completes.
+        chaos.install(
+            3, [chaos.parse_rule("rpc_delay@ctrl:match=status:ms=10000", 0)]
+        )
+        client = LighthouseClient(server.address())
+        t0 = time.monotonic()
+        try:
+            client.status(timeout=1.5)
+        except TimeoutError:
+            pass  # budget exhausted by the delay: also acceptable
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Data plane: socket backend
+# ---------------------------------------------------------------------------
+
+
+def test_socket_stall_delays_allreduce(store):
+    groups = _make_socket_group(store, 2, prefix="chst")
+    chaos.install(5, [_rule(kind="stall", ms=300, count=1)])
+
+    def run(rank):
+        arr = np.full(4, float(rank), np.float32)
+        return groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)[0]
+
+    t0 = time.monotonic()
+    for r in _run_parallel([lambda r=r: run(r) for r in range(2)]):
+        np.testing.assert_allclose(r, 1.0)
+    assert time.monotonic() - t0 >= 0.25
+    assert chaos.active().injections_fired() == 1
+    for g in groups:
+        g.shutdown()
+
+
+def test_socket_reset_latches_error_and_reconfigure_recovers(store):
+    groups = _make_socket_group(store, 2, prefix="chrs")
+    chaos.install(5, [_rule(kind="reset", match="c1", count=1)])
+
+    def run(rank):
+        try:
+            groups[rank].allreduce(np.ones(4, np.float32)).wait(timeout=10)
+            return None
+        except Exception as e:
+            return e
+
+    errors = [e for e in _run_parallel([lambda r=r: run(r) for r in range(2)]) if e]
+    assert errors, "chaos reset should fail at least one rank's allreduce"
+    assert any(g.errored() is not None for g in groups)
+
+    # Same process, fresh prefix: reconfigure clears the latched error and
+    # the group works again (the in-run recovery path the soak exercises).
+    chaos.reset()
+
+    def reconfigure(rank):
+        groups[rank].configure(f"{store.address()}/chrs2", rank, 2)
+        arr = np.full(4, float(rank + 1), np.float32)
+        groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+        return arr
+
+    a, _ = _run_parallel([lambda r=r: reconfigure(r) for r in range(2)])
+    np.testing.assert_allclose(a, 3.0)
+    assert all(g.errored() is None for g in groups)
+    for g in groups:
+        g.shutdown()
+
+
+def _make_socket_group(store, world_size, prefix, timeout=10.0):
+    groups = [ProcessGroupSocket(timeout=timeout) for _ in range(world_size)]
+    _run_parallel(
+        [
+            lambda r=r: groups[r].configure(
+                f"{store.address()}/{prefix}", r, world_size
+            )
+            for r in range(world_size)
+        ]
+    )
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) mirror parity
+# ---------------------------------------------------------------------------
+
+native = pytest.mark.skipif(
+    not _native.is_available(), reason="native collective engine unavailable"
+)
+
+
+def _make_native_group(store, world_size, prefix, timeout=10.0):
+    groups = [ProcessGroupNative(timeout=timeout) for _ in range(world_size)]
+    _run_parallel(
+        [
+            lambda r=r: groups[r].configure(
+                f"{store.address()}/{prefix}", r, world_size
+            )
+            for r in range(world_size)
+        ]
+    )
+    return groups
+
+
+@native
+def test_native_abi_arm_disarm():
+    with pytest.raises(ValueError):
+        _native.chaos_init("seed:1,spec:bogus@ctrl")
+    assert not _native.chaos_armed()
+    _native.chaos_init("seed:1,spec:stall@data:ms=1")
+    assert _native.chaos_armed()
+    snap = _native.chaos_snapshot()
+    assert snap["seq"] == 0 and snap["events"] == []
+    _native.chaos_init(" ")
+    assert not _native.chaos_armed()
+
+
+@native
+def test_native_reset_latches_error_like_socket(store):
+    """Socket-vs-native parity: the same spec produces the same observable
+    outcome — a failed collective, a latched errored(), and a clean
+    recovery on reconfigure."""
+    groups = _make_native_group(store, 2, prefix="nchr")
+    _native.chaos_init("seed:5,spec:reset@data:match=c1:count=1")
+
+    def run(rank):
+        try:
+            groups[rank].allreduce(np.ones(256, np.float32)).wait(timeout=10)
+            return None
+        except Exception as e:
+            return e
+
+    errors = [e for e in _run_parallel([lambda r=r: run(r) for r in range(2)]) if e]
+    assert errors, "native chaos reset should fail at least one rank"
+    assert any(g.errored() is not None for g in groups)
+    snap = _native.chaos_snapshot()
+    assert any(
+        e["kind"] == "reset" and e["plane"] == "data" for e in snap["events"]
+    )
+
+    _native.chaos_init(" ")
+
+    def reconfigure(rank):
+        groups[rank].configure(f"{store.address()}/nchr2", rank, 2)
+        arr = np.full(4, float(rank + 1), np.float32)
+        groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+        return arr
+
+    a, _ = _run_parallel([lambda r=r: reconfigure(r) for r in range(2)])
+    np.testing.assert_allclose(a, 3.0)
+    assert all(g.errored() is None for g in groups)
+    for g in groups:
+        g.shutdown()
+
+
+@native
+def test_native_schedule_matches_python_hash_and_replays(store):
+    """Bit-parity with the Python decision function: every injection the
+    C++ engine fires for a p<1 rule must be a visit the Python hash fires,
+    and a same-seed rerun must fire the identical (site, visit) set."""
+    spec = "seed:99,spec:stall@data:p=0.4:ms=1"
+
+    def one_run(prefix):
+        _native.chaos_init(spec)  # fresh counters each run
+        groups = _make_native_group(store, 2, prefix=prefix)
+        for _ in range(6):
+            _run_parallel(
+                [
+                    lambda r=r: groups[r]
+                    .allreduce(np.ones(1024, np.float32))
+                    .wait(timeout=30)
+                    for r in range(2)
+                ]
+            )
+        snap = _native.chaos_snapshot()
+        _native.chaos_init(" ")
+        for g in groups:
+            g.shutdown()
+        return snap["events"]
+
+    a = one_run("npar1")
+    b = one_run("npar2")
+    assert a, "expected at least one native injection at p=0.4"
+    for ev in a:
+        unit = chaos._hash_unit(
+            chaos.decision_hash(
+                99, ev["rule"], chaos.fnv1a64(ev["site"]), ev["visit"]
+            )
+        )
+        assert unit < 0.4, f"native fired a visit Python would not: {ev}"
+    key = lambda evs: sorted((e["site"], e["rule"], e["visit"]) for e in evs)
+    assert key(a) == key(b)
+
+
+@native
+def test_native_snapshot_since_seq(store):
+    _native.chaos_init("seed:1,spec:stall@data:ms=1:count=2")
+    groups = _make_native_group(store, 2, prefix="nsnap")
+    _run_parallel(
+        [
+            lambda r=r: groups[r]
+            .allreduce(np.ones(64, np.float32))
+            .wait(timeout=30)
+            for r in range(2)
+        ]
+    )
+    snap = _native.chaos_snapshot()
+    assert snap["seq"] >= 1 and len(snap["events"]) >= 1
+    again = _native.chaos_snapshot(since_seq=snap["seq"])
+    assert again["events"] == []
+    _native.chaos_init(" ")
+    for g in groups:
+        g.shutdown()

@@ -49,6 +49,65 @@ def _worker(args: argparse.Namespace) -> int:
     results = []
     rng = np.random.default_rng(args.rank)
     try:
+        if args.chaos_ab:
+            # Chaos-plane A/B inside ONE process: alternate disarmed and
+            # armed-but-inert (rule matches no peer, so hooks run their
+            # armed-path checks without ever firing) per iteration.
+            # Interleaving under the same connections removes the
+            # run-to-run box noise that swamps a two-process comparison.
+            from torchft_tpu import _native
+
+            mib = sizes[-1]
+            count = mib * (1 << 20) // 4
+            arr = rng.standard_normal(count).astype(np.float32)
+            inert = "seed:1,spec:stall@data:peer=__none__:ms=1"
+            pg.barrier().wait(timeout=args.timeout)
+            pg.allreduce(arr.copy(), ReduceOp.SUM).wait(timeout=args.timeout)
+            times = {"off": [], "on": []}
+            pair = (("off", " "), ("on", inert))
+            block = 10
+            for i in range(args.iters):
+                # Alternate phase order so a systematic first-vs-second
+                # effect (cache/allocator state left by the previous
+                # collective) cancels instead of biasing one phase.
+                for phase, spec in (pair if i % 2 == 0 else pair[::-1]):
+                    _native.chaos_init(spec)
+                    buf = arr.copy()
+                    # Barrier after arming: both ranks are in the same
+                    # phase before the timed block starts. Timing a block
+                    # of back-to-back collectives (~0.5 s) instead of a
+                    # single one averages scheduler noise that otherwise
+                    # swamps a sub-1% effect on a shared box.
+                    pg.barrier().wait(timeout=args.timeout)
+                    t0 = time.perf_counter()
+                    for _ in range(block):
+                        pg.allreduce(buf, ReduceOp.SUM).wait(
+                            timeout=args.timeout
+                        )
+                    times[phase].append(
+                        (time.perf_counter() - t0) / block
+                    )
+            _native.chaos_init(" ")
+            # Each iteration's off/on pair runs back-to-back, so the
+            # per-iteration ratio cancels load drift that a min-of-mins
+            # across the whole run cannot; the median ratio is robust to
+            # the occasional scheduler spike on a shared box.
+            ratios = sorted(
+                on / off for on, off in zip(times["on"], times["off"])
+            )
+            median_ratio = ratios[len(ratios) // 2]
+            results.append(
+                {
+                    "size_mib": mib,
+                    "chaos_off_best_s": min(times["off"]),
+                    "armed_inert_best_s": min(times["on"]),
+                    "median_pair_ratio": median_ratio,
+                }
+            )
+            if args.rank == 0 and args.result:
+                with open(args.result, "w") as f:
+                    json.dump(results, f)
+            return 0
         for mib in sizes:
             count = mib * (1 << 20) // 4
             arr = rng.standard_normal(count).astype(np.float32)
@@ -86,6 +145,7 @@ def _run_backend(
     iters: int,
     timeout: float,
     extra_env: dict | None = None,
+    chaos_ab: bool = False,
 ) -> list:
     from torchft_tpu.store import TCPStoreServer
 
@@ -102,6 +162,8 @@ def _run_backend(
                 "--sizes", sizes, "--iters", str(iters),
                 "--timeout", str(timeout),
             ]
+            if chaos_ab:
+                cmd += ["--chaos-ab"]
             if rank == 0:
                 cmd += ["--result", result_path]
             procs.append(
@@ -145,6 +207,12 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--result", default="")
     ap.add_argument(
+        "--chaos-ab",
+        action="store_true",
+        help="worker mode: interleaved chaos disarmed-vs-armed-inert A/B "
+        "at the given size (native only)",
+    )
+    ap.add_argument(
         "--out",
         default=os.path.join(REPO, "BENCH_PG_allreduce.json"),
         help="report path (BENCH_PG_*.json)",
@@ -158,6 +226,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.worker:
         return _worker(args)
+
+    # A chaos schedule inherited from the caller's env would corrupt every
+    # number below; workers inherit this env, so drop it once here.
+    os.environ.pop("TORCHFT_CHAOS", None)
 
     report = {
         "world": args.world,
@@ -218,6 +290,33 @@ def main() -> int:
     print(
         f"  fr recorder on {on_best * 1e3:9.1f} ms  "
         f"off {off_best * 1e3:9.1f} ms  overhead {overhead_pct:+.1f}%"
+    )
+
+    # Chaos-plane overhead at the largest size, measured as an interleaved
+    # in-process A/B (see _worker --chaos-ab): disarmed (TORCHFT_CHAOS
+    # unset — one relaxed atomic load per I/O call) vs armed-but-inert
+    # (rule filters scanned once per ctx generation, then a cached
+    # per-ctx verdict). The armed number upper-bounds what the disarmed
+    # gate could possibly cost. Budget: < 1% for the disarmed path.
+    print(f"== bench native (chaos off vs armed-inert A/B): {largest} MiB ==")
+    ab_rows = _run_backend(
+        "native", args.world, str(largest), max(args.iters, 5), args.timeout,
+        extra_env={"TORCHFT_NATIVE_FR_RING": "256"},
+        chaos_ab=True,
+    )
+    ab_off = ab_rows[0]["chaos_off_best_s"]
+    ab_on = ab_rows[0]["armed_inert_best_s"]
+    chaos_pct = (ab_rows[0]["median_pair_ratio"] - 1.0) * 100.0
+    report["chaos_overhead"] = {
+        "size_mib": largest,
+        "chaos_off_best_s": ab_off,
+        "armed_inert_best_s": ab_on,
+        "overhead_pct": chaos_pct,
+    }
+    print(
+        f"  chaos off {ab_off * 1e3:9.1f} ms  "
+        f"armed-inert {ab_on * 1e3:9.1f} ms  "
+        f"overhead (median pair ratio) {chaos_pct:+.2f}%"
     )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)

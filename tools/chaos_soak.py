@@ -1,0 +1,395 @@
+"""Seeded chaos soak: DDP replicas under deterministic fault injection.
+
+Launches a real 2-replica DDP run with a ``TORCHFT_CHAOS`` schedule armed
+in every process (trainers, manager servers, lighthouse), then checks the
+per-step fault-tolerance invariants from the replicas' own event journals:
+
+  I1 agreement   — every replica finished at the same step with the same
+                   parameter sha256, and the per-step commit decisions
+                   (and so batches_committed) are identical across
+                   replicas.
+  I2 no wedge    — every replica reached a clean exit within the run
+                   deadline (no quorum wedge, no stuck collective).
+  I3 recovery    — every injected fault was followed by a committed step
+                   within ``--recovery-bound`` seconds, reported per
+                   injection.
+
+The outcome is ONE JSON line plus a ``CHAOS_SOAK.json`` artifact carrying
+the seed, the spec, and the full injection sequence. Replay the artifact
+with::
+
+    python tools/chaos_soak.py --replay CHAOS_SOAK.json
+
+which re-runs the identical schedule and asserts the injection sequence
+(kind, plane, site, rule, visit — per replica) is bit-for-bit identical:
+the determinism contract of torchft_tpu.chaos.
+
+``--quick`` is the suite_gate lane shape: fixed seed, ~4 fault kinds
+spanning the control and data planes, no process kills (pure chaos-layer
+faults, so the whole drill is one generation). ``--kills N`` layers
+SIGKILL relaunches on top, which drags the heal plane into scope: the
+quick spec's heal rules (``abort_heal``, ``ckpt_truncate``) only ever
+fire when a relaunch actually heals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from torchft_tpu import chaos  # noqa: E402
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
+from torchft_tpu.orchestration import (  # noqa: E402
+    ReplicaGroupRunner,
+    render_topology,
+)
+
+# The quick schedule. Every rule is count-bounded and keyed to sites whose
+# visit order is step-driven (one quorum + one commit vote per step, one
+# allreduce frame per peer per step), so the same seed replays the same
+# injection sequence even across wall-clock jitter:
+#   rpc_delay  — commit votes delayed 120 ms on a fixed cadence (ctrl)
+#   rpc_drop   — two quorum requests torn mid-flight; the client's
+#                jittered-backoff retry loop must absorb them (ctrl)
+#   stall      — p=0.35 seeded stalls on the commit vote's wire frames;
+#                WHICH visits fire comes from the seed hash (ctrl)
+#   stall      — allreduce frames stalled 60 ms on a fixed cadence (data)
+#   reset      — one allreduce connection torn mid-run: the step must
+#                fail, latch, and reconfigure via the commit_failures
+#                quorum bump (data)
+QUICK_SPEC = (
+    "rpc_delay@ctrl:match=should_commit:ms=120:every=4:count=3;"
+    "rpc_drop@ctrl:match=quorum:after=2:count=2;"
+    "stall@ctrl:match=should_commit:p=0.35:ms=50:count=3;"
+    "stall@data:ms=60:every=5:count=4;"
+    "reset@data:after=12:count=1"
+)
+# Heal-plane rules appended when --kills > 0 (they need a heal to target):
+# the first recovery attempt is aborted outright, the second serves a
+# truncated checkpoint stream; the third must succeed.
+HEAL_SPEC = ";abort_heal@heal:count=1;ckpt_truncate@heal:count=1"
+
+QUICK_SEED = 1337
+
+
+def _specs(cmd, n_groups, lighthouse, chaos_env, result_dir, journal_dir):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONUNBUFFERED": "1",
+        "TORCHFT_QUORUM_TIMEOUT_SEC": "120",
+        # A failed heal (abort_heal / ckpt_truncate) costs one commit-gate
+        # vote-gather timeout before the next quorum retries it; the
+        # default 30 s would dominate the drill's wall clock.
+        "TORCHFT_TIMEOUT_SEC": "10",
+        "TORCHFT_CHAOS": chaos_env,
+    }
+    os.makedirs(journal_dir, exist_ok=True)
+    return render_topology(
+        list(cmd) + ["--result-dir", result_dir],
+        num_replica_groups=n_groups,
+        lighthouse_addr=lighthouse.address(),
+        env=env,
+        journal_dir=journal_dir,
+    )
+
+
+def _wait_step_mark(runner, log_dir, group, incarnation, marks, deadline_s):
+    deadline = time.time() + deadline_s
+    path = os.path.join(log_dir, f"replica{group}_rank0.r{incarnation}.log")
+    markers = [f"- step {s}]" for s in marks]
+    while time.time() < deadline:
+        runner.monitor_once()
+        try:
+            text = open(path).read()
+        except OSError:
+            time.sleep(0.3)
+            continue
+        for m in markers:
+            if m in text:
+                return True
+        time.sleep(0.3)
+    return False
+
+
+def _read_journal(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass  # torn tail line of a killed incarnation
+    except OSError:
+        pass
+    return out
+
+
+def _injections(events):
+    """The replica's fired-injection sequence, in journal order."""
+    out = []
+    for ev in events:
+        if ev.get("event") != "chaos_inject":
+            continue
+        a = ev.get("attrs", {})
+        out.append(
+            {
+                "ts": ev.get("ts"),
+                "step": ev.get("step"),
+                "origin": a.get("origin", "python"),
+                "kind": a.get("kind"),
+                "plane": a.get("plane"),
+                "site": a.get("site"),
+                "rule": a.get("rule"),
+                "visit": a.get("visit"),
+                "seq": a.get("seq"),
+            }
+        )
+    return out
+
+
+def _commits(events):
+    """[(ts, step, num_participants)] of committed gates, journal order."""
+    return [
+        (ev.get("ts"), ev.get("step"), ev.get("attrs", {}).get(
+            "num_participants", 0))
+        for ev in events
+        if ev.get("event") == "commit_gate"
+        and ev.get("attrs", {}).get("committed")
+    ]
+
+
+def _retries(events):
+    return [
+        ev.get("attrs", {})
+        for ev in events
+        if ev.get("event") == "rpc_retry"
+    ]
+
+
+def _seq_key(injections):
+    """The determinism fingerprint: what fired, where, on which visit.
+    Timestamps and journal interleaving are excluded — they are the
+    only things allowed to differ between same-seed runs."""
+    return [
+        (i["origin"], i["kind"], i["plane"], i["site"], i["rule"], i["visit"])
+        for i in injections
+    ]
+
+
+def run_soak(args) -> dict:
+    spec = args.spec
+    if args.kills > 0 and "abort_heal" not in spec:
+        spec += HEAL_SPEC
+    chaos_env = f"seed:{args.seed},spec:{spec}"
+    # Fail on a malformed spec HERE, not as 2 wedged trainers later.
+    chaos.parse_spec(chaos_env)
+
+    workdir = tempfile.mkdtemp(prefix="chaos_soak_")
+    result_dir = os.path.join(workdir, "results")
+    log_dir = os.path.join(workdir, "logs")
+    journal_dir = os.path.join(workdir, "journal")
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=2,
+        join_timeout_ms=30000,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=5000,
+    )
+    runner = ReplicaGroupRunner(
+        _specs(
+            [
+                sys.executable, "train_ddp.py", "--model", "cnn",
+                "--steps", str(args.steps), "--batch-size", "8",
+                "--min-replicas", "2",
+            ],
+            2, lighthouse, chaos_env, result_dir, journal_dir,
+        ),
+        max_restarts=max(args.kills * 2, 1),
+        log_dir=log_dir,
+    )
+    t0 = time.time()
+    runner.start()
+    kills_done = 0
+    try:
+        for k in range(args.kills):
+            # Early marks (first half of the run): the kill must land while
+            # plenty of steps remain, or the fast-finishing trainer exits
+            # before the signal and the drill degrades to a plain run.
+            mark = max(1, int(args.steps * (k + 1) / (2 * args.kills + 1)))
+            assert _wait_step_mark(
+                runner, log_dir, 1, kills_done, range(mark, mark + 4),
+                args.deadline,
+            ), f"group 1 never reached step {mark}"
+            assert runner.kill_group(1), "kill failed"
+            kills_done += 1
+        wedge_free = runner.run_until_done(timeout=args.deadline)
+    finally:
+        runner.stop()
+        lighthouse.shutdown()
+    wall_s = time.time() - t0
+
+    # -- harvest ----------------------------------------------------------
+    results, journals = {}, {}
+    for g in (0, 1):
+        try:
+            with open(os.path.join(result_dir, f"group{g}.json")) as f:
+                results[g] = json.load(f)
+        except (OSError, ValueError):
+            results[g] = None
+        journals[g] = _read_journal(
+            os.path.join(journal_dir, f"journal_replica{g}_rank0.jsonl")
+        )
+    injections = {g: _injections(journals[g]) for g in (0, 1)}
+    commits = {g: _commits(journals[g]) for g in (0, 1)}
+    retries = {g: _retries(journals[g]) for g in (0, 1)}
+
+    # -- I1: committed replicas agree -------------------------------------
+    shas = [r.get("param_sha256") if r else None for r in results.values()]
+    steps = [r.get("final_step") if r else None for r in results.values()]
+    committed_steps = {g: [s for (_, s, _) in commits[g]] for g in (0, 1)}
+    batches = {g: sum(n for (_, _, n) in commits[g]) for g in (0, 1)}
+    i1 = (
+        None not in shas
+        and len(set(shas)) == 1
+        and len(set(steps)) == 1
+        and committed_steps[0] == committed_steps[1]
+        and batches[0] == batches[1]
+    )
+
+    # -- I2: no replica wedged --------------------------------------------
+    i2 = bool(wedge_free) and None not in steps
+
+    # -- I3: bounded recovery per injection -------------------------------
+    recoveries = []
+    i3 = True
+    for g in (0, 1):
+        last_commit = max(
+            (ts for (ts, _, _) in commits[g]), default=0.0
+        )
+        for inj in injections[g]:
+            after = [ts for (ts, _, _) in commits[g] if ts >= inj["ts"]]
+            rec = round(min(after) - inj["ts"], 3) if after else None
+            recoveries.append(
+                {
+                    "replica": g,
+                    "kind": inj["kind"],
+                    "plane": inj["plane"],
+                    "site": inj["site"],
+                    "recovery_s": rec,
+                }
+            )
+            if rec is None:
+                # Legal only for a fault injected after the replica's
+                # final commit (nothing left in the run to commit).
+                if inj["ts"] <= last_commit:
+                    i3 = False
+            elif rec > args.recovery_bound:
+                i3 = False
+
+    n_inj = sum(len(v) for v in injections.values())
+    kinds = sorted(set(i["kind"] for v in injections.values() for i in v))
+    planes = sorted(set(i["plane"] for v in injections.values() for i in v))
+    report = {
+        "soak": "chaos",
+        "seed": args.seed,
+        "spec": spec,
+        "steps": args.steps,
+        "kills": kills_done,
+        "injections_fired": n_inj,
+        "kinds_fired": kinds,
+        "planes_fired": planes,
+        "retries": {g: len(retries[g]) for g in (0, 1)},
+        "invariants": {
+            "agreement": bool(i1),
+            "no_wedge": bool(i2),
+            "bounded_recovery": bool(i3),
+        },
+        "final_steps": steps,
+        "batches_committed": batches,
+        "max_recovery_s": max(
+            (r["recovery_s"] for r in recoveries if r["recovery_s"]),
+            default=0.0,
+        ),
+        "wall_s": round(wall_s, 1),
+        "journal_dir": journal_dir,
+    }
+    report["ok"] = bool(
+        i1 and i2 and i3 and n_inj >= 3 and len(planes) >= 2
+    )
+    artifact = {
+        **report,
+        "injections": {str(g): injections[g] for g in (0, 1)},
+        "recoveries": recoveries,
+        "replay_cmd": f"python tools/chaos_soak.py --replay {args.out}",
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    return report
+
+
+def run_replay(args) -> dict:
+    with open(args.replay) as f:
+        ref = json.load(f)
+    args.seed = ref["seed"]
+    args.spec = ref["spec"]
+    args.steps = ref["steps"]
+    args.kills = ref.get("kills", 0)
+    args.out = args.out or (args.replay + ".replay")
+    report = run_soak(args)
+    with open(args.out) as f:
+        new = json.load(f)
+    matches = {}
+    for g in ("0", "1"):
+        matches[g] = _seq_key(
+            [i for i in ref["injections"][g]]
+        ) == _seq_key([i for i in new["injections"][g]])
+    report["replay_of"] = args.replay
+    report["sequence_identical"] = all(matches.values())
+    report["ok"] = report["ok"] and report["sequence_identical"]
+    return report
+
+
+def main() -> int:
+    import signal as _signal
+
+    # Driver SIGTERM must run the finally blocks (runner.stop/lighthouse
+    # shutdown) or the spawned trainers orphan-spin on quorum retries.
+    def _term(_signum, _frame):
+        raise SystemExit(143)
+
+    _signal.signal(_signal.SIGTERM, _term)
+    os.chdir(REPO)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="suite_gate lane: fixed seed, built-in spec, "
+                   "no kills")
+    p.add_argument("--replay", type=str, default=None,
+                   help="CHAOS_SOAK.json to re-run; asserts the injection "
+                   "sequence is identical")
+    p.add_argument("--seed", type=int, default=QUICK_SEED)
+    p.add_argument("--spec", type=str, default=QUICK_SPEC)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--kills", type=int, default=0,
+                   help="SIGKILL relaunches layered on top (arms the "
+                   "heal-plane rules)")
+    p.add_argument("--recovery-bound", type=float, default=120.0)
+    p.add_argument("--deadline", type=float, default=600.0)
+    p.add_argument("--out", type=str, default=None)
+    args = p.parse_args()
+    if args.out is None and args.replay is None:
+        args.out = os.path.join(REPO, "CHAOS_SOAK.json")
+    report = run_replay(args) if args.replay else run_soak(args)
+    print(json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

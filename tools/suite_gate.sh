@@ -20,6 +20,11 @@
 #                                       # 2-replica native kill+heal drill ->
 #                                       # obs_trace.py Chrome trace, schema-
 #                                       # checked with trace-id assertions
+#        bash tools/suite_gate.sh chaos # seeded fault-injection soak:
+#                                       # 2-replica DDP under the quick
+#                                       # schedule -> CHAOS_SOAK.json, then a
+#                                       # same-seed replay asserting the
+#                                       # injection sequence is identical
 set -u
 cd "$(dirname "$0")/.."
 
@@ -31,6 +36,15 @@ fi
 if [ "${1:-}" = "trace" ]; then
   echo "== trace smoke: native kill+heal drill -> obs_trace Chrome trace =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/obs_trace_smoke.py
+fi
+
+if [ "${1:-}" = "chaos" ]; then
+  echo "== chaos soak: seeded 2-replica DDP drill (quick schedule) =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --quick \
+    || exit 1
+  echo "== chaos replay: same seed must reproduce the injection sequence =="
+  exec timeout 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+    --replay CHAOS_SOAK.json
 fi
 
 if [ "${1:-}" = "pg" ]; then

@@ -8,6 +8,7 @@
 #include <cstring>
 #include <string>
 
+#include "chaos.hpp"
 #include "lighthouse.hpp"
 #include "net.hpp"
 
@@ -55,6 +56,9 @@ int main(int argc, char** argv) {
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
+  // Seeded fault injection (TORCHFT_CHAOS, inherited from the spawning
+  // trainer); off and free when the env var is unset.
+  tft::chaos::init_from_env();
   tft::Lighthouse lh(bind_host, port, opts);
   if (!lh.start()) {
     fprintf(stderr, "failed to bind %s:%d\n", bind_host.c_str(), port);

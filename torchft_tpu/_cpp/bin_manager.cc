@@ -8,6 +8,7 @@
 #include <cstdlib>
 #include <string>
 
+#include "chaos.hpp"
 #include "manager_server.hpp"
 #include "net.hpp"
 
@@ -62,6 +63,9 @@ int main(int argc, char** argv) {
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
+  // Seeded fault injection (TORCHFT_CHAOS, inherited from the spawning
+  // trainer); off and free when the env var is unset.
+  tft::chaos::init_from_env();
   tft::ManagerServer server(opts);
   if (!server.start()) {
     fprintf(stderr, "failed to bind manager server\n");

@@ -1,0 +1,126 @@
+// Deterministic seeded fault injection for the C++ side of the system —
+// the exact mirror of torchft_tpu/chaos.py. Consumes the same
+// TORCHFT_CHAOS="seed:<u64>,spec:<kind>@<plane>[:k=v]...[;...]" grammar and
+// the same decision function (FNV-1a-64 site hash folded through splitmix64
+// with per-(rule, site) visit counters), so a schedule replays bit-for-bit
+// across both planes from one seed.
+//
+// Wiring:
+// - net.cc's write_all/read_exact/tcp_connect consult a thread-local context
+//   (plane, peer, match) set via ScopedCtx; no context == no injection, so
+//   unrelated I/O (store traffic, HTTP status) is never perturbed.
+// - collectives.cc stripe jobs set the context around each transfer
+//   (plane "data", peer rank, flight-record tag).
+// - lighthouse.cc / manager_server.cc call server_rpc() per dispatched
+//   request (plane "ctrl", match = RPC type) for rpc_delay / rpc_drop.
+// - Every injection is recorded in a bounded ring; tft_chaos_snapshot
+//   exposes it as JSON so ProcessGroupNative can journal engine-side
+//   injections as chaos_inject events; server binaries log to stderr.
+//
+// Off is free: every hook starts with a relaxed atomic load of a bool.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tft {
+namespace chaos {
+
+// Fault kinds (codes shared with the event ring).
+enum Kind : int32_t {
+  kConnectRefuse = 0,
+  kReset = 1,
+  kStall = 2,
+  kPartialWrite = 3,
+  kRpcDelay = 4,
+  kRpcDrop = 5,
+  kAbortHeal = 6,
+  kCkptTruncate = 7,
+};
+
+// Parses `spec` (TORCHFT_CHAOS grammar) and arms the global schedule.
+// Empty/absent spec leaves chaos off. Returns false (and fills *err) on a
+// malformed spec — callers should fail loudly, a typo'd schedule must not
+// silently inject nothing.
+bool init_from_spec(const std::string& spec, std::string* err);
+
+// init_from_spec(getenv("TORCHFT_CHAOS")). Parse errors go to stderr and
+// abort the arming (servers keep running un-injected).
+void init_from_env();
+
+// True when a schedule is armed (relaxed load; the universal fast gate).
+bool armed();
+
+// Pins the current training step for step=a-b rule windows (mirrors
+// chaos.py set_step; forwarded from Python via tft_chaos_set_step).
+void set_step(int64_t step);
+
+// What a fired rule tells the hook to do.
+struct Decision {
+  int32_t kind = -1;  // -1: nothing fired
+  int64_t ms = 0;
+  double frac = 0.0;
+};
+
+// One eligible visit at `site` for `kind` under the current thread context.
+// Bumps matching rules' visit counters; returns the first firing rule's
+// decision (kind == -1 otherwise). Records the injection in the event ring.
+Decision pick(int32_t kind, const std::string& site);
+
+// RAII thread-local context: attributes I/O inside the scope to
+// (plane, peer, match). Nesting restores the outer context.
+class ScopedCtx {
+ public:
+  ScopedCtx(const char* plane, const std::string& peer,
+            const std::string& match);
+  ~ScopedCtx();
+
+ private:
+  std::string prev_plane_, prev_peer_, prev_match_;
+  bool prev_set_;
+  uint64_t prev_gen_;
+  bool prev_maybe_;
+};
+
+// Hook for net.cc write_all: stall sleeps in place; returns a Decision
+// whose kind is kReset or kPartialWrite when the write should be torn.
+Decision on_write(int fd, size_t len);
+
+// Hook for net.cc read_all/read_exact: stall sleeps; kReset tears.
+Decision on_read(int fd);
+
+// Hook for net.cc tcp_connect: true == refuse (caller returns -1).
+bool on_connect(const std::string& host, int port);
+
+// Server dispatch hook (lighthouse/manager_server handle_conn): applies
+// rpc_delay (sleeps) and rpc_drop/reset (returns false: drop the
+// connection without replying — the client sees a torn RPC).
+bool server_rpc(const std::string& rpc_type);
+
+// Decision hash primitives (exposed for cpp_tests parity checks against
+// the Python implementation).
+uint64_t fnv1a64(const std::string& s);
+uint64_t splitmix64(uint64_t x);
+uint64_t decision_hash(uint64_t seed, uint64_t rule_idx, uint64_t site_hash,
+                       uint64_t visit);
+
+}  // namespace chaos
+}  // namespace tft
+
+// C ABI for ctypes (_native.py) — lives in libtftcollectives.so.
+extern "C" {
+// Arms the global schedule from `spec` (empty string reads TORCHFT_CHAOS).
+// Returns 0 ok / -1 parse error.
+int32_t tft_chaos_init(const char* spec);
+// 1 when a schedule is armed.
+int32_t tft_chaos_armed();
+// Mirrors chaos.py set_step for step-windowed rules on this plane.
+void tft_chaos_set_step(int64_t step);
+// Monotonic count of injections fired so far.
+int64_t tft_chaos_seq();
+// JSON {"seq": N, "events": [{seq, kind, plane, site, rule, visit, step,
+// ms, frac, ts_ns}, ...]} with events whose seq > since_seq. Returns bytes
+// written, or -needed when `cap` is too small (caller grows and retries —
+// same contract as tft_coll_fr_snapshot).
+int64_t tft_chaos_snapshot(int64_t since_seq, char* buf, int64_t cap);
+}

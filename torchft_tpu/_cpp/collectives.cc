@@ -8,6 +8,7 @@
 #include <algorithm>
 #include <cstdio>
 
+#include "chaos.hpp"
 #include "net.hpp"
 
 namespace tft {
@@ -265,6 +266,7 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
       if (remaining <= 0 || aborted_.load())
         return fail("timeout: data plane connect to rank " +
                     std::to_string(p));
+      chaos::ScopedCtx cctx("data", std::to_string(p), "configure");
       int fd = tcp_connect_retry(host, port, remaining);
       if (fd < 0)
         return fail("timeout: data plane connect to rank " +
@@ -585,6 +587,11 @@ void CollectiveEngine::send_stripes(int peer, const char* data,
     pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
       const uint64_t t0 = now_realtime_ns();
       const uint64_t sp0 = net_spin_count();
+      // Chaos scope: stall/partial_write/reset rules fire inside write_all,
+      // attributed to (peer rank, collective tag).
+      chaos::ScopedCtx cctx(
+          "data", std::to_string(peer),
+          rec != nullptr ? std::string(rec->tag) : std::string());
       const int64_t remaining = deadline_ms - now_ms();
       const bool ok = remaining > 0 && !aborted_.load() &&
                       write_all(fd, p, len, remaining);
@@ -612,6 +619,9 @@ void CollectiveEngine::recv_stripes(int peer, char* data, uint64_t nbytes,
     pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
       const uint64_t t0 = now_realtime_ns();
       const uint64_t sp0 = net_spin_count();
+      chaos::ScopedCtx cctx(
+          "data", std::to_string(peer),
+          rec != nullptr ? std::string(rec->tag) : std::string());
       const int64_t remaining = deadline_ms - now_ms();
       const bool ok = remaining > 0 && !aborted_.load() &&
                       read_exact(fd, p, len, remaining);
@@ -676,6 +686,9 @@ void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
                    block_elems, deadline_ms, w, rec] {
       const uint64_t t0 = now_realtime_ns();
       const uint64_t sp0 = net_spin_count();
+      chaos::ScopedCtx cctx(
+          "data", std::to_string(peer),
+          rec != nullptr ? std::string(rec->tag) : std::string());
       uint64_t reduce_ns = 0;
       bool ok = false;
       if (!aborted_.load()) {

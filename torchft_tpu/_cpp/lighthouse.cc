@@ -9,6 +9,7 @@
 #include <map>
 #include <sstream>
 
+#include "chaos.hpp"
 #include "net.hpp"
 
 namespace tft {
@@ -159,6 +160,10 @@ void Lighthouse::handle_conn(int fd) {
       resp["ok"] = Json::of(false);
       resp["error"] = Json::of("bad json: " + err);
     } else {
+      // Server-side chaos (rpc_delay sleeps; rpc_drop/reset tear the
+      // connection without replying — the client sees a torn RPC and must
+      // absorb it through its retry policy).
+      if (!chaos::server_rpc(req.get("type").as_str())) break;
       int64_t timeout = req.get("timeout_ms").as_int(60000);
       resp = handle_request(req, now_ms() + timeout);
       // Echo the caller's trace id so both planes of a step share one id

@@ -8,6 +8,7 @@
 #include <cstdio>
 #include <cstdlib>
 
+#include "chaos.hpp"
 #include "net.hpp"
 
 namespace tft {
@@ -106,6 +107,8 @@ void ManagerServer::handle_conn(int fd) {
       resp["ok"] = Json::of(false);
       resp["error"] = Json::of("bad json: " + err);
     } else {
+      // Server-side chaos: delay or drop this RPC (see lighthouse.cc).
+      if (!chaos::server_rpc(req.get("type").as_str())) break;
       int64_t timeout = req.get("timeout_ms").as_int(60000);
       resp = handle_request(req, now_ms() + timeout);
       // Echo the caller's trace id so both planes of a step share one id

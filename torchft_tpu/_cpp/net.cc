@@ -1,6 +1,7 @@
 #include "net.hpp"
 
 #include <arpa/inet.h>
+#include "chaos.hpp"
 #include <errno.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -109,6 +110,7 @@ int tcp_accept(int listen_fd, int timeout_ms) {
 }
 
 int tcp_connect(const std::string& host, int port, int64_t timeout_ms) {
+  if (chaos::armed() && chaos::on_connect(host, port)) return -1;
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
@@ -208,8 +210,8 @@ static bool wait_fd(int fd, short events, int64_t deadline) {
   return rc > 0 && (pfd.revents & (events | POLLHUP | POLLERR));
 }
 
-bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
-  int64_t deadline = now_ms() + timeout_ms;
+static bool write_all_inner(int fd, const char* data, size_t len,
+                            int64_t deadline) {
   size_t off = 0;
   while (off < len) {
     // Optimistic fast path: MSG_DONTWAIT keeps the call non-blocking on a
@@ -227,6 +229,26 @@ bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
     off += static_cast<size_t>(n);
   }
   return true;
+}
+
+bool write_all(int fd, const char* data, size_t len, int64_t timeout_ms) {
+  int64_t deadline = now_ms() + timeout_ms;
+  if (chaos::armed()) {
+    chaos::Decision d = chaos::on_write(fd, len);
+    if (d.kind == chaos::kPartialWrite) {
+      // Write a prefix through the REAL path, then tear the connection:
+      // the peer sees a torn transfer, this side reports failure.
+      size_t cut = static_cast<size_t>(static_cast<double>(len) * d.frac);
+      if (cut > 0) write_all_inner(fd, data, cut, deadline);
+      shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+    if (d.kind == chaos::kReset) {
+      shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+  }
+  return write_all_inner(fd, data, len, deadline);
 }
 
 static bool read_all(int fd, char* data, size_t len, int64_t deadline) {
@@ -249,7 +271,15 @@ static bool read_all(int fd, char* data, size_t len, int64_t deadline) {
 }
 
 bool read_exact(int fd, char* data, size_t len, int64_t timeout_ms) {
-  return read_all(fd, data, len, now_ms() + timeout_ms);
+  int64_t deadline = now_ms() + timeout_ms;
+  if (chaos::armed()) {
+    chaos::Decision d = chaos::on_read(fd);
+    if (d.kind == chaos::kReset) {
+      shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+  }
+  return read_all(fd, data, len, deadline);
 }
 
 bool send_frame(int fd, const std::string& payload, int64_t timeout_ms) {

@@ -80,6 +80,16 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tft_coll_fr_seq.argtypes = [P]
     lib.tft_coll_fr_snapshot.restype = I64
     lib.tft_coll_fr_snapshot.argtypes = [P, U64, P, I64]
+    lib.tft_chaos_init.restype = I32
+    lib.tft_chaos_init.argtypes = [CP]
+    lib.tft_chaos_armed.restype = I32
+    lib.tft_chaos_armed.argtypes = []
+    lib.tft_chaos_set_step.restype = None
+    lib.tft_chaos_set_step.argtypes = [I64]
+    lib.tft_chaos_seq.restype = I64
+    lib.tft_chaos_seq.argtypes = []
+    lib.tft_chaos_snapshot.restype = I64
+    lib.tft_chaos_snapshot.argtypes = [I64, P, I64]
 
 
 def _load() -> ctypes.CDLL:
@@ -99,8 +109,65 @@ def _load() -> ctypes.CDLL:
         except (OSError, RuntimeError) as e:
             _lib_error = f"native collective engine unavailable: {e}"
             raise RuntimeError(_lib_error) from e
+        # Arm the in-library chaos plane from TORCHFT_CHAOS (no-op, and the
+        # hot-path hooks stay a single relaxed atomic load, when unset), and
+        # keep its step window in lockstep with the Python plane's.
+        lib.tft_chaos_init(b"")
+        from torchft_tpu import chaos as _chaos
+
+        _chaos.on_step_change(lambda s: lib.tft_chaos_set_step(int(s)))
+        cur = _chaos.current_step()
+        if cur is not None:
+            lib.tft_chaos_set_step(int(cur))
         _lib = lib
         return lib
+
+
+# -- chaos plane (seeded fault injection inside the native engine) ----------
+
+
+def chaos_armed() -> bool:
+    """True iff the loaded library has an active TORCHFT_CHAOS spec."""
+    if _lib is None:
+        return False
+    return bool(_lib.tft_chaos_armed())
+
+
+def chaos_init(spec: str) -> None:
+    """(Re)arm the native chaos plane from an explicit spec string; empty
+    re-reads TORCHFT_CHAOS. Raises on a malformed spec."""
+    lib = _load()
+    if lib.tft_chaos_init(spec.encode()) != 0:
+        raise ValueError(f"bad TORCHFT_CHAOS spec: {spec!r}")
+
+
+def chaos_set_step(step: int) -> None:
+    """Mirror the trainer's committed step into the library so step-windowed
+    rules scope native injections too. Cheap; safe when chaos is off."""
+    if _lib is not None:
+        _lib.tft_chaos_set_step(int(step))
+
+
+def chaos_seq() -> int:
+    if _lib is None:
+        return 0
+    return int(_lib.tft_chaos_seq())
+
+
+def chaos_snapshot(since_seq: int = 0) -> dict:
+    """Injections recorded inside the library with seq > since_seq, as
+    ``{"seq": N, "events": [...]}`` (bounded ring; oldest dropped first)."""
+    import json
+
+    lib = _load()
+    cap = 16384
+    for _ in range(4):
+        buf = ctypes.create_string_buffer(cap)
+        got = lib.tft_chaos_snapshot(int(since_seq), buf, cap)
+        if got >= 0:
+            return json.loads(buf.value.decode(errors="replace"))
+        cap = -int(got) + 4096
+    raise RuntimeError("native chaos_snapshot: buffer kept growing")
 
 
 def is_available() -> bool:

@@ -14,7 +14,50 @@ import struct
 import time
 from typing import Any, Optional
 
+from . import chaos as _chaos
+
 MAX_FRAME = 1 << 30  # 1 GiB sanity cap, matches net.cc
+
+
+def _chaos_armed() -> bool:
+    """Fast chaos gate: two module-attribute reads when chaos is off and
+    already initialised (the steady state), so the disabled hot path costs
+    nothing measurable. Before the first init the slow path runs once to
+    parse TORCHFT_CHAOS."""
+    return _chaos._STATE is not None or not _chaos._INITED
+
+
+def _chaos_io(sock: socket.socket, op: str, payload=None, timeout=None) -> None:
+    """Applies a scoped chaos injection to one frame send/recv. ``stall``
+    sleeps; ``reset`` closes the socket and raises; ``partial_write`` (send
+    only) writes a prefix of the frame, closes, and raises — the peer sees a
+    torn frame, this side sees a reset."""
+    st = _chaos.active()
+    ctx = _chaos._scope_ctx()
+    if st is None or ctx is None:
+        return
+    plane, peer, match = ctx
+    site = f"{op}:{peer or '?'}"
+    inj = st.pick("stall", plane, site, peer=peer, match=match)
+    if inj is not None:
+        time.sleep(inj.ms / 1000.0)
+    if op == "send" and payload is not None:
+        inj = st.pick("partial_write", plane, site, peer=peer, match=match)
+        if inj is not None:
+            n = len(payload)
+            cut = int(n * inj.frac)
+            try:
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                sock.sendall(struct.pack(">I", n) + bytes(payload[:cut]))
+            except OSError:
+                pass
+            sock.close()
+            raise ConnectionResetError(f"[chaos] partial write: {inj}")
+    inj = st.pick("reset", plane, site, peer=peer, match=match)
+    if inj is not None:
+        sock.close()
+        raise ConnectionResetError(f"[chaos] connection reset: {inj}")
 
 
 class FrameError(RuntimeError):
@@ -62,6 +105,19 @@ def connect(addr: str, timeout: float) -> socket.socket:
     """Connects with exponential backoff retries until ``timeout`` seconds,
     mirroring the reference's net.rs connect() (100ms -> 10s, x1.5)."""
     host, port = parse_addr(addr)
+    if _chaos_armed():
+        st, ctx = _chaos.active(), _chaos._scope_ctx()
+        if st is not None and ctx is not None:
+            plane, peer, match = ctx
+            inj = st.pick(
+                "connect_refuse",
+                plane,
+                f"connect:{peer or addr}",
+                peer=peer or addr,
+                match=match,
+            )
+            if inj is not None:
+                raise ConnectionRefusedError(f"[chaos] connection refused: {inj}")
     deadline = time.monotonic() + timeout
     backoff = 0.1
     last_err: Optional[Exception] = None
@@ -102,6 +158,8 @@ def send_frame(
     payload: "bytes | bytearray | memoryview",
     timeout: Optional[float] = None,
 ) -> None:
+    if _chaos_armed():
+        _chaos_io(sock, "send", payload=payload, timeout=timeout)
     if timeout is not None:
         sock.settimeout(timeout)
     n = len(payload)
@@ -136,6 +194,8 @@ def _recv_exact(sock: socket.socket, n: int, deadline: Optional[float]) -> bytea
 
 
 def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> bytearray:
+    if _chaos_armed():
+        _chaos_io(sock, "recv")
     deadline = None if timeout is None else time.monotonic() + timeout
     header = _recv_exact(sock, 4, deadline)
     (length,) = struct.unpack(">I", header)

@@ -20,6 +20,7 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
+from torchft_tpu import chaos as _chaos
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.telemetry import get_event_log, timed, timeit
 from torchft_tpu.checkpointing._serialization import (
@@ -99,6 +100,12 @@ class _Handler(BaseHTTPRequestHandler):
                          f"(serving {state.step})"
                 )
                 return
+            # Seeded truncation fault: the stream stops partway through a
+            # record, modelling a sender dying mid-transfer. The receiver
+            # must surface EOFError, not hand back a torn state dict.
+            trunc = _chaos.maybe(
+                "ckpt_truncate", "heal", f"ckpt:{what}", match=str(step)
+            )
             if what == "metadata":
                 body = pickle.dumps({"num_chunks": state.num_chunks})
                 self._respond_small(body)
@@ -108,7 +115,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # server never builds a payload-sized pickle blob (a 12 GB
                 # state would otherwise spike to 2x its size per request).
                 assigned = list(range(len(state.buffers)))
-                self._respond_stream(state.meta, assigned, state.buffers)
+                self._respond_stream(
+                    state.meta,
+                    assigned,
+                    state.buffers,
+                    truncate_frac=trunc.frac if trunc else None,
+                )
             elif what.startswith("chunk_"):
                 idx = int(what[len("chunk_"):])
                 if state.num_chunks == 0 or idx >= state.num_chunks:
@@ -121,11 +133,15 @@ class _Handler(BaseHTTPRequestHandler):
                     state.meta if idx == 0 else None,
                     assigned,
                     state.buffers,
+                    truncate_frac=trunc.frac if trunc else None,
                 )
             else:
                 self.send_error(404, "unknown resource")
                 return
-        except BrokenPipeError:
+        except OSError:
+            # BrokenPipe/ConnectionReset from a receiver that died or was
+            # chaos-reset mid-fetch: its manager latches the error; the
+            # serving side just drops the connection.
             pass
         finally:
             state.lock.release_read()
@@ -138,12 +154,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _respond_stream(
-        self, meta: Any, assigned: List[int], buffers: List[Any]
+        self,
+        meta: Any,
+        assigned: List[int],
+        buffers: List[Any],
+        truncate_frac: Optional[float] = None,
     ) -> None:
         """Length-prefixed record stream: pickle({"meta", "indices"}),
         then each assigned buffer's raw bytes.  The exact Content-Length
         is computable without materializing anything payload-sized, so
-        peak server memory per request is one small header."""
+        peak server memory per request is one small header.
+
+        ``truncate_frac`` (chaos ``ckpt_truncate``) stops the stream after
+        that fraction of the payload bytes — mid-record, with the full
+        Content-Length already advertised — and force-closes the
+        connection so the receiver sees a short read, not a clean end."""
         header = pickle.dumps(
             {"meta": meta, "indices": assigned},
             protocol=pickle.HIGHEST_PROTOCOL,
@@ -156,9 +181,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(_LEN.pack(len(header)))
         self.wfile.write(header)
+        payload = sum(v.nbytes for v in views)
+        budget = (
+            int(payload * truncate_frac) if truncate_frac is not None else -1
+        )
         for v in views:
             self.wfile.write(_LEN.pack(v.nbytes))
+            if budget >= 0 and v.nbytes > budget:
+                self.wfile.write(v[:budget])
+                self.wfile.flush()
+                self.close_connection = True
+                return
             self.wfile.write(v)
+            if budget >= 0:
+                budget -= v.nbytes
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -286,6 +322,7 @@ class HTTPTransport(CheckpointTransport):
         header, then each buffer's raw bytes, read record-by-record off
         the socket (no payload-sized intermediate).  Same bounded 404
         retry as _fetch (sender staging can race the receiver's plan)."""
+        _chaos.maybe_stall("heal", "ckpt:fetch", match=url)
         deadline = time.monotonic() + timeout
         while True:
             try:

@@ -25,6 +25,16 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from torchft_tpu import _net
+from torchft_tpu import chaos as _chaos
+
+# Client retry policy, shared by lighthouse and manager clients: bounded
+# exponential backoff with FULL jitter (delay ~ U[0, min(max, base*2^n)]),
+# mirroring the reference's retry.rs ExponentialBackoff. Jitter decorrelates
+# replicas that all lost the same server — without it every client of a
+# restarted lighthouse reconnect-storms in lockstep.
+_RETRY_ATTEMPTS = max(1, int(os.environ.get("TORCHFT_RPC_RETRIES", "3")))
+_RETRY_BASE_S = float(os.environ.get("TORCHFT_RPC_BACKOFF_BASE_S", "0.05"))
+_RETRY_MAX_S = float(os.environ.get("TORCHFT_RPC_BACKOFF_MAX_S", "1.0"))
 
 _CPP_DIR = Path(__file__).resolve().parent / "_cpp"
 _BIN_DIR = _CPP_DIR / "bin"
@@ -195,6 +205,10 @@ class _FramedClient:
     def __init__(self, addr: str, connect_timeout: float) -> None:
         self._addr = addr
         self._connect_timeout = connect_timeout
+        # Chaos attribution uses the HOST only: servers bind ephemeral
+        # ports, and a port-carrying site string would hash differently on
+        # every run — breaking the chaos plane's replay-from-seed contract.
+        self._chaos_peer = addr.rsplit(":", 1)[0]
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._aborted = False
@@ -253,38 +267,58 @@ class _FramedClient:
 
         ``retry=False`` for non-idempotent requests (e.g. should_commit
         votes): a reconnect-resend could double-apply a request whose first
-        copy the server already processed."""
+        copy the server already processed.
+
+        Retries follow the shared backoff policy (``TORCHFT_RPC_RETRIES``
+        attempts, full-jitter exponential delays) and every attempt is
+        bounded by the *remaining* call deadline — backoff sleeps and
+        reconnects spend the caller's budget, never extend it."""
+        rpc = str(req.get("type"))
+        deadline = time.monotonic() + timeout
         with self._lock:
             if self._aborted:
                 # The socket (if any) was killed by abort(); drop it so
                 # the caller after us reconnects cleanly.
                 self._aborted = False
                 self.close_unlocked()
-                raise RequestAborted(
-                    f"request {req.get('type')} to {self._addr} aborted"
-                )
-            attempts = (0, 1) if retry else (1,)
-            for attempt in attempts:
-                if self._sock is None:
-                    # Reconnect bounded by the PER-CALL deadline too: a
-                    # 2 s drain_status probe against a dead server must
-                    # fail in ~2 s, not the full connect_timeout.
-                    self._sock = _net.connect(
-                        self._addr, min(self._connect_timeout, timeout)
+                raise RequestAborted(f"request {rpc} to {self._addr} aborted")
+            max_attempts = _RETRY_ATTEMPTS if retry else 1
+            attempt = 0
+            while True:
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"request {rpc} to {self._addr} timed out"
                     )
                 try:
-                    resp = _net.call_json(self._sock, req, timeout)
+                    if _chaos._STATE is not None or not _chaos._INITED:
+                        self._chaos_rpc(rpc, remaining)
+                    with _chaos.scope("ctrl", peer=self._chaos_peer, match=rpc):
+                        if self._sock is None:
+                            # Reconnect bounded by the REMAINING per-call
+                            # deadline too: a 2 s drain_status probe against
+                            # a dead server must fail in ~2 s, not the full
+                            # connect_timeout — and a slow connect must not
+                            # eat the budget of the send/recv after it.
+                            self._sock = _net.connect(
+                                self._addr,
+                                min(self._connect_timeout, remaining),
+                            )
+                            remaining = deadline - time.monotonic()
+                        resp = _net.call_json(
+                            self._sock, req, max(remaining, 0.001)
+                        )
                     break
                 except (TimeoutError, socket.timeout) as e:
                     self.close_unlocked()
                     if self._aborted:
                         self._aborted = False
                         raise RequestAborted(
-                            f"request {req.get('type')} to {self._addr} "
-                            "aborted"
+                            f"request {rpc} to {self._addr} aborted"
                         ) from e
                     raise TimeoutError(
-                        f"request {req.get('type')} to {self._addr} timed out"
+                        f"request {rpc} to {self._addr} timed out"
                     ) from e
                 except (OSError, _net.FrameError) as e:
                     # FrameError covers the abort path's shutdown(): EOF
@@ -293,15 +327,14 @@ class _FramedClient:
                     if self._aborted:
                         self._aborted = False
                         raise RequestAborted(
-                            f"request {req.get('type')} to {self._addr} "
-                            "aborted"
+                            f"request {rpc} to {self._addr} aborted"
                         ) from e
-                    if attempt == 1:
+                    if attempt >= max_attempts:
                         raise RuntimeError(
-                            f"request {req.get('type')} to {self._addr} failed: {e}"
+                            f"request {rpc} to {self._addr} failed "
+                            f"after {attempt} attempts: {e}"
                         ) from e
-            else:  # pragma: no cover
-                raise RuntimeError("unreachable")
+                    self._retry_sleep(rpc, attempt, deadline, e)
         if not resp.get("ok", False):
             if resp.get("timeout"):
                 raise TimeoutError(resp.get("error", "timed out"))
@@ -309,6 +342,50 @@ class _FramedClient:
                 f"{req.get('type')} to {self._addr} failed: {resp.get('error')}"
             )
         return resp
+
+    def _chaos_rpc(self, rpc: str, remaining: float) -> None:
+        """Control-plane RPC injections: ``rpc_delay`` sleeps (bounded by
+        the call's remaining budget); ``rpc_drop`` tears the connection
+        with the request unsent — a lost request, the torn-RPC shape the
+        retry policy must absorb."""
+        st = _chaos.active()
+        if st is None:
+            return
+        site = f"rpc:{rpc}"
+        inj = st.pick("rpc_delay", "ctrl", site, peer=self._chaos_peer, match=rpc)
+        if inj is not None:
+            time.sleep(min(inj.ms / 1000.0, max(remaining - 0.001, 0.0)))
+        inj = st.pick("rpc_drop", "ctrl", site, peer=self._chaos_peer, match=rpc)
+        if inj is not None:
+            self.close_unlocked()
+            raise _net.FrameError(f"[chaos] rpc dropped: {inj}")
+
+    def _retry_sleep(
+        self, rpc: str, attempt: int, deadline: float, err: Exception
+    ) -> None:
+        """Full-jitter exponential backoff before attempt N+1, clipped to
+        the remaining call budget; journaled so retry storms are visible."""
+        import random
+
+        cap = min(_RETRY_MAX_S, _RETRY_BASE_S * (2.0 ** (attempt - 1)))
+        delay = min(
+            random.uniform(0.0, cap),
+            max(deadline - time.monotonic() - 0.001, 0.0),
+        )
+        from torchft_tpu.telemetry import get_event_log
+
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "rpc_retry",
+                rpc=rpc,
+                addr=self._addr,
+                attempt=attempt,
+                delay_s=round(delay, 4),
+                error=str(err)[:200],
+            )
+        if delay > 0:
+            time.sleep(delay)
 
     def close_unlocked(self) -> None:
         if self._sock is not None:

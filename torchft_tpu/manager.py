@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar
 
 import numpy as np
 
+from torchft_tpu import chaos as _chaos
 from torchft_tpu import futures as ft_futures
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.transport import CheckpointTransport
@@ -408,6 +409,9 @@ class Manager:
         self._journal(
             "quorum_start", allow_heal=allow_heal, shrink_only=shrink_only
         )
+        # Pin the step for chaos step-window rules (``step=a-b``); listeners
+        # mirror it into the native engine's chaos plane.
+        _chaos.set_step(self._step)
         self._errored = None
         self._healing = False
         self._quorum_future = self._executor.submit(
@@ -419,7 +423,16 @@ class Manager:
         if not self._use_async_quorum:
             self.wait_quorum()
             if self._healing:
-                self._apply_pending_state_dict()
+                # Transport errors surfacing here (torn fetch, reset mid
+                # checkpoint apply) latch like every other heal failure —
+                # the commit gate skips the step instead of the raw
+                # ConnectionResetError killing the trainer.
+                try:
+                    self._apply_pending_state_dict()
+                except Exception as e:  # noqa: BLE001 - latched, gate skips
+                    self._logger.exception(f"apply healed state failed: {e}")
+                    self._journal("heal_failed", error=str(e)[:200])
+                    self.report_error(e)
 
     def wait_quorum(self) -> None:
         assert self._quorum_future is not None, (
@@ -560,10 +573,25 @@ class Manager:
 
         self._commit_failures = max(self._commit_failures, result.commit_failures)
 
-        # Recovery (reference: manager.py:662-729, "recovery stream").
+        # Recovery (reference: manager.py:662-729, "recovery stream"). One
+        # budget covers the whole heal (metadata RPC + transfer): each
+        # nested call gets the *remaining* time, so a stalled metadata fetch
+        # can't leave the checkpoint transfer with a fresh full timeout and
+        # blow the step deadline to 2x.
         if allow_heal:
+            heal_deadline = time.monotonic() + self._timeout
+
+            def _heal_left() -> float:
+                return max(heal_deadline - time.monotonic(), 0.001)
+
             try:
                 if result.recover_dst_replica_ranks:
+                    inj = _chaos.maybe(
+                        "abort_heal", "heal", "heal:send",
+                        match=str(result.max_step),
+                    )
+                    if inj is not None:
+                        raise _chaos.ChaosError(f"[chaos] heal aborted: {inj}")
                     self._logger.info(
                         f"sending checkpoint to {result.recover_dst_replica_ranks}"
                     )
@@ -579,7 +607,7 @@ class Manager:
                             dst_ranks=result.recover_dst_replica_ranks,
                             step=result.max_step,
                             state_dict=self._manager_state_dict(),
-                            timeout=self._timeout,
+                            timeout=_heal_left(),
                         )
                     self._journal(
                         "heal_send_done",
@@ -588,12 +616,20 @@ class Manager:
                     )
                 if heal:
                     self._healing = True
+                    inj = _chaos.maybe(
+                        "abort_heal", "heal", "heal:recv",
+                        peer=str(result.recover_src_replica_rank),
+                        match=str(result.max_step),
+                    )
+                    if inj is not None:
+                        raise _chaos.ChaosError(f"[chaos] heal aborted: {inj}")
                     src_client = ManagerClient(
-                        result.recover_src_manager_address, self._connect_timeout
+                        result.recover_src_manager_address,
+                        min(self._connect_timeout, _heal_left()),
                     )
                     try:
                         metadata = src_client._checkpoint_metadata(
-                            self._group_rank, timeout=self._timeout
+                            self._group_rank, timeout=_heal_left()
                         )
                     finally:
                         src_client.close()
@@ -614,7 +650,7 @@ class Manager:
                             src_rank=(result.recover_src_replica_rank or 0),
                             metadata=metadata,
                             step=result.max_step,
-                            timeout=self._timeout,
+                            timeout=_heal_left(),
                         )
                     with self._goodput_lock:
                         self._goodput["heal_count"] += 1
@@ -859,6 +895,12 @@ class Manager:
 
     @traced("torchft::manager::should_commit")
     def _should_commit_inner(self, timeout: Optional[float]) -> bool:
+        # One budget for the whole gate: joining the quorum thread and
+        # applying healed state eat into it, and the commit RPC gets what's
+        # left — so a slow heal can't stretch the gate to heal + timeout.
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self._timeout
+        )
         # Join the quorum thread if nothing else has (e.g. a step with no
         # allreduce); failures are latched, not raised.
         if self._quorum_future is not None:
@@ -867,9 +909,16 @@ class Manager:
             except Exception:  # noqa: BLE001 - latched by _async_quorum
                 pass
         # Apply healed user state before deciding (sync path applies in
-        # start_quorum; async path applies here, manager.py:803-804).
+        # start_quorum; async path applies here, manager.py:803-804). A
+        # transport error surfacing here latches like any heal failure —
+        # the gate votes no instead of the trainer dying on a raw reset.
         if self._healing:
-            self._apply_pending_state_dict()
+            try:
+                self._apply_pending_state_dict()
+            except Exception as e:  # noqa: BLE001 - latched, gate skips
+                self._logger.exception(f"apply healed state failed: {e}")
+                self._journal("heal_failed", error=str(e)[:200])
+                self.report_error(e)
 
         err = self.errored()
         local_ok = (
@@ -881,7 +930,7 @@ class Manager:
                 self._group_rank,
                 self._step,
                 local_ok,
-                timeout=timeout if timeout is not None else self._timeout,
+                timeout=max(deadline - time.monotonic(), 0.001),
                 trace_id=self._trace_id,
             )
         except Exception as e:

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from torchft_tpu import _net
+from torchft_tpu import chaos as _chaos
 from torchft_tpu.store import StoreClient
 from torchft_tpu.telemetry import (
     add_bytes,
@@ -242,8 +243,11 @@ class _PeerConn:
             # dtype from the header.
             data = memoryview(arr_c.view(np.uint8)).cast("B")
         with self.send_lock:
-            _net.send_json(self.sock, header)
-            _net.send_frame(self.sock, data)
+            # Data-plane chaos scope: stall/reset/partial_write rules fire
+            # inside _net's frame I/O, attributed to (peer rank, tag).
+            with _chaos.scope("data", peer=str(self.peer), match=tag):
+                _net.send_json(self.sock, header)
+                _net.send_frame(self.sock, data)
         # Data-plane wire accounting (payload only; the JSON header is
         # tens of bytes) — what makes the quantized codecs' byte cut
         # measurable on any backend (telemetry.byte_stats).
@@ -264,6 +268,20 @@ class _PeerConn:
             pass  # dead/closing conn: its reader death already fails waits
 
     def recv(self, tag: str, timeout: float) -> np.ndarray:
+        if _chaos._STATE is not None or not _chaos._INITED:
+            st = _chaos.active()
+            if st is not None:
+                peer = str(self.peer)
+                site = f"pgrecv:{peer}"
+                inj = st.pick("stall", "data", site, peer=peer, match=tag)
+                if inj is not None:
+                    time.sleep(inj.ms / 1000.0)
+                inj = st.pick("reset", "data", site, peer=peer, match=tag)
+                if inj is not None:
+                    # Kill the transport; the reader thread dies and fails
+                    # this (and every pending) wait through the real
+                    # peer-death path.
+                    self.close()
         q = self._queue(tag)
         try:
             # A message the peer delivered before dying must still be
@@ -412,7 +430,8 @@ class ProcessGroupSocket(ProcessGroup):
                 # higher ranks (avoids duplicate cross connections).
                 for peer in range(rank):
                     peer_addr = store.get_str(f"addr_{peer}", timeout=self._timeout)
-                    sock = _net.connect(peer_addr, self._timeout)
+                    with _chaos.scope("data", peer=str(peer), match="configure"):
+                        sock = _net.connect(peer_addr, self._timeout)
                     _net.send_json(sock, {"rank": rank})
                     peers[peer] = _PeerConn(sock, peer)
                 listener.settimeout(self._timeout)
@@ -844,6 +863,7 @@ class ProcessGroupNative(ProcessGroupSocket):
             else os.environ.get("TORCHFT_NATIVE_FR_RING", "256")
         )
         self._fr_last_seq = 0
+        self._chaos_last_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1015,6 +1035,41 @@ class ProcessGroupNative(ProcessGroupSocket):
             n_streams=int(snap.get("n_streams", 0)),
             peers=snap.get("peers", []),
         )
+        self._drain_chaos_events(log)
+
+    def _drain_chaos_events(self, log: Any) -> None:
+        """Injections fired inside libtftcollectives (the C++ chaos ring)
+        land in the journal with the same ``chaos_inject`` shape the Python
+        plane emits, tagged ``origin=native`` so the soak harness can merge
+        both planes' sequences. The library ring is process-global (not
+        per-engine), so the cursor lives on the PG, which survives engine
+        generations."""
+        if not self._native.chaos_armed():
+            return
+        try:
+            snap = self._native.chaos_snapshot(self._chaos_last_seq)
+        except Exception:  # noqa: BLE001 - telemetry must not fail a step
+            return
+        for ev in snap.get("events", []):
+            seq = int(ev.get("seq", 0))
+            if seq > self._chaos_last_seq:
+                self._chaos_last_seq = seq
+            step = int(ev.get("step", -1))
+            log.emit(
+                "chaos_inject",
+                step=None if step < 0 else step,
+                trace=self._trace_id or None,
+                origin="native",
+                kind=ev.get("kind"),
+                plane=ev.get("plane"),
+                site=ev.get("site"),
+                rule=int(ev.get("rule", -1)),
+                visit=int(ev.get("visit", 0)),
+                seq=seq,
+                ms=int(ev.get("ms", 0)),
+                frac=ev.get("frac", 0.0),
+                ts_ns=int(ev.get("ts_ns", 0)),
+            )
 
     def _accounted(self, engine: Any, fn: Callable[[], None]) -> None:
         tx0, rx0 = engine.bytes_tx(), engine.bytes_rx()
