@@ -1,0 +1,352 @@
+"""Tests for the live fleet-health plane: StepDigest wire budget,
+DigestWindow math, heartbeat compat in both directions, the lighthouse
+fleet table/aggregates, and the online anomaly detector's determinism
+(same digest sequence => same anomaly sequence).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu import _net
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+from torchft_tpu.telemetry import DigestWindow, StepDigest
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    yield server
+    server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# StepDigest wire budget + round-trip
+# ---------------------------------------------------------------------------
+
+
+def _worst_digest() -> StepDigest:
+    return StepDigest(
+        step=2**53 - 1,
+        rate=123456.789,
+        goodput=0.999999,
+        phases={
+            k: [123456.123456, 999999.99999]
+            for k in ("q", "h", "c", "a", "m")
+        },
+        peer_gib_s={f"peer-{i:06d}": 123456.789 for i in range(32)},
+        errored=True,
+        chaos_injections=2**31,
+        commit_failures=2**31,
+    )
+
+
+def test_digest_worst_case_stays_under_budget():
+    digest = _worst_digest()
+    s = digest.to_json()
+    assert len(s.encode()) <= StepDigest.MAX_WIRE_BYTES
+    wire = json.loads(s)
+    assert wire["v"] == 1
+    assert wire["step"] == 2**53 - 1
+    # Peer map is capped, keys truncated — the budget holds by
+    # construction, not by luck.
+    assert len(wire.get("bw", {})) <= StepDigest.MAX_PEERS
+
+
+def test_digest_wire_roundtrip():
+    digest = StepDigest(
+        step=42, rate=1.25, goodput=0.5,
+        phases={"q": [0.001, 0.002]}, peer_gib_s={"1": 2.5},
+        errored=False, chaos_injections=3, commit_failures=1,
+    )
+    wire = json.loads(digest.to_json())
+    back = StepDigest.from_wire(wire)
+    assert back.step == 42
+    assert back.rate == pytest.approx(1.25)
+    assert back.goodput == pytest.approx(0.5)
+    assert back.phases["q"] == pytest.approx([0.001, 0.002])
+    assert back.peer_gib_s["1"] == pytest.approx(2.5)
+    assert back.chaos_injections == 3
+    assert back.commit_failures == 1
+    # chaos/cf omitted when zero keeps the common-case digest smaller.
+    small = json.loads(StepDigest(step=1, rate=0.0, goodput=0.0).to_json())
+    assert "chaos" not in small and "cf" not in small
+
+
+def test_digest_window_rate_goodput_and_pruning():
+    w = DigestWindow(window_s=10.0)
+    w.note_gate(1, True, 1.0, now=1.0)
+    w.note_gate(2, True, 1.0, now=2.0)
+    w.note_gate(3, False, 2.0, now=4.0)
+    snap = w.snapshot(now=4.0)
+    assert snap["step"] == 2  # only COMMITTED steps advance the digest
+    assert snap["rate"] == pytest.approx(2 / 3.0)  # 2 commits over 3 s span
+    assert snap["gp"] == pytest.approx(0.5)  # 2 good seconds of 4 total
+    # Everything ages out of the window: rate/gp go to zero, the last
+    # committed step is retained (it is state, not a rate).
+    snap = w.snapshot(now=30.0)
+    assert snap["rate"] == 0.0
+    assert snap["gp"] == 0.0
+    assert snap["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat compat, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_new_client_against_old_lighthouse():
+    """A digest-carrying heartbeat must not break a lighthouse that
+    predates the fleet plane (it reads only the keys it knows)."""
+    received = []
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve() -> None:
+        conn, _ = lsock.accept()
+        try:
+            while True:
+                req = _net.recv_json(conn, timeout=5)
+                received.append(json.loads(bytes(req).decode())
+                                if isinstance(req, (bytes, bytearray))
+                                else req)
+                # An old lighthouse ignores fields it doesn't know and
+                # answers the heartbeat like it always did.
+                _net.send_json(conn, {"ok": True})
+        except Exception:
+            pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    client = LighthouseClient(f"127.0.0.1:{port}", connect_timeout=5.0)
+    client.heartbeat(
+        "compat", digest={"v": 1, "step": 7}, hb_interval_ms=100
+    )  # must not raise
+    client.close()
+    lsock.close()
+    t.join(timeout=5)
+    assert received, "fake old lighthouse saw no heartbeat"
+    req = received[0]
+    assert req["type"] == "heartbeat"
+    assert req["digest"]["step"] == 7
+
+
+def test_old_client_against_new_lighthouse(lighthouse) -> None:
+    """Digest-less heartbeats (an old client) still land in the fleet
+    table — row present, digest null — and quorum still forms when old
+    and new clients mix."""
+    old = LighthouseClient(lighthouse.address())
+    old.heartbeat("old-style")  # no digest, no declared cadence
+    new = LighthouseClient(lighthouse.address())
+    new.heartbeat("new-style", digest={"v": 1, "step": 3, "rate": 1.0},
+                  hb_interval_ms=60000)
+    fleet = new.fleet()
+    assert fleet["replicas"]["old-style"]["digest"] is None
+    assert fleet["replicas"]["old-style"]["digest_age_ms"] is None
+    assert fleet["replicas"]["new-style"]["digest"]["step"] == 3
+
+    results = {}
+
+    def join(name: str) -> None:
+        c = LighthouseClient(lighthouse.address())
+        results[name] = c.quorum(
+            replica_id=name, step=1, timeout=10.0, address=f"addr-{name}"
+        )
+        c.close()
+
+    threads = [
+        threading.Thread(target=join, args=("old-style",)),
+        threading.Thread(target=join, args=("new-style",)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert results["old-style"].quorum_id == results["new-style"].quorum_id
+    old.close()
+    new.close()
+
+
+def test_manager_heartbeat_piggybacks_digest(lighthouse) -> None:
+    """set_digest on the manager server rides the C++ heartbeat loop all
+    the way into the lighthouse fleet table."""
+    mgr = ManagerServer(
+        replica_id="digester",
+        lighthouse_addr=lighthouse.address(),
+        store_address="store:1",
+        world_size=1,
+        heartbeat_interval_ms=50,
+    )
+    lh = LighthouseClient(lighthouse.address())
+    mc = ManagerClient(mgr.address())
+    try:
+        mc.set_digest({"v": 1, "step": 11, "rate": 2.0, "gp": 0.9})
+        deadline = time.monotonic() + 10
+        row = None
+        while time.monotonic() < deadline:
+            fleet = lh.fleet()
+            row = fleet["replicas"].get("digester")
+            if row and row.get("digest"):
+                break
+            time.sleep(0.05)
+        assert row and row["digest"]["step"] == 11, row
+        # The declared cadence rode along with the digest.
+        assert row["hb_interval_ms"] == 50
+    finally:
+        mc.close()
+        lh.close()
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation + endpoints
+# ---------------------------------------------------------------------------
+
+
+def _dg(step: int, rate: float, gp: float = 1.0, cf: int = 0) -> dict:
+    return {"v": 1, "step": step, "rate": rate, "gp": gp, "err": 0,
+            "cf": cf}
+
+
+def test_fleet_aggregation_and_endpoints(lighthouse) -> None:
+    c = LighthouseClient(lighthouse.address())
+    c.heartbeat("fa", digest=_dg(10, 1.0, gp=0.8), hb_interval_ms=60000)
+    c.heartbeat("fb", digest=_dg(12, 2.0, gp=1.0), hb_interval_ms=60000)
+    c.heartbeat("fc")  # no digest
+    fleet = c.fleet()
+    agg = fleet["agg"]
+    assert agg["n"] == 3
+    assert agg["n_digest"] == 2
+    assert agg["median_rate"] == pytest.approx(2.0)  # upper median
+    assert agg["median_step"] == 12
+    assert agg["median_goodput"] == pytest.approx(1.0)
+    assert agg["stragglers"] == 0
+
+    # HTTP twin serves the same table.
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/fleet.json", timeout=5
+    ) as resp:
+        http_fleet = json.loads(resp.read())
+    assert set(http_fleet["replicas"]) == {"fa", "fb", "fc"}
+
+    # The summary slice is merged into status.json.
+    status = c.status()
+    assert status["fleet"]["n"] == 3
+    assert "anomaly_seq" in status["fleet"]
+
+    # /metrics grows fleet gauges.
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/metrics", timeout=5
+    ) as resp:
+        metrics = resp.read().decode()
+    assert "torchft_lighthouse_anomalies_total" in metrics
+    assert "torchft_lighthouse_fleet_median_step_rate" in metrics
+    c.close()
+
+
+def test_fleet_leave_removes_row(lighthouse) -> None:
+    c = LighthouseClient(lighthouse.address())
+    c.heartbeat("leaver", digest=_dg(1, 1.0), hb_interval_ms=60000)
+    assert "leaver" in c.fleet()["replicas"]
+    c.leave("leaver")
+    assert "leaver" not in c.fleet()["replicas"]
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Online anomaly detector: rules + determinism
+# ---------------------------------------------------------------------------
+
+# Ordered digest sequence driving every arrival-time rule. The declared
+# 60 s cadence keeps the jitter budget far above test timing, so the
+# time-based rule cannot interleave nondeterministically.
+_SEQ = [
+    ("ra", _dg(10, 1.0)),
+    ("rb", _dg(10, 1.0)),
+    ("rb", _dg(10, 1.0, cf=3)),   # commit_stall rises (cf >= 3)
+    ("rb", _dg(10, 0.4)),         # commit_stall clears; slow_rate rises
+                                  # (0.4 < 0.5 * median 1.0)
+    ("rb", _dg(7, 1.0)),          # slow_rate clears; step_lag rises
+                                  # (7 < median 10 - 2)
+    ("rb", _dg(10, 1.0, cf=3)),   # step_lag clears; commit_stall AGAIN
+]
+
+
+def _drive(addr: str, seq) -> list:
+    client = LighthouseClient(addr)
+    for rid, dg in seq:
+        client.heartbeat(rid, digest=dg, hb_interval_ms=60000)
+    fleet = client.fleet()
+    client.close()
+    return [
+        (a["seq"], a["kind"], a["replica_id"])
+        for a in fleet["anomalies"]
+    ]
+
+
+def test_anomaly_rules_fire_in_order():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    try:
+        anomalies = _drive(server.address(), _SEQ)
+    finally:
+        server.shutdown()
+    assert [(k, r) for _, k, r in anomalies] == [
+        ("commit_stall", "rb"),
+        ("slow_rate", "rb"),
+        ("step_lag", "rb"),
+        ("commit_stall", "rb"),
+    ]
+    assert [s for s, _, _ in anomalies] == [1, 2, 3, 4]
+
+
+def test_anomaly_detector_is_deterministic():
+    """Same digest sequence through two fresh lighthouses => identical
+    anomaly sequence (the replay contract chaos drills rely on)."""
+    runs = []
+    for _ in range(2):
+        server = LighthouseServer(
+            min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+        )
+        try:
+            runs.append(_drive(server.address(), _SEQ))
+        finally:
+            server.shutdown()
+    assert runs[0] == runs[1]
+    assert runs[0], "sequence produced no anomalies at all"
+
+
+def test_hb_jitter_flags_closed_gap():
+    """A heartbeat gap blowing the declared-cadence budget flags
+    hb_jitter at arrival (budget = max(8 x cadence, 1 s))."""
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    try:
+        client = LighthouseClient(server.address())
+        client.heartbeat("jit", digest=_dg(1, 1.0), hb_interval_ms=100)
+        time.sleep(1.3)  # > 1 s floor
+        client.heartbeat("jit", digest=_dg(2, 1.0), hb_interval_ms=100)
+        fleet = client.fleet()
+        row = fleet["replicas"]["jit"]
+        assert "hb_jitter" in row["flags"], row
+        assert row["straggler"] is True
+        kinds = [a["kind"] for a in fleet["anomalies"]]
+        assert "hb_jitter" in kinds
+        client.close()
+    finally:
+        server.shutdown()

@@ -385,3 +385,147 @@ def test_manager_trace_ids_across_generations(tmp_path, monkeypatch):
     # (absent, not empty) so tools never group it under a bogus key.
     first = next(r for r in rows if r["event"] == "quorum_start")
     assert "trace" not in first
+
+
+# ---------------------------------------------------------------------------
+# obs_report: mixed native/socket journals and malformed lane records
+# ---------------------------------------------------------------------------
+
+
+def test_native_attribution_tolerates_mixed_and_malformed_journals():
+    """A fleet mixing native-backend replicas, socket-only replicas, and a
+    replica whose lane records are malformed must degrade PER REPLICA —
+    the healthy attribution survives, the broken one is counted, nothing
+    raises (regression: None lane timestamps crashed the whole report)."""
+    events = [
+        _ev(1.0, "native_collective", step=1, replica_id="0",
+            lanes=[{"peer": 1, "stripe": 0, "dir": "tx", "bytes": 1 << 20,
+                    "t0_ns": 0, "t1_ns": 1_000_000}]),
+        # Torn record: null timestamps/bytes (observed from a SIGKILL mid
+        # drain). Degrades to a zero-bandwidth row, does not crash.
+        _ev(1.1, "native_collective", step=1, replica_id="1",
+            lanes=[{"peer": 0, "stripe": 0, "dir": "rx", "bytes": None,
+                    "t0_ns": None, "t1_ns": None}]),
+        # Garbage lane shape entirely: skipped, counted.
+        _ev(1.2, "native_collective", step=1, replica_id="2",
+            lanes=["not-a-lane"]),
+        # Socket-only replica: no native events, simply absent.
+        _ev(1.3, "commit_gate", step=1, replica_id="3", committed=True),
+    ]
+    native = obs_report.native_stall_attribution(events)
+    assert native["0"]["peer"] == 1
+    assert native["0"]["gib_s"] > 0
+    assert native["1"]["count"] == 1
+    assert native["1"]["gib_s"] == 0.0
+    assert native["2"] == {"skipped": 1}
+    assert "3" not in native
+    # The text renderer handles fully-degraded rows too.
+    text = obs_report.render_text({}, [], {}, native)
+    assert "replica 2: attribution degraded" in text
+    assert "replica 0: bounded by peer 1" in text
+
+
+# ---------------------------------------------------------------------------
+# obs_export: fleet gauges + anomaly journaling
+# ---------------------------------------------------------------------------
+
+
+def _fake_fleet():
+    return {
+        "ts_ms": 1000,
+        "anomaly_seq": 3,
+        "agg": {"n": 2, "n_digest": 1, "stragglers": 1,
+                "median_rate": 1.5, "median_step": 10,
+                "median_goodput": 0.9, "max_commit_failures": 4},
+        "replicas": {
+            "a": {"straggler": True, "flags": ["hb_jitter"],
+                  "digest": {"step": 10, "rate": 1.5, "gp": 0.9, "cf": 4},
+                  "last_hb_age_ms": 50, "hb_interval_ms": 100,
+                  "digest_age_ms": 60},
+            "b": {"straggler": False, "flags": [], "digest": None,
+                  "last_hb_age_ms": 40, "hb_interval_ms": 0,
+                  "digest_age_ms": None},
+        },
+        "anomalies": [
+            {"seq": 2, "ts_ms": 900, "replica_id": "a",
+             "kind": "hb_jitter", "detail": {"gap_ms": 2000}},
+            {"seq": 3, "ts_ms": 950, "replica_id": "a",
+             "kind": "commit_stall", "detail": {"cf": 4}},
+        ],
+    }
+
+
+def test_render_fleet_prometheus_gauges():
+    text = obs_export.render_fleet_prometheus(_fake_fleet())
+    assert "torchft_exporter_fleet_replicas 2" in text
+    assert "torchft_exporter_fleet_stragglers 1" in text
+    assert "torchft_exporter_fleet_anomalies_total 3" in text
+    assert "torchft_exporter_fleet_median_step_rate 1.5" in text
+    assert 'torchft_exporter_replica_straggler{replica="a"} 1' in text
+    assert 'torchft_exporter_replica_straggler{replica="b"} 0' in text
+    assert ('torchft_exporter_replica_anomaly{replica="a",'
+            'kind="hb_jitter"} 1') in text
+    assert 'torchft_exporter_replica_step_rate{replica="a"} 1.5' in text
+    assert 'torchft_exporter_replica_commit_failures{replica="a"} 4' in text
+    # Digest-less replica renders no rate/goodput sample, but keeps the
+    # cf gauge at zero (absence of evidence, not a gap in the series).
+    assert 'torchft_exporter_replica_step_rate{replica="b"}' not in text
+    assert 'torchft_exporter_replica_commit_failures{replica="b"} 0' in text
+
+
+def test_journal_anomalies_cursor_dedup(tmp_path):
+    from torchft_tpu.telemetry import EventLog
+
+    path = str(tmp_path / "exp.jsonl")
+    log = EventLog(path, replica_id="exporter")
+    fleet = _fake_fleet()
+    cursor = obs_export.journal_anomalies(log, fleet, 0)
+    assert cursor == 3
+    # Re-polling the same ring with the advanced cursor emits nothing new.
+    cursor = obs_export.journal_anomalies(log, fleet, cursor)
+    assert cursor == 3
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["event"] for l in lines] == ["anomaly", "anomaly"]
+    assert [l["attrs"]["seq"] for l in lines] == [2, 3]
+    assert lines[1]["attrs"]["kind"] == "commit_stall"
+    # Cursor resumption mid-ring: only newer records emit.
+    log2 = EventLog(str(tmp_path / "exp2.jsonl"), replica_id="exporter")
+    assert obs_export.journal_anomalies(log2, fleet, 2) == 3
+    log2.close()
+    lines2 = [json.loads(l) for l in open(str(tmp_path / "exp2.jsonl"))]
+    assert [l["attrs"]["seq"] for l in lines2] == [3]
+
+
+# ---------------------------------------------------------------------------
+# obs_top: render/check on synthetic fleet tables
+# ---------------------------------------------------------------------------
+
+
+def test_obs_top_render_and_check_roundtrip():
+    import obs_top
+
+    fleet = _fake_fleet()
+    frame = obs_top.render(fleet, color=False)
+    assert obs_top.check_frame(fleet, frame) == []
+    assert "STRAGGLER" in frame
+    assert "hb_jitter" in frame
+    # A frame that lost its straggler marking fails the check.
+    bad = frame.replace("STRAGGLER ", "")
+    assert obs_top.check_frame(fleet, bad)
+    # A frame missing a replica row fails the check.
+    missing = "\n".join(
+        ln for ln in frame.splitlines() if not ln.startswith("b")
+    )
+    assert obs_top.check_frame(fleet, missing)
+
+
+def test_obs_top_renders_empty_fleet():
+    import obs_top
+
+    frame = obs_top.render({"replicas": {}, "agg": {}, "anomalies": [],
+                            "anomaly_seq": 0})
+    assert "no replicas" in frame
+    assert obs_top.check_frame(
+        {"replicas": {}, "agg": {}, "anomalies": []}, frame
+    ) == []

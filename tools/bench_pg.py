@@ -138,6 +138,84 @@ def _worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def _worst_case_digest() -> dict:
+    """A StepDigest wire dict at its densest realistic shape (all five
+    phases, MAX_PEERS bandwidth entries, every optional field) so the
+    heartbeat A/B charges the digest path its worst-case serialization and
+    parse cost."""
+    from torchft_tpu.telemetry import StepDigest
+
+    digest = {
+        "v": 1,
+        "step": 2**53 - 1,
+        "rate": 0.0001234,
+        "gp": 0.9999,
+        "ph": {k: [0.001234, 0.005678] for k in ("q", "h", "c", "a", "m")},
+        "bw": {f"p{i:02d}-tpu": 123.4567 for i in range(StepDigest.MAX_PEERS)},
+        "err": 1,
+        "chaos": 999999,
+        "cf": 999,
+    }
+    assert len(json.dumps(digest, separators=(",", ":"))) <= \
+        StepDigest.MAX_WIRE_BYTES
+    return digest
+
+
+def bench_digest_overhead(
+    iters: int = 40,
+    block: int = 20,
+    hb_interval_ms: int = 100,
+) -> dict:
+    """Heartbeat-digest overhead against a LIVE lighthouse, as an
+    interleaved A/B: blocks of heartbeats without a digest vs with a
+    worst-case digest attached, alternating pair order per iteration
+    (same connection, same process — run-to-run noise cancels in the
+    per-iteration delta).
+
+    The gate metric is DUTY-CYCLE overhead: the extra wall time a digest
+    adds to one heartbeat, divided by the heartbeat interval — that is
+    the fraction of the heartbeat loop's period the digest consumes,
+    which is what "overhead < 1%" means for a background loop that
+    spends ~all its time sleeping. A raw RTT ratio would compare two
+    ~100 us loopback round-trips and drown the signal in scheduler
+    noise."""
+    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+    srv = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, heartbeat_timeout_ms=60000
+    )
+    try:
+        client = LighthouseClient(srv.address(), connect_timeout=10.0)
+        digest = _worst_case_digest()
+        for _ in range(2 * block):  # warmup: connection + lighthouse table
+            client.heartbeat("bench_digest", digest=digest,
+                             hb_interval_ms=hb_interval_ms)
+        times = {"off": [], "on": []}
+        pair = (("off", None), ("on", digest))
+        for i in range(iters):
+            for phase, dg in (pair if i % 2 == 0 else pair[::-1]):
+                t0 = time.perf_counter()
+                for _ in range(block):
+                    client.heartbeat("bench_digest", digest=dg,
+                                     hb_interval_ms=hb_interval_ms)
+                times[phase].append((time.perf_counter() - t0) / block)
+        client.close()
+    finally:
+        srv.shutdown()
+    deltas = sorted(on - off for on, off in zip(times["on"], times["off"]))
+    median_delta = deltas[len(deltas) // 2]
+    period_s = hb_interval_ms / 1e3
+    return {
+        "hb_interval_ms": hb_interval_ms,
+        "iters": iters,
+        "block": block,
+        "plain_hb_best_s": min(times["off"]),
+        "digest_hb_best_s": min(times["on"]),
+        "extra_per_heartbeat_s": median_delta,
+        "overhead_pct": (median_delta / period_s) * 100.0,
+    }
+
+
 def _run_backend(
     backend: str,
     world: int,
@@ -218,6 +296,18 @@ def main() -> int:
         help="report path (BENCH_PG_*.json)",
     )
     ap.add_argument(
+        "--digest-ab-only",
+        action="store_true",
+        help="run ONLY the heartbeat-digest overhead A/B and merge the "
+        "digest_overhead block into --out (skips the ~15 min full bench)",
+    )
+    ap.add_argument(
+        "--assert-digest-overhead",
+        type=float,
+        default=0.0,
+        help="fail if digest duty-cycle overhead_pct >= this (0 = no gate)",
+    )
+    ap.add_argument(
         "--assert-speedup",
         type=float,
         default=0.0,
@@ -230,6 +320,40 @@ def main() -> int:
     # A chaos schedule inherited from the caller's env would corrupt every
     # number below; workers inherit this env, so drop it once here.
     os.environ.pop("TORCHFT_CHAOS", None)
+
+    def run_digest_ab() -> dict:
+        print("== bench heartbeat digest (plain vs worst-case digest) ==")
+        d = bench_digest_overhead()
+        print(
+            f"  plain hb {d['plain_hb_best_s'] * 1e6:7.1f} us  "
+            f"digest hb {d['digest_hb_best_s'] * 1e6:7.1f} us  "
+            f"extra/hb {d['extra_per_heartbeat_s'] * 1e6:+7.1f} us  "
+            f"duty-cycle overhead {d['overhead_pct']:+.3f}% "
+            f"(interval {d['hb_interval_ms']} ms)"
+        )
+        if args.assert_digest_overhead and (
+            d["overhead_pct"] >= args.assert_digest_overhead
+        ):
+            raise SystemExit(
+                f"FAIL: digest overhead {d['overhead_pct']:.3f}% >= "
+                f"{args.assert_digest_overhead}%"
+            )
+        return d
+
+    if args.digest_ab_only:
+        # Merge into an existing report so a full bench's numbers survive.
+        report = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    report = json.load(f)
+            except (OSError, ValueError):
+                report = {}
+        report["digest_overhead"] = run_digest_ab()
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"== digest_overhead merged into {args.out} ==")
+        return 0
 
     report = {
         "world": args.world,
@@ -318,6 +442,10 @@ def main() -> int:
         f"armed-inert {ab_on * 1e3:9.1f} ms  "
         f"overhead (median pair ratio) {chaos_pct:+.2f}%"
     )
+    # Heartbeat-digest overhead (control plane): in-process interleaved
+    # A/B against a live lighthouse; see bench_digest_overhead.
+    report["digest_overhead"] = run_digest_ab()
+
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(

@@ -108,6 +108,119 @@ def render_prometheus(sample: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def scrape_fleet(client: LighthouseClient,
+                 timeout: float = 5.0) -> Optional[Dict[str, Any]]:
+    """One ``fleet`` scrape (live health table). Returns ``None`` against an
+    old lighthouse that predates the RPC instead of failing the whole poll."""
+    try:
+        return client.fleet(timeout=timeout)
+    except Exception:  # noqa: BLE001 - fleet plane is additive
+        return None
+
+
+def render_fleet_prometheus(fleet: Dict[str, Any]) -> str:
+    """Prometheus gauges from the lighthouse's live fleet table: per-replica
+    straggler/step-rate/goodput plus fleet-wide aggregates and the anomaly
+    counter monitoring should alert on."""
+    lines = []
+
+    def header(name: str, help_: str) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+
+    def esc(s: Any) -> str:
+        return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
+    agg = fleet.get("agg") or {}
+    replicas = fleet.get("replicas") or {}
+    header("torchft_exporter_fleet_replicas",
+           "Replicas in the lighthouse fleet table.")
+    lines.append(f"torchft_exporter_fleet_replicas {int(agg.get('n', 0))}")
+    header("torchft_exporter_fleet_stragglers",
+           "Replicas currently flagged as stragglers.")
+    lines.append("torchft_exporter_fleet_stragglers "
+                 f"{int(agg.get('stragglers', 0))}")
+    header("torchft_exporter_fleet_anomalies_total",
+           "Anomalies detected since lighthouse boot (rise edges).")
+    lines.append("torchft_exporter_fleet_anomalies_total "
+                 f"{int(fleet.get('anomaly_seq', 0))}")
+    if agg.get("median_rate") is not None:
+        header("torchft_exporter_fleet_median_step_rate",
+               "Median committed-steps-per-second across digest replicas.")
+        lines.append("torchft_exporter_fleet_median_step_rate "
+                     f"{float(agg['median_rate']):.6g}")
+    if agg.get("median_goodput") is not None:
+        header("torchft_exporter_fleet_median_goodput",
+               "Median rolling goodput fraction across digest replicas.")
+        lines.append("torchft_exporter_fleet_median_goodput "
+                     f"{float(agg['median_goodput']):.6g}")
+
+    header("torchft_exporter_replica_straggler",
+           "1 when the lighthouse flags this replica as a straggler.")
+    for rid in sorted(replicas):
+        flag = 1 if replicas[rid].get("straggler") else 0
+        lines.append(
+            f'torchft_exporter_replica_straggler{{replica="{esc(rid)}"}} '
+            f"{flag}")
+    header("torchft_exporter_replica_anomaly",
+           "1 per active anomaly flag (kind label) on this replica.")
+    for rid in sorted(replicas):
+        for kind in sorted(replicas[rid].get("flags") or []):
+            lines.append(
+                f'torchft_exporter_replica_anomaly{{replica="{esc(rid)}",'
+                f'kind="{esc(kind)}"}} 1')
+    header("torchft_exporter_replica_step_rate",
+           "Committed steps per second from this replica's digest.")
+    for rid in sorted(replicas):
+        dg = replicas[rid].get("digest") or {}
+        if "rate" in dg:
+            lines.append(
+                f'torchft_exporter_replica_step_rate{{replica="{esc(rid)}"}} '
+                f"{float(dg['rate']):.6g}")
+    header("torchft_exporter_replica_goodput",
+           "Rolling goodput fraction from this replica's digest.")
+    for rid in sorted(replicas):
+        dg = replicas[rid].get("digest") or {}
+        if "gp" in dg:
+            lines.append(
+                f'torchft_exporter_replica_goodput{{replica="{esc(rid)}"}} '
+                f"{float(dg['gp']):.6g}")
+    header("torchft_exporter_replica_commit_failures",
+           "Consecutive commit failures from this replica's digest.")
+    for rid in sorted(replicas):
+        dg = replicas[rid].get("digest") or {}
+        lines.append(
+            f'torchft_exporter_replica_commit_failures{{'
+            f'replica="{esc(rid)}"}} {int(dg.get("cf", 0))}')
+    return "\n".join(lines) + "\n"
+
+
+def journal_anomalies(journal: Optional[EventLog],
+                      fleet: Optional[Dict[str, Any]],
+                      cursor: int) -> int:
+    """Emit every anomaly newer than ``cursor`` as an ``anomaly`` journal
+    event; returns the new cursor. The lighthouse assigns each anomaly a
+    monotone ``seq``, so a restarting exporter only replays what the ring
+    still holds."""
+    if fleet is None:
+        return cursor
+    for rec in fleet.get("anomalies") or []:
+        seq = int(rec.get("seq", 0))
+        if seq <= cursor:
+            continue
+        cursor = seq
+        if journal is not None:
+            journal.emit(
+                "anomaly",
+                seq=seq,
+                replica=str(rec.get("replica_id", "")),
+                kind=str(rec.get("kind", "")),
+                ts_ms=int(rec.get("ts_ms", 0)),
+                detail=rec.get("detail"),
+            )
+    return cursor
+
+
 def latest_native_counters(
     events: list,
 ) -> Dict[str, Dict[str, Any]]:
@@ -199,12 +312,15 @@ class _Exporter:
     def __init__(self, journal_paths: Optional[list] = None) -> None:
         self._lock = threading.Lock()
         self._sample: Optional[Dict[str, Any]] = None
+        self._fleet: Optional[Dict[str, Any]] = None
         self._error: str = "no scrape yet"
         self._journal_paths = list(journal_paths or [])
 
-    def update(self, sample: Dict[str, Any]) -> None:
+    def update(self, sample: Dict[str, Any],
+               fleet: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
             self._sample = sample
+            self._fleet = fleet
             self._error = ""
 
     def fail(self, error: str) -> None:
@@ -213,8 +329,10 @@ class _Exporter:
 
     def render(self) -> str:
         with self._lock:
-            sample, error = self._sample, self._error
+            sample, fleet, error = self._sample, self._fleet, self._error
         body = render_prometheus(sample) if sample is not None else ""
+        if fleet is not None:
+            body += render_fleet_prometheus(fleet)
         if self._journal_paths:
             try:
                 body += render_native_prometheus(
@@ -292,6 +410,10 @@ def main(argv: Optional[list] = None) -> int:
             if journal is not None:
                 journal.emit("lighthouse_status", **sample)
             sys.stdout.write(render_prometheus(sample))
+            fleet = scrape_fleet(client)
+            if fleet is not None:
+                journal_anomalies(journal, fleet, 0)
+                sys.stdout.write(render_fleet_prometheus(fleet))
         if args.journal:
             sys.stdout.write(
                 render_native_prometheus(
@@ -314,13 +436,18 @@ def main(argv: Optional[list] = None) -> int:
         print(f"serving /metrics on :{server.server_address[1]}", flush=True)
 
     scrapes = 0
+    anomaly_cursor = 0
     try:
         while True:
             try:
                 sample = scrape(client)
-                exporter.update(sample)
+                fleet = scrape_fleet(client)
+                exporter.update(sample, fleet)
                 if journal is not None:
                     journal.emit("lighthouse_status", **sample)
+                anomaly_cursor = journal_anomalies(
+                    journal, fleet, anomaly_cursor
+                )
                 scrapes += 1
                 if args.max_scrapes and scrapes >= args.max_scrapes:
                     return 0

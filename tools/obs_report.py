@@ -196,27 +196,41 @@ def native_stall_attribution(
     winner across records names the peer (and direction) a stalled
     allreduce is actually waiting on, with the bandwidth that lane
     achieved — "slow because peer 2's recv stripe ran at 0.3 GiB/s", not
-    just "allreduce was slow"."""
+    just "allreduce was slow".
+
+    Journals routinely mix replicas on the native engine with replicas on
+    the socket backend (heterogeneous fleets, mid-run backend flips), and
+    partially-written lane records can carry null timestamps. A malformed
+    record degrades only its own replica's attribution (counted in
+    ``skipped``) instead of aborting the whole report."""
     agg: Dict[Tuple[str, Any, Any, Any], Dict[str, Any]] = {}
     totals: Dict[str, int] = {}
+    skipped: Dict[str, int] = {}
+
+    def lane_ns(ln: Any) -> int:
+        return int(ln.get("t1_ns") or 0) - int(ln.get("t0_ns") or 0)
+
     for ev in events:
         if ev.get("event") != "native_collective":
             continue
-        attrs = ev.get("attrs") or {}
-        lanes = attrs.get("lanes") or []
-        if not lanes:
-            continue
         rid = _replica_key(ev)
+        try:
+            attrs = ev.get("attrs") or {}
+            lanes = attrs.get("lanes") or []
+            if not lanes:
+                continue
+            slow = max(lanes, key=lane_ns)
+            wall = max(lane_ns(slow), 1)
+            key = (rid, slow.get("peer"), slow.get("stripe"),
+                   slow.get("dir"))
+            nbytes = int(slow.get("bytes") or 0)
+        except (TypeError, ValueError, AttributeError):
+            skipped[rid] = skipped.get(rid, 0) + 1
+            continue
         totals[rid] = totals.get(rid, 0) + 1
-        slow = max(
-            lanes,
-            key=lambda ln: int(ln.get("t1_ns", 0)) - int(ln.get("t0_ns", 0)),
-        )
-        wall = max(int(slow.get("t1_ns", 0)) - int(slow.get("t0_ns", 0)), 1)
-        key = (rid, slow.get("peer"), slow.get("stripe"), slow.get("dir"))
         a = agg.setdefault(key, {"count": 0, "bytes": 0, "wall_ns": 0})
         a["count"] += 1
-        a["bytes"] += int(slow.get("bytes", 0))
+        a["bytes"] += nbytes
         a["wall_ns"] += wall
     per_replica: Dict[str, Dict[str, Any]] = {}
     for (rid, peer, stripe, d), a in agg.items():
@@ -233,6 +247,8 @@ def native_stall_attribution(
                 (a["bytes"] / (1 << 30)) / (a["wall_ns"] / 1e9), 4
             ),
         }
+    for rid, n in skipped.items():
+        per_replica.setdefault(rid, {})["skipped"] = n
     return per_replica
 
 
@@ -306,12 +322,20 @@ def render_text(
                    "collective, majority winner):")
         for rid in sorted(native):
             a = native[rid]
-            out.append(
-                f"  replica {rid}: bounded by peer {a['peer']} "
-                f"stripe {a['stripe']} ({a['dir']}) in "
-                f"{a['count']}/{a['records']} collectives "
-                f"at {a['gib_s']} GiB/s"
-            )
+            if "peer" in a:
+                suffix = (f" (+{a['skipped']} malformed records skipped)"
+                          if a.get("skipped") else "")
+                out.append(
+                    f"  replica {rid}: bounded by peer {a['peer']} "
+                    f"stripe {a['stripe']} ({a['dir']}) in "
+                    f"{a['count']}/{a['records']} collectives "
+                    f"at {a['gib_s']} GiB/s{suffix}"
+                )
+            else:
+                out.append(
+                    f"  replica {rid}: attribution degraded — all "
+                    f"{a.get('skipped', 0)} native records malformed"
+                )
     if goodput:
         out.append("")
         out.append(

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""obs_top: live terminal dashboard for the lighthouse fleet-health plane.
+
+Polls the lighthouse's ``/fleet.json`` endpoint and redraws a compact
+``top``-style table — one row per replica with its last committed step,
+step rate, rolling goodput, phase p95s, native per-peer bandwidth,
+heartbeat age, and any straggler/anomaly flags the lighthouse's online
+detector has raised. Plain ANSI escapes only (cursor-home + clear), no
+curses, so it works over ssh, in tmux panes, and under ``script``.
+
+Usage::
+
+    python tools/obs_top.py --lighthouse 127.0.0.1:29510
+    python tools/obs_top.py --lighthouse 127.0.0.1:29510 --once
+    python tools/obs_top.py --lighthouse 127.0.0.1:29510 --once --check
+
+``--once`` renders a single frame to stdout and exits (no escapes).
+``--check`` validates the rendered frame against the fetched JSON (every
+replica rendered, stragglers marked, aggregate line consistent) and exits
+non-zero on a mismatch — the CI fleet lane uses it as a render smoke.
+
+Env: ``TORCHFT_LIGHTHOUSE`` is the default for ``--lighthouse``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+ANSI_HOME_CLEAR = "\x1b[H\x1b[J"
+ANSI_BOLD = "\x1b[1m"
+ANSI_RED = "\x1b[31m"
+ANSI_YELLOW = "\x1b[33m"
+ANSI_RESET = "\x1b[0m"
+
+
+def fetch_fleet(lighthouse: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """GET http://<lighthouse>/fleet.json and decode it."""
+    url = f"http://{lighthouse}/fleet.json"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt(v: Any, fmt: str = "{:.2f}", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    try:
+        return fmt.format(float(v))
+    except (TypeError, ValueError):
+        return dash
+
+
+def _phase_ms(digest: Dict[str, Any], key: str) -> Optional[float]:
+    """p95 of one digest phase, in milliseconds."""
+    ph = digest.get("ph") or {}
+    pair = ph.get(key)
+    if not isinstance(pair, list) or len(pair) < 2 or pair[1] is None:
+        return None
+    return float(pair[1]) * 1e3
+
+
+def _bw_summary(digest: Dict[str, Any]) -> str:
+    """Worst per-peer GiB/s (the lane that bounds the allreduce)."""
+    bw = digest.get("bw") or {}
+    vals = [float(v) for v in bw.values()
+            if isinstance(v, (int, float))]
+    if not vals:
+        return "-"
+    return f"{min(vals):.2f}"
+
+
+def render(fleet: Dict[str, Any], color: bool = False) -> str:
+    """One full frame of the dashboard as a string (no clear escape)."""
+    replicas = fleet.get("replicas") or {}
+    agg = fleet.get("agg") or {}
+    anomalies = fleet.get("anomalies") or []
+
+    def paint(s: str, code: str) -> str:
+        return f"{code}{s}{ANSI_RESET}" if color else s
+
+    lines: List[str] = []
+    lines.append(paint(
+        f"torchft fleet  replicas={int(agg.get('n', 0))} "
+        f"digests={int(agg.get('n_digest', 0))} "
+        f"stragglers={int(agg.get('stragglers', 0))} "
+        f"median_rate={_fmt(agg.get('median_rate'), '{:.3f}')}/s "
+        f"median_step={_fmt(agg.get('median_step'), '{:.0f}')} "
+        f"anomalies={int(fleet.get('anomaly_seq', 0))}",
+        ANSI_BOLD))
+    header = (f"{'REPLICA':<20} {'STEP':>7} {'RATE/s':>7} {'GOOD%':>6} "
+              f"{'Q95ms':>7} {'H95ms':>7} {'C95ms':>7} {'A95ms':>7} "
+              f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7}  FLAGS")
+    lines.append(paint(header, ANSI_BOLD))
+    for rid in sorted(replicas):
+        r = replicas[rid]
+        dg = r.get("digest") or {}
+        flags = sorted(r.get("flags") or [])
+        straggler = bool(r.get("straggler"))
+        tag = " ".join(flags)
+        if straggler:
+            tag = ("STRAGGLER " + tag).strip()
+        gp = dg.get("gp")
+        row = (
+            f"{str(rid)[:20]:<20} "
+            f"{_fmt(dg.get('step'), '{:.0f}'):>7} "
+            f"{_fmt(dg.get('rate'), '{:.3f}'):>7} "
+            f"{_fmt(None if gp is None else float(gp) * 100, '{:.1f}'):>6} "
+            f"{_fmt(_phase_ms(dg, 'q'), '{:.1f}'):>7} "
+            f"{_fmt(_phase_ms(dg, 'h'), '{:.1f}'):>7} "
+            f"{_fmt(_phase_ms(dg, 'c'), '{:.1f}'):>7} "
+            f"{_fmt(_phase_ms(dg, 'a'), '{:.1f}'):>7} "
+            f"{_fmt(_phase_ms(dg, 'm'), '{:.1f}'):>7} "
+            f"{_bw_summary(dg):>6} "
+            f"{_fmt(r.get('last_hb_age_ms'), '{:.0f}'):>7}  "
+            f"{tag}"
+        )
+        if straggler:
+            row = paint(row, ANSI_RED)
+        elif flags:
+            row = paint(row, ANSI_YELLOW)
+        lines.append(row)
+    if not replicas:
+        lines.append("  (no replicas heartbeating yet)")
+    if anomalies:
+        lines.append("")
+        lines.append(paint("recent anomalies:", ANSI_BOLD))
+        for rec in anomalies[-8:]:
+            lines.append(
+                f"  #{rec.get('seq')} {rec.get('kind')} "
+                f"replica={rec.get('replica_id')} "
+                f"detail={json.dumps(rec.get('detail'))}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def check_frame(fleet: Dict[str, Any], frame: str) -> List[str]:
+    """Cross-checks a rendered frame against the JSON it came from.
+    Returns a list of problems (empty = pass)."""
+    problems: List[str] = []
+    replicas = fleet.get("replicas") or {}
+    for rid in replicas:
+        shown = str(rid)[:20]
+        if not any(ln.startswith(shown) for ln in frame.splitlines()):
+            problems.append(f"replica {rid!r} missing from rendered frame")
+            continue
+        if replicas[rid].get("straggler"):
+            row = next(ln for ln in frame.splitlines()
+                       if ln.startswith(shown))
+            if "STRAGGLER" not in row:
+                problems.append(
+                    f"replica {rid!r} is a straggler but its row has no "
+                    f"STRAGGLER tag")
+        for kind in replicas[rid].get("flags") or []:
+            row = next(ln for ln in frame.splitlines()
+                       if ln.startswith(shown))
+            if kind not in row:
+                problems.append(
+                    f"replica {rid!r} flag {kind!r} not rendered")
+    agg = fleet.get("agg") or {}
+    head = frame.splitlines()[0] if frame else ""
+    if f"replicas={int(agg.get('n', 0))}" not in head:
+        problems.append("aggregate replica count missing from header")
+    if f"stragglers={int(agg.get('stragglers', 0))}" not in head:
+        problems.append("aggregate straggler count missing from header")
+    return problems
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--lighthouse",
+                   default=os.environ.get("TORCHFT_LIGHTHOUSE", ""),
+                   help="lighthouse host:port (default: $TORCHFT_LIGHTHOUSE)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh interval seconds (default 1)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame to stdout and exit")
+    p.add_argument("--check", action="store_true",
+                   help="with --once: validate the frame against the JSON "
+                        "and exit non-zero on mismatch")
+    p.add_argument("--max-frames", type=int, default=0,
+                   help="exit after N frames (0 = run until interrupted)")
+    args = p.parse_args(argv)
+    if not args.lighthouse:
+        p.error("--lighthouse / $TORCHFT_LIGHTHOUSE is required")
+
+    if args.once:
+        fleet = fetch_fleet(args.lighthouse)
+        frame = render(fleet, color=False)
+        sys.stdout.write(frame)
+        if args.check:
+            problems = check_frame(fleet, frame)
+            for prob in problems:
+                print(f"CHECK FAIL: {prob}", file=sys.stderr)
+            return 1 if problems else 0
+        return 0
+
+    color = sys.stdout.isatty()
+    frames = 0
+    try:
+        while True:
+            try:
+                fleet = fetch_fleet(args.lighthouse)
+                frame = render(fleet, color=color)
+            except Exception as e:  # noqa: BLE001 - keep polling
+                frame = f"fleet poll failed: {e}\n"
+            sys.stdout.write((ANSI_HOME_CLEAR if color else "") + frame)
+            sys.stdout.flush()
+            frames += 1
+            if args.max_frames and frames >= args.max_frames:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,12 @@
 #                                       # schedule -> CHAOS_SOAK.json, then a
 #                                       # same-seed replay asserting the
 #                                       # injection sequence is identical
+#        bash tools/suite_gate.sh fleet # live fleet-health drill: 2-replica
+#                                       # demo with a chaos heartbeat stall on
+#                                       # one replica; /fleet.json must flag
+#                                       # it straggler WHILE running, obs_top
+#                                       # --once --check must render, digest
+#                                       # heartbeat overhead A/B must be <1%
 set -u
 cd "$(dirname "$0")/.."
 
@@ -45,6 +51,11 @@ if [ "${1:-}" = "chaos" ]; then
   echo "== chaos replay: same seed must reproduce the injection sequence =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
     --replay CHAOS_SOAK.json
+fi
+
+if [ "${1:-}" = "fleet" ]; then
+  echo "== fleet smoke: live straggler detection + obs_top + digest A/B =="
+  exec timeout 600 env JAX_PLATFORMS=cpu python tools/obs_fleet_smoke.py
 fi
 
 if [ "${1:-}" = "pg" ]; then

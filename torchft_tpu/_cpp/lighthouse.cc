@@ -4,10 +4,12 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstring>
 #include <map>
 #include <sstream>
+#include <vector>
 
 #include "chaos.hpp"
 #include "net.hpp"
@@ -72,6 +74,10 @@ void Lighthouse::tick_loop() {
 
 void Lighthouse::tick() {
   std::unique_lock<std::mutex> lk(mu_);
+  // Time-based anomaly rules (open heartbeat gaps, digest staleness) ride
+  // the tick so a wedged replica is flagged while it is STILL wedged —
+  // before its step completes or its heartbeat resumes.
+  fleet_scan_locked(now_ms());
   std::string reason;
   auto members = quorum_compute(now_ms(), state_, opts_, &reason);
   if (!members) {
@@ -185,13 +191,24 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     // leave lands; the tombstone keeps it from resurrecting the entry (which
     // would stall the survivors' next quorum until heartbeat expiry).
     if (!state_.left.count(replica_id)) {
-      state_.heartbeats[replica_id] = now_ms();
+      int64_t now = now_ms();
+      state_.heartbeats[replica_id] = now;
       // Heartbeats carry the manager address so drain_all can reach a
       // replica that heartbeats but never registered a quorum.
       const std::string addr = req.get("address").as_str();
       if (!addr.empty()) state_.heartbeat_addrs[replica_id] = addr;
+      // Live fleet plane: fold the optional digest + declared cadence into
+      // the fleet table and run the digest-driven anomaly rules. Old
+      // clients send neither field; the row simply stays digest-less.
+      fleet_note_heartbeat(replica_id, req, now);
     }
     resp["ok"] = Json::of(true);
+    return resp;
+  }
+  if (type == "fleet") {
+    std::lock_guard<std::mutex> lk(mu_);
+    resp["ok"] = Json::of(true);
+    resp["fleet"] = fleet_json_locked(now_ms());
     return resp;
   }
   if (type == "leave") {
@@ -207,6 +224,9 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
       state_.heartbeat_addrs.erase(replica_id);
       state_.participants.erase(replica_id);
       state_.left.insert(replica_id);
+      // A drained replica must not linger in the fleet table looking like
+      // a straggler whose heartbeats stopped.
+      fleet_.erase(replica_id);
     }
     fprintf(stderr, "[lighthouse] replica %s left gracefully\n",
             replica_id.c_str());
@@ -414,6 +434,225 @@ Json Lighthouse::status_json() {
   for (const auto& id : state_.left) left.push(Json::of(id));
   s["left"] = left;
   s["reason"] = Json::of(last_reason_);
+  // Live-plane summary rides along so a status poller sees fleet health
+  // without a second RPC; the full table stays on /fleet.json.
+  s["fleet"] = fleet_summary_locked(now);
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// Live fleet health plane
+// ---------------------------------------------------------------------------
+
+namespace {
+constexpr size_t kFleetAnomalyRing = 64;     // rise-edge records kept
+constexpr int64_t kFleetStickyMs = 10000;    // straggler display hold
+constexpr int64_t kFleetCommitStall = 3;     // cf streak that flags
+constexpr double kFleetSlowRateFrac = 0.5;   // rate < frac*median flags
+constexpr int64_t kFleetStepLag = 2;         // step < median-lag flags
+constexpr int64_t kFleetJitterMult = 8;      // budget = mult * cadence
+constexpr int64_t kFleetJitterFloorMs = 1000;
+constexpr int64_t kFleetEwmaWarmup = 5;      // gaps before EWMA budget counts
+
+// Upper median: with two replicas this is the HEALTHY one's value, which is
+// the right baseline for "relative slowdown vs the fleet".
+double fleet_median(std::vector<double> v) {
+  std::sort(v.begin(), v.end());
+  return v[v.size() / 2];
+}
+}  // namespace
+
+int64_t Lighthouse::fleet_jitter_budget_ms(const FleetEntry& e) const {
+  // Deterministic when the sender declared its cadence; EWMA of observed
+  // inter-arrival gaps as the old-client fallback. The floor absorbs GC /
+  // scheduler hiccups that are noise at any cadence.
+  int64_t base = e.hb_interval_ms > 0
+                     ? e.hb_interval_ms * kFleetJitterMult
+                     : static_cast<int64_t>(e.hb_gap_ewma_ms) * kFleetJitterMult;
+  return base < kFleetJitterFloorMs ? kFleetJitterFloorMs : base;
+}
+
+void Lighthouse::fleet_set_flag(const std::string& replica_id, FleetEntry& e,
+                                const std::string& kind, int64_t now,
+                                Json detail) {
+  e.straggler_until_ms = now + kFleetStickyMs;
+  if (e.flags.count(kind)) return;  // only the RISE edge is an anomaly
+  e.flags.insert(kind);
+  anomaly_seq_ += 1;
+  Json a = Json::object();
+  a["seq"] = Json::of(anomaly_seq_);
+  a["ts_ms"] = Json::of(now);
+  a["replica_id"] = Json::of(replica_id);
+  a["kind"] = Json::of(kind);
+  a["detail"] = detail;
+  anomalies_.push_back(a);
+  while (anomalies_.size() > kFleetAnomalyRing) anomalies_.pop_front();
+  fprintf(stderr, "[lighthouse] anomaly #%lld: %s on %s %s\n",
+          static_cast<long long>(anomaly_seq_), kind.c_str(),
+          replica_id.c_str(), detail.dump().c_str());
+}
+
+void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
+                                      const Json& req, int64_t now) {
+  FleetEntry& e = fleet_[replica_id];
+  if (e.hb_count > 0) {
+    int64_t gap = now - e.last_hb_ms;
+    // Judge the gap against the budget BEFORE folding it into the EWMA —
+    // a jittered gap must not raise its own threshold.
+    bool budget_valid =
+        e.hb_interval_ms > 0 || e.hb_count >= kFleetEwmaWarmup;
+    if (budget_valid && gap > fleet_jitter_budget_ms(e)) {
+      Json d = Json::object();
+      d["gap_ms"] = Json::of(gap);
+      d["budget_ms"] = Json::of(fleet_jitter_budget_ms(e));
+      fleet_set_flag(replica_id, e, "hb_jitter", now, d);
+      e.last_jitter_ms = now;
+    }
+    e.hb_gap_ewma_ms = e.hb_gap_ewma_ms == 0.0
+                           ? static_cast<double>(gap)
+                           : 0.8 * e.hb_gap_ewma_ms + 0.2 * gap;
+  }
+  e.last_hb_ms = now;
+  e.hb_count += 1;
+  int64_t declared = req.get("hb_interval_ms").as_int(0);
+  if (declared > 0) e.hb_interval_ms = declared;
+  if (!req.has("digest") || !req.get("digest").is_object()) return;
+
+  // Digest-driven rules run at ARRIVAL, against the fleet table as of this
+  // heartbeat: given the same global digest sequence the flag/anomaly
+  // sequence is identical, so a chaos replay reproduces its alerts.
+  e.digest = req.get("digest");
+  e.has_digest = true;
+  e.digest_ms = now;
+
+  int64_t cf = e.digest.get("cf").as_int(0);
+  if (cf >= kFleetCommitStall) {
+    Json d = Json::object();
+    d["cf"] = Json::of(cf);
+    fleet_set_flag(replica_id, e, "commit_stall", now, d);
+  } else {
+    e.flags.erase("commit_stall");
+  }
+
+  std::vector<double> rates, steps;
+  for (const auto& kv : fleet_) {
+    if (!kv.second.has_digest) continue;
+    double r = kv.second.digest.get("rate").as_double(0.0);
+    if (r > 0.0) rates.push_back(r);
+    steps.push_back(
+        static_cast<double>(kv.second.digest.get("step").as_int(0)));
+  }
+  double own_rate = e.digest.get("rate").as_double(0.0);
+  if (rates.size() >= 2) {
+    double med = fleet_median(rates);
+    if (own_rate < kFleetSlowRateFrac * med) {
+      Json d = Json::object();
+      d["rate"] = Json::of(own_rate);
+      d["median_rate"] = Json::of(med);
+      fleet_set_flag(replica_id, e, "slow_rate", now, d);
+    } else {
+      e.flags.erase("slow_rate");
+    }
+  }
+  int64_t own_step = e.digest.get("step").as_int(0);
+  if (steps.size() >= 2) {
+    int64_t med = static_cast<int64_t>(fleet_median(steps));
+    if (own_step < med - kFleetStepLag) {
+      Json d = Json::object();
+      d["step"] = Json::of(own_step);
+      d["median_step"] = Json::of(med);
+      fleet_set_flag(replica_id, e, "step_lag", now, d);
+    } else {
+      e.flags.erase("step_lag");
+    }
+  }
+}
+
+void Lighthouse::fleet_scan_locked(int64_t now) {
+  // Time-based rules only: an OPEN heartbeat gap (the replica is wedged
+  // RIGHT NOW — arrival-side checks can't see it because nothing arrives)
+  // plus expiry of a jitter flag whose evidence has aged out.
+  for (auto& kv : fleet_) {
+    FleetEntry& e = kv.second;
+    bool budget_valid =
+        e.hb_interval_ms > 0 || e.hb_count >= kFleetEwmaWarmup;
+    int64_t open_gap = now - e.last_hb_ms;
+    if (budget_valid && open_gap > fleet_jitter_budget_ms(e)) {
+      Json d = Json::object();
+      d["gap_ms"] = Json::of(open_gap);
+      d["budget_ms"] = Json::of(fleet_jitter_budget_ms(e));
+      d["open"] = Json::of(true);
+      fleet_set_flag(kv.first, e, "hb_jitter", now, d);
+      e.last_jitter_ms = now;
+    } else if (e.flags.count("hb_jitter") &&
+               now - e.last_jitter_ms > kFleetStickyMs) {
+      e.flags.erase("hb_jitter");
+    }
+  }
+}
+
+Json Lighthouse::fleet_json_locked(int64_t now) {
+  Json f = Json::object();
+  f["ts_ms"] = Json::of(now);
+  Json reps = Json::object();
+  std::vector<double> rates, steps, gps;
+  int64_t max_cf = 0;
+  int64_t n_digest = 0, n_straggler = 0;
+  for (const auto& kv : fleet_) {
+    const FleetEntry& e = kv.second;
+    Json r = Json::object();
+    r["last_hb_age_ms"] = Json::of(now - e.last_hb_ms);
+    r["hb_interval_ms"] = Json::of(e.hb_interval_ms);
+    // Old client (no digest ever): fields render as null, row stays —
+    // the forward-compat contract the tests pin.
+    r["digest"] = e.has_digest ? e.digest : Json::null();
+    r["digest_age_ms"] =
+        e.has_digest ? Json::of(now - e.digest_ms) : Json::null();
+    Json fl = Json::array();
+    for (const auto& k : e.flags) fl.push(Json::of(k));
+    if (now - e.last_hb_ms > opts_.heartbeat_timeout_ms)
+      fl.push(Json::of("stale"));  // view-only: presence, not an anomaly
+    r["flags"] = fl;
+    bool straggler = !e.flags.empty() || now < e.straggler_until_ms;
+    r["straggler"] = Json::of(straggler);
+    if (straggler) n_straggler += 1;
+    if (e.has_digest) {
+      n_digest += 1;
+      double rt = e.digest.get("rate").as_double(0.0);
+      if (rt > 0.0) rates.push_back(rt);
+      steps.push_back(
+          static_cast<double>(e.digest.get("step").as_int(0)));
+      gps.push_back(e.digest.get("gp").as_double(0.0));
+      int64_t cf = e.digest.get("cf").as_int(0);
+      if (cf > max_cf) max_cf = cf;
+    }
+    reps[kv.first] = r;
+  }
+  f["replicas"] = reps;
+  Json agg = Json::object();
+  agg["n"] = Json::of(static_cast<int64_t>(fleet_.size()));
+  agg["n_digest"] = Json::of(n_digest);
+  agg["stragglers"] = Json::of(n_straggler);
+  agg["median_rate"] =
+      rates.empty() ? Json::null() : Json::of(fleet_median(rates));
+  agg["median_step"] =
+      steps.empty() ? Json::null()
+                    : Json::of(static_cast<int64_t>(fleet_median(steps)));
+  agg["median_goodput"] =
+      gps.empty() ? Json::null() : Json::of(fleet_median(gps));
+  agg["max_commit_failures"] = Json::of(max_cf);
+  f["agg"] = agg;
+  Json an = Json::array();
+  for (const auto& a : anomalies_) an.push(a);
+  f["anomalies"] = an;
+  f["anomaly_seq"] = Json::of(anomaly_seq_);
+  return f;
+}
+
+Json Lighthouse::fleet_summary_locked(int64_t now) {
+  Json fj = fleet_json_locked(now);
+  Json s = fj.get("agg");
+  s["anomaly_seq"] = fj.get("anomaly_seq");
   return s;
 }
 
@@ -515,6 +754,47 @@ std::string Lighthouse::render_metrics() {
       m << "torchft_lighthouse_member_step{replica=\""
         << prom_escape(mem.replica_id) << "\"} " << mem.step << "\n";
   }
+  // Live-plane alert gauges: straggler flags + the anomaly counter are
+  // what a pager rule fires on; per-replica step rate + the fleet median
+  // give the rule its denominator.
+  m << "# HELP torchft_lighthouse_anomalies_total Anomaly rise-edges "
+       "detected since boot.\n"
+    << "# TYPE torchft_lighthouse_anomalies_total counter\n"
+    << "torchft_lighthouse_anomalies_total " << anomaly_seq_ << "\n";
+  if (!fleet_.empty()) {
+    m << "# HELP torchft_lighthouse_straggler Replica currently flagged "
+         "as a straggler (1) or healthy (0).\n"
+      << "# TYPE torchft_lighthouse_straggler gauge\n";
+    for (const auto& kv : fleet_) {
+      bool straggler =
+          !kv.second.flags.empty() || now < kv.second.straggler_until_ms;
+      m << "torchft_lighthouse_straggler{replica=\""
+        << prom_escape(kv.first) << "\"} " << (straggler ? 1 : 0) << "\n";
+    }
+    std::vector<double> rates;
+    std::ostringstream per_replica;
+    for (const auto& kv : fleet_) {
+      if (!kv.second.has_digest) continue;
+      double r = kv.second.digest.get("rate").as_double(0.0);
+      per_replica << "torchft_lighthouse_replica_step_rate{replica=\""
+                  << prom_escape(kv.first) << "\"} " << r << "\n";
+      if (r > 0.0) rates.push_back(r);
+    }
+    std::string per = per_replica.str();
+    if (!per.empty()) {
+      m << "# HELP torchft_lighthouse_replica_step_rate Committed steps "
+           "per second each replica reported in its digest.\n"
+        << "# TYPE torchft_lighthouse_replica_step_rate gauge\n"
+        << per;
+    }
+    if (!rates.empty()) {
+      m << "# HELP torchft_lighthouse_fleet_median_step_rate Fleet median "
+           "of reported step rates.\n"
+        << "# TYPE torchft_lighthouse_fleet_median_step_rate gauge\n"
+        << "torchft_lighthouse_fleet_median_step_rate "
+        << fleet_median(rates) << "\n";
+    }
+  }
   return m.str();
 }
 
@@ -553,6 +833,10 @@ void Lighthouse::handle_http(int fd) {
     body = render_status_html();
   } else if (path == "/status.json") {
     body = status_json().dump();
+    ctype = "application/json";
+  } else if (path == "/fleet.json") {
+    std::lock_guard<std::mutex> lk(mu_);
+    body = fleet_json_locked(now_ms()).dump();
     ctype = "application/json";
   } else if (path == "/metrics") {
     body = render_metrics();

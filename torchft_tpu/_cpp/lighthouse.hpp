@@ -8,16 +8,32 @@
 // forwards a Kill message to a member's manager address.
 //
 // Wire protocol: length-prefixed JSON frames (see net.hpp). Requests:
-//   {"type":"heartbeat","replica_id":...}
+//   {"type":"heartbeat","replica_id":...[,"digest":{...},"hb_interval_ms":N]}
 //   {"type":"quorum","timeout_ms":N,"requester":{QuorumMember}}
 //   {"type":"status"}
+//   {"type":"fleet"}   (live fleet-health table, the framed twin of
+//       GET /fleet.json: per-replica digest rows + aggregates + anomalies)
 //   {"type":"kill","replica_id":...}
-// HTTP: GET / or /status (dashboard), GET/POST /replica/<id>/kill.
+// HTTP: GET / or /status (dashboard), GET /fleet.json (live health table),
+// GET/POST /replica/<id>/kill.
+//
+// Live fleet plane: heartbeats optionally carry a StepDigest (compact
+// per-replica health summary built by telemetry.StepDigest). The lighthouse
+// keeps a rolling per-replica fleet table, runs an online straggler/anomaly
+// detector (relative step-rate slowdown vs the fleet median, heartbeat-gap
+// jitter against the sender-declared cadence, commit-failure streaks), and
+// serves it all at /fleet.json. Digest-driven rules evaluate at heartbeat
+// ARRIVAL (same digest sequence => same anomaly sequence, so chaos replays
+// reproduce alerts); only the time-based rules (open heartbeat gaps,
+// staleness) live in the tick scan.
 #pragma once
 
 #include <atomic>
 #include <condition_variable>
+#include <deque>
+#include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -53,6 +69,33 @@ class Lighthouse {
   std::string render_status_html();
   std::string render_metrics();
   Json status_json();
+
+  // ---- live fleet health plane ----
+  struct FleetEntry {
+    Json digest;                     // last StepDigest wire dict
+    bool has_digest = false;
+    int64_t digest_ms = 0;           // arrival time of that digest
+    int64_t last_hb_ms = 0;          // last heartbeat arrival
+    int64_t hb_interval_ms = 0;      // sender-declared cadence (0 = unknown)
+    double hb_gap_ewma_ms = 0.0;     // inter-arrival EWMA (old-client fallback)
+    int64_t hb_count = 0;
+    int64_t last_jitter_ms = 0;      // when a closed gap last blew the budget
+    std::set<std::string> flags;     // active anomaly flags
+    int64_t straggler_until_ms = 0;  // sticky display flag
+  };
+  // All fleet_* helpers run with mu_ held by the caller.
+  void fleet_note_heartbeat(const std::string& replica_id, const Json& req,
+                            int64_t now);
+  void fleet_scan_locked(int64_t now);  // time-based rules (gaps, staleness)
+  void fleet_set_flag(const std::string& replica_id, FleetEntry& e,
+                      const std::string& kind, int64_t now, Json detail);
+  int64_t fleet_jitter_budget_ms(const FleetEntry& e) const;
+  Json fleet_json_locked(int64_t now);
+  Json fleet_summary_locked(int64_t now);  // the slice merged into status.json
+
+  std::map<std::string, FleetEntry> fleet_;
+  std::deque<Json> anomalies_;  // rise-edge anomaly ring (capped)
+  int64_t anomaly_seq_ = 0;     // total anomalies ever (ring drops old ones)
 
   std::string bind_host_;
   int port_;

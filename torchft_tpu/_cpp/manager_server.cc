@@ -77,6 +77,11 @@ void ManagerServer::heartbeat_loop() {
       sleep_ms(opts_.heartbeat_interval_ms);
       continue;
     }
+    // Attribute heartbeat I/O to (ctrl, lighthouse-host, "heartbeat") for
+    // the chaos plane: a stall@ctrl:match=heartbeat spec can delay THIS
+    // replica's heartbeats (the fleet lane's straggler signal) without
+    // touching quorum or data traffic.
+    chaos::ScopedCtx chaos_ctx("ctrl", host, "heartbeat");
     if (fd < 0) fd = tcp_connect(host, port, opts_.connect_timeout_ms);
     if (fd >= 0) {
       Json req = Json::object();
@@ -85,6 +90,16 @@ void ManagerServer::heartbeat_loop() {
       // Carry our address: lets the lighthouse drain_all reach us even if
       // we never managed to register a quorum (drain_all blind spot).
       req["address"] = Json::of(address());
+      // Our nominal cadence: lets the lighthouse derive a deterministic
+      // jitter threshold instead of guessing from arrival statistics.
+      req["hb_interval_ms"] = Json::of(opts_.heartbeat_interval_ms);
+      {
+        // Piggyback the latest health digest (if the trainer pushed one).
+        // Old lighthouses read only the keys they know, so this is free
+        // to send unconditionally.
+        std::lock_guard<std::mutex> lk(digest_mu_);
+        if (has_digest_) req["digest"] = digest_;
+      }
       Json resp;
       if (!call_json(fd, req, &resp, 5000)) {
         close(fd);
@@ -171,6 +186,19 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     // step instead of retrying quorums it can never win.
     resp["ok"] = Json::of(true);
     resp["drain_requested"] = Json::of(drain_requested_.load());
+    return resp;
+  }
+  if (type == "set_digest") {
+    // Cache the trainer's latest health digest; the heartbeat loop
+    // attaches it to every lighthouse ping until replaced. Advisory
+    // telemetry only — no validation beyond "is an object" (the
+    // lighthouse tolerates anything), and dropping it is never an error.
+    {
+      std::lock_guard<std::mutex> lk(digest_mu_);
+      digest_ = req.get("digest");
+      has_digest_ = digest_.is_object();
+    }
+    resp["ok"] = Json::of(true);
     return resp;
   }
   if (type == "info") {

@@ -22,6 +22,9 @@
 //   {"type":"request_drain"}   (operator asks the TRAINER to drain: sets a
 //       flag piggybacked on every quorum response as "drain_requested";
 //       the trainer drains at its next step boundary via "leave")
+//   {"type":"set_digest","digest":{...}}   (trainer hands over its latest
+//       StepDigest wire dict; the heartbeat loop attaches it to every
+//       lighthouse heartbeat until replaced — the live fleet-health feed)
 //   {"type":"info"}
 #pragma once
 
@@ -104,6 +107,13 @@ class ManagerServer {
   std::thread accept_thread_;
   std::thread heartbeat_thread_;
   ConnTracker conns_;
+
+  // Latest StepDigest handed over via set_digest, attached verbatim to every
+  // heartbeat frame. Own mutex: the heartbeat loop must never contend with a
+  // quorum round holding mu_ across a lighthouse RPC.
+  std::mutex digest_mu_;
+  Json digest_ = Json::null();
+  bool has_digest_ = false;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -529,9 +529,35 @@ class LighthouseClient:
     def __init__(self, addr: str, connect_timeout: float = 10.0) -> None:
         self._client = _FramedClient(addr, connect_timeout)
 
-    def heartbeat(self, replica_id: str, timeout: float = 5.0) -> None:
-        self._client.call({"type": "heartbeat", "replica_id": replica_id,
-                           "timeout_ms": int(timeout * 1000)}, timeout)
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: float = 5.0,
+        digest: Optional[Dict[str, Any]] = None,
+        hb_interval_ms: int = 0,
+    ) -> None:
+        """One heartbeat, optionally carrying a :class:`~torchft_tpu.
+        telemetry.StepDigest` wire dict (``StepDigest.to_wire()``) plus
+        the sender's nominal heartbeat interval. Old lighthouses read only
+        the keys they know, so the extra fields are silently dropped —
+        a new client never breaks an old fleet."""
+        req: Dict[str, Any] = {
+            "type": "heartbeat", "replica_id": replica_id,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if digest is not None:
+            req["digest"] = digest
+        if hb_interval_ms > 0:
+            req["hb_interval_ms"] = int(hb_interval_ms)
+        self._client.call(req, timeout)
+
+    def fleet(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Live fleet-health table (the framed twin of ``GET
+        /fleet.json``): per-replica digest rows, fleet aggregates, and
+        the anomaly ring. See docs/OBSERVABILITY.md "live plane"."""
+        return self._client.call(
+            {"type": "fleet", "timeout_ms": int(timeout * 1000)}, timeout
+        )["fleet"]
 
     def quorum(
         self,
@@ -773,6 +799,19 @@ class ManagerClient:
             retry=False,  # a resent vote would poison the next barrier round
         )
         return resp["should_commit"]
+
+    def set_digest(self, digest: Dict[str, Any], timeout: float = 2.0) -> None:
+        """Hands the manager server the latest health digest
+        (``StepDigest.to_wire()``); the server's heartbeat loop attaches
+        it to every lighthouse heartbeat until replaced. Fire-and-forget
+        from the trainer's perspective: the digest is advisory telemetry,
+        so callers swallow failures rather than perturb the step."""
+        self._client.call(
+            {"type": "set_digest", "digest": digest,
+             "timeout_ms": int(timeout * 1000)},
+            timeout,
+            retry=False,  # next digest push supersedes this one anyway
+        )
 
     def kill(self, msg: str = "") -> None:
         try:

@@ -22,6 +22,7 @@ dicts are arbitrary pytrees.
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
 import os
 import socket
@@ -43,8 +44,11 @@ from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.store import StoreClient, TCPStoreServer
 from torchft_tpu.telemetry import (
+    DigestWindow,
+    StepDigest,
     get_event_log,
     get_metrics_logger,
+    observe_span,
     set_default_replica_id,
     timeit,
     trace_span,
@@ -199,6 +203,25 @@ class Manager:
         # from the window before bucketing so heal time isn't counted as
         # productive (or doubly as lost) time.
         self._heal_since_gate = 0.0
+        # Allreduce-wait seconds inside the current window (accumulated by
+        # _ManagedWork._finish): subtracting them from the gate dt leaves
+        # the compute residual the live digest reports as its "c" phase.
+        self._allreduce_since_gate = 0.0
+
+        # Live health digest (heartbeat-carried StepDigest): rolling
+        # rate/goodput window fed at every commit gate, pushed to the
+        # manager server (group rank 0) at most every
+        # TORCHFT_DIGEST_INTERVAL_S so it rides the heartbeats to the
+        # lighthouse. TORCHFT_DIGEST=0 turns the push off entirely.
+        self._digest_enabled = os.environ.get("TORCHFT_DIGEST", "1") != "0"
+        try:
+            self._digest_interval_s = float(
+                os.environ.get("TORCHFT_DIGEST_INTERVAL_S", "1.0")
+            )
+        except ValueError:
+            self._digest_interval_s = 1.0
+        self._digest_window = DigestWindow()
+        self._digest_last_push = 0.0
 
         # Rendezvous store (replica-group local; reference uses torchrun's
         # TCPStore, manager.py:271-276).
@@ -951,6 +974,7 @@ class Manager:
         # Heal time inside the window is excluded from the outcome bucket
         # (it is accounted separately as heal_s).
         now = time.monotonic()
+        gate_dt: Optional[float] = None
         with self._goodput_lock:
             if self._last_gate_t is not None:
                 dt = max(
@@ -960,12 +984,25 @@ class Manager:
                     self._goodput["committed_s"] += dt
                 else:
                     self._goodput["failed_s"] += dt
+                gate_dt = dt
             self._last_gate_t = now
             self._heal_since_gate = 0.0
+            allreduce_since_gate = self._allreduce_since_gate
+            self._allreduce_since_gate = 0.0
             if answer:
                 self._goodput["committed_steps"] += 1
             else:
                 self._goodput["failed_commits"] += 1
+
+        if gate_dt is not None:
+            # Feed the live-digest window, and record the compute residual
+            # (gate-to-gate time not spent waiting on the allreduce — the
+            # digest's "c" phase; heal time is already excluded from dt).
+            self._digest_window.note_gate(self._step, answer, gate_dt)
+            observe_span(
+                "torchft::manager::step_compute",
+                max(gate_dt - allreduce_since_gate, 0.0),
+            )
 
         if answer:
             self._step += 1
@@ -976,16 +1013,64 @@ class Manager:
         else:
             self._commit_failures += 1
             self._consecutive_commit_failures += 1
-            if (
-                self._max_retries is not None
-                and self._consecutive_commit_failures > self._max_retries
-            ):
-                raise ExceededMaxRetriesError(
-                    f"exceeded max_retries={self._max_retries} consecutive "
-                    "commit failures"
-                )
+
+        # Push the live digest AFTER the failure-streak bookkeeping (so a
+        # commit_stall streak is visible to the lighthouse) and BEFORE the
+        # max-retries raise (the terminal streak is exactly the one an
+        # operator's dashboard must show).
+        self._maybe_push_digest()
+
+        if not answer and (
+            self._max_retries is not None
+            and self._consecutive_commit_failures > self._max_retries
+        ):
+            raise ExceededMaxRetriesError(
+                f"exceeded max_retries={self._max_retries} consecutive "
+                "commit failures"
+            )
         self._logger.info(f"should_commit={answer} (local_ok={local_ok})")
         return answer
+
+    def _maybe_push_digest(self) -> None:
+        """Builds a :class:`StepDigest` and hands it to the manager server,
+        which piggybacks it on every lighthouse heartbeat. Group rank 0
+        only (the server lives there), rate-limited to
+        ``TORCHFT_DIGEST_INTERVAL_S`` (default 1 s), and every failure is
+        swallowed: the digest is advisory telemetry and must never perturb
+        a training step."""
+        if not self._digest_enabled or self._group_rank != 0:
+            return
+        now = time.monotonic()
+        if now - self._digest_last_push < self._digest_interval_s:
+            return
+        self._digest_last_push = now
+        try:
+            peer_bw = None
+            bw_fn = getattr(self._pg, "peer_gib_s", None)
+            if callable(bw_fn):
+                peer_bw = bw_fn()
+            chaos_n = 0
+            ch = _chaos.active()
+            if ch is not None:
+                chaos_n += ch.injections_fired()
+            try:
+                from torchft_tpu import _native
+
+                chaos_n += _native.chaos_seq()
+            except Exception:  # noqa: BLE001 - native plane optional
+                pass
+            digest = StepDigest.collect(
+                self._digest_window,
+                peer_gib_s=peer_bw,
+                errored=self.errored() is not None,
+                chaos_injections=chaos_n,
+                commit_failures=self._consecutive_commit_failures,
+            )
+            # to_json() enforces the ≤512 B heartbeat budget (dropping bw,
+            # then phases, if ever needed); ship the bounded form.
+            self._client.set_digest(json.loads(digest.to_json()))
+        except Exception:  # noqa: BLE001 - advisory only, never raise
+            pass
 
     def goodput(self) -> Dict[str, Any]:
         """Productive-vs-lost wall-time split since startup: time between
@@ -1168,20 +1253,32 @@ class _ManagedWork(Work):
                         a *= self._scale
                 else:
                     self._arrays = list(result)
+                elapsed = time.monotonic() - t0
+                self._note_allreduce_wait(elapsed)
                 self._manager._journal(
                     "allreduce_complete",
                     ok=True,
-                    elapsed_s=time.monotonic() - t0,
+                    elapsed_s=elapsed,
                 )
             except Exception as e:  # noqa: BLE001
                 self._manager._logger.exception(f"allreduce work failed: {e}")
+                elapsed = time.monotonic() - t0
+                self._note_allreduce_wait(elapsed)
                 self._manager._journal(
                     "allreduce_complete",
                     ok=False,
-                    elapsed_s=time.monotonic() - t0,
+                    elapsed_s=elapsed,
                     error=str(e)[:200],
                 )
                 self._manager.report_error(e)
+
+    def _note_allreduce_wait(self, elapsed: float) -> None:
+        # Backend-independent wall time the TRAINER spent blocked on the
+        # allreduce: the live digest's "a" phase, and the amount the commit
+        # gate subtracts from gate-to-gate time to get the compute residual.
+        observe_span("torchft::manager::allreduce_wait", elapsed)
+        with self._manager._goodput_lock:
+            self._manager._allreduce_since_gate += elapsed
 
     def wait(self, timeout: Optional[float] = None) -> Any:
         self._finish(timeout)

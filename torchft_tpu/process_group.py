@@ -985,6 +985,38 @@ class ProcessGroupNative(ProcessGroupSocket):
         stamp can't race a concurrent collective's."""
         engine.set_trace(f"{self._trace_id}|{tag}" if self._trace_id else tag)
 
+    def peer_gib_s(self) -> Dict[str, float]:
+        """Effective per-peer throughput {peer rank: GiB/s} from the
+        engine's always-on byte/busy counters — the live digest's ``bw``
+        block. Uses a cursor-free snapshot at the current seq (counters
+        only, no records), so reading it never consumes entries from the
+        journal drain's incremental cursor. Empty when the engine is down
+        or nothing has moved yet; cheap enough for a once-per-second
+        digest build."""
+        engine = self._engine
+        if engine is None:
+            return {}
+        try:
+            snap = engine.fr_snapshot(engine.fr_seq())
+        except Exception:  # noqa: BLE001 - telemetry must not fail a step
+            return {}
+        n_streams = max(int(snap.get("n_streams", 1)), 1)
+        out: Dict[str, float] = {}
+        for p in snap.get("peers", []):
+            busy_ns = int(p.get("tx_busy_ns", 0)) + int(p.get("rx_busy_ns", 0))
+            nbytes = int(p.get("tx_bytes", 0)) + int(p.get("rx_bytes", 0))
+            if busy_ns <= 0 or nbytes <= 0:
+                continue
+            # Lane busy-ns accumulate across n_streams parallel stripes;
+            # wall time is busy/streams (same normalization obs_export
+            # applies to native_counters).
+            wall_s = busy_ns / n_streams / 1e9
+            if wall_s > 0:
+                out[str(p.get("peer", "?"))] = (
+                    nbytes / float(1 << 30) / wall_s
+                )
+        return out
+
     def _drain_flight_records(self, engine: Any) -> None:
         """Moves completed engine flight records into the step-event
         journal as ``native_collective`` events (plus one

@@ -54,6 +54,8 @@ __all__ = [
     "get_metrics_logger",
     "EventLog",
     "get_event_log",
+    "StepDigest",
+    "DigestWindow",
     "reset_event_log",
     "set_default_replica_id",
     "trace_window",
@@ -407,6 +409,16 @@ class EventLog:
     pointing everyone at the same path) without interleaving partial
     lines. The in-process lock still serializes threads sharing this
     EventLog instance.
+
+    ``TORCHFT_JOURNAL_MAX_MB`` caps journal size: once the (approximate)
+    size crosses the cap the file is renamed to ``<path>.1`` (replacing
+    any previous rotation) and a fresh file is opened at the same path.
+    Size tracking is one fstat at open plus the byte count of each write,
+    so the cap costs nothing per event. Rotation is single-writer-safe:
+    the rename happens under this instance's lock, between complete
+    lines; processes *sharing* one journal path should leave the cap
+    unset (each process would rotate on its own counter). Unset = no cap,
+    byte-for-byte the previous behavior.
     """
 
     def __init__(self, path: str, replica_id: Optional[str] = None) -> None:
@@ -424,6 +436,19 @@ class EventLog:
         self._fd: int = os.open(
             path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
         )
+        try:
+            self._max_bytes = int(
+                float(os.environ.get("TORCHFT_JOURNAL_MAX_MB", "0") or "0")
+                * (1 << 20)
+            )
+        except ValueError:
+            self._max_bytes = 0
+        self._approx_size = 0
+        if self._max_bytes > 0:
+            try:
+                self._approx_size = os.fstat(self._fd).st_size
+            except OSError:
+                pass
         atexit.register(self.close)
 
     def emit(
@@ -455,7 +480,35 @@ class EventLog:
             try:
                 os.write(self._fd, data)
             except Exception:
-                pass
+                return
+            if self._max_bytes > 0:
+                self._approx_size += len(data)
+                if self._approx_size >= self._max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Rename-based rotation (caller holds ``self._lock``): the full
+        journal becomes ``<path>.1`` (clobbering the previous rotation)
+        and writing continues into a fresh file at ``<path>``. On any
+        failure the journal keeps appending to whatever fd it has —
+        rotation is best-effort, losing telemetry to an ENOSPC rename is
+        worse than an oversized journal."""
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        self._fd = -1
+        try:
+            os.rename(self._path, self._path + ".1")
+        except OSError:
+            pass  # already moved/removed: reopen below recreates the path
+        try:
+            self._fd = os.open(
+                self._path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+            self._approx_size = os.fstat(self._fd).st_size
+        except OSError:
+            self._fd = -1
 
     def close(self) -> None:
         with self._lock:
@@ -526,6 +579,241 @@ def reset_event_log() -> None:
             _EVENT_LOG.close()
         _EVENT_LOG = None
         _DEFAULT_REPLICA_ID = None
+
+
+# ----------------------------------------------------------------------
+# Live fleet digest (heartbeat-carried health summary)
+# ----------------------------------------------------------------------
+
+# Span names the digest's phase block is built from. quorum/heal/commit
+# already exist; allreduce_wait and step_compute are observed by the
+# Manager at the commit gate (manager.py) specifically so the digest can
+# report what the trainer *experiences* independent of backend.
+DIGEST_PHASE_SPANS: Dict[str, str] = {
+    "q": "torchft::manager::_async_quorum",
+    "h": "torchft::manager::recv_checkpoint",
+    "c": "torchft::manager::step_compute",
+    "a": "torchft::manager::allreduce_wait",
+    "m": "torchft::manager::should_commit",
+}
+
+
+def _sig4(x: float) -> float:
+    """Round to 4 significant digits — keeps the wire digest compact
+    without losing anything a health dashboard can display."""
+    try:
+        return float(f"{float(x):.4g}")
+    except (TypeError, ValueError, OverflowError):
+        return 0.0
+
+
+class DigestWindow:
+    """Rolling window over commit-gate outcomes, feeding
+    :class:`StepDigest` its step-rate and goodput.
+
+    The Manager calls :meth:`note_gate` once per ``should_commit`` with
+    the gate verdict and the gate-to-gate wall time (heal time already
+    excluded, matching the cumulative goodput bookkeeping). Rate and
+    goodput are then computed over the trailing ``window_s`` seconds, so
+    the digest reports *current* health, not a lifetime average that a
+    long-dead stall would take hours to move.
+
+    ``now`` is injectable everywhere for deterministic tests.
+    """
+
+    def __init__(self, window_s: float = 60.0) -> None:
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        # (t, step, committed, dt_s) per gate, oldest first.
+        self._gates: collections.deque = collections.deque()
+        self._last_step = 0
+
+    def note_gate(
+        self,
+        step: int,
+        committed: bool,
+        dt_s: float,
+        now: Optional[float] = None,
+    ) -> None:
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._gates.append((t, int(step), bool(committed), float(dt_s)))
+            if committed:
+                self._last_step = max(self._last_step, int(step))
+            self._prune_locked(t)
+
+    def _prune_locked(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._gates and self._gates[0][0] < cutoff:
+            self._gates.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, float]:
+        """{"step", "rate", "gp"} over the trailing window. Rate is
+        committed gates per second of window span; goodput is committed
+        gate-seconds over total gate-seconds (1.0 when nothing failed,
+        0.0 when nothing ran)."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(t)
+            committed = [g for g in self._gates if g[2]]
+            total_dt = sum(g[3] for g in self._gates)
+            good_dt = sum(g[3] for g in committed)
+            span = t - self._gates[0][0] if self._gates else 0.0
+            if span <= 0.0:
+                span = total_dt  # single gate: fall back to its own cost
+            rate = len(committed) / span if span > 0.0 else 0.0
+            return {
+                "step": self._last_step,
+                "rate": rate,
+                "gp": (good_dt / total_dt) if total_dt > 0.0 else 0.0,
+            }
+
+
+class StepDigest:
+    """Compact per-replica health digest carried on lighthouse heartbeats.
+
+    Wire form (short keys; ``to_json()`` is guaranteed ≤ 512 bytes):
+
+    .. code-block:: json
+
+        {"v": 1, "step": 420, "rate": 1.25, "gp": 0.98,
+         "ph": {"q": [0.003, 0.008], "h": [0, 0], "c": [0.101, 0.105],
+                "a": [0.012, 0.02], "m": [0.001, 0.002]},
+         "bw": {"1": 1.25, "2": 0.9},
+         "err": 0, "chaos": 3, "cf": 0}
+
+    ``ph`` maps phase → [p50_s, p95_s] for quorum|heal|compute|allreduce|
+    commit (keys q/h/c/a/m, see :data:`DIGEST_PHASE_SPANS`); ``bw`` maps
+    peer rank → effective GiB/s on the native data plane (absent on the
+    socket backend); ``err`` is the error-latch state, ``chaos`` the
+    injection count, ``cf`` the consecutive-commit-failure streak. The
+    budget exists because the digest rides the 100 ms-interval heartbeat:
+    it must stay cheap to build, send, and parse every tick.
+    """
+
+    MAX_WIRE_BYTES = 512
+    MAX_PEERS = 8
+
+    def __init__(
+        self,
+        step: int,
+        rate: float,
+        goodput: float,
+        phases: Optional[Dict[str, List[float]]] = None,
+        peer_gib_s: Optional[Dict[str, float]] = None,
+        errored: bool = False,
+        chaos_injections: int = 0,
+        commit_failures: int = 0,
+    ) -> None:
+        self.step = int(step)
+        self.rate = float(rate)
+        self.goodput = float(goodput)
+        self.phases = dict(phases or {})
+        self.peer_gib_s = dict(peer_gib_s or {})
+        self.errored = bool(errored)
+        self.chaos_injections = int(chaos_injections)
+        self.commit_failures = int(commit_failures)
+
+    @classmethod
+    def collect(
+        cls,
+        window: DigestWindow,
+        peer_gib_s: Optional[Dict[str, float]] = None,
+        errored: bool = False,
+        chaos_injections: int = 0,
+        commit_failures: int = 0,
+        now: Optional[float] = None,
+    ) -> "StepDigest":
+        """Builds a digest from a :class:`DigestWindow` plus the process's
+        own span histograms (:func:`span_percentiles`) — no extra timers,
+        only reads of accounting that already exists."""
+        snap = window.snapshot(now=now)
+        pct = span_percentiles()
+        phases: Dict[str, List[float]] = {}
+        for key, span_name in DIGEST_PHASE_SPANS.items():
+            p = pct.get(span_name)
+            if p is not None:
+                phases[key] = [p["p50"], p["p95"]]
+        return cls(
+            step=int(snap["step"]),
+            rate=snap["rate"],
+            goodput=snap["gp"],
+            phases=phases,
+            peer_gib_s=peer_gib_s,
+            errored=errored,
+            chaos_injections=chaos_injections,
+            commit_failures=commit_failures,
+        )
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Short-key dict form; peers capped at :data:`MAX_PEERS` (highest
+        bandwidth kept — the interesting peers are the fast lanes whose
+        *absence* signals trouble) and floats rounded to 4 significant
+        digits so the JSON stays inside the heartbeat budget."""
+        wire: Dict[str, Any] = {
+            "v": 1,
+            "step": self.step,
+            "rate": _sig4(self.rate),
+            "gp": _sig4(self.goodput),
+        }
+        if self.phases:
+            wire["ph"] = {
+                k: [_sig4(v[0]), _sig4(v[1])]
+                for k, v in sorted(self.phases.items())
+                if isinstance(v, (list, tuple)) and len(v) >= 2
+            }
+        if self.peer_gib_s:
+            top = sorted(
+                self.peer_gib_s.items(),
+                key=lambda kv: (-float(kv[1]), str(kv[0])),
+            )[: self.MAX_PEERS]
+            wire["bw"] = {
+                str(k)[:8]: _sig4(v) for k, v in sorted(top)
+            }
+        wire["err"] = 1 if self.errored else 0
+        if self.chaos_injections:
+            wire["chaos"] = self.chaos_injections
+        if self.commit_failures:
+            wire["cf"] = self.commit_failures
+        return wire
+
+    def to_json(self) -> str:
+        """Compact JSON, hard-capped at :data:`MAX_WIRE_BYTES`: if the
+        encoded form is somehow over budget the bandwidth map is dropped
+        first, then the phase block — a truncated digest beats a heartbeat
+        frame that old lighthouses might refuse to read."""
+        wire = self.to_wire()
+        for drop in (None, "bw", "ph"):
+            if drop is not None:
+                wire.pop(drop, None)
+            s = json.dumps(wire, separators=(",", ":"))
+            if len(s.encode("utf-8")) <= self.MAX_WIRE_BYTES:
+                return s
+        return json.dumps(
+            {"v": 1, "step": self.step}, separators=(",", ":")
+        )
+
+    @classmethod
+    def from_wire(cls, wire: Dict[str, Any]) -> "StepDigest":
+        """Inverse of :meth:`to_wire` (tolerant: unknown keys ignored,
+        missing keys default — the compat contract both directions)."""
+        ph = wire.get("ph") or {}
+        return cls(
+            step=int(wire.get("step", 0) or 0),
+            rate=float(wire.get("rate", 0.0) or 0.0),
+            goodput=float(wire.get("gp", 0.0) or 0.0),
+            phases={
+                k: [float(v[0]), float(v[1])]
+                for k, v in ph.items()
+                if isinstance(v, (list, tuple)) and len(v) >= 2
+            },
+            peer_gib_s={
+                str(k): float(v) for k, v in (wire.get("bw") or {}).items()
+            },
+            errored=bool(wire.get("err", 0)),
+            chaos_injections=int(wire.get("chaos", 0) or 0),
+            commit_failures=int(wire.get("cf", 0) or 0),
+        )
 
 
 # ----------------------------------------------------------------------
