@@ -34,6 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import obs_report  # noqa: E402
+from torchft_tpu import knobs  # noqa: E402
 from torchft_tpu.coordination import LighthouseClient  # noqa: E402
 from torchft_tpu.telemetry import EventLog  # noqa: E402
 
@@ -372,7 +373,7 @@ def _make_handler(exporter: _Exporter):
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--lighthouse",
-                   default=os.environ.get("TORCHFT_LIGHTHOUSE", ""),
+                   default=knobs.get_str("TORCHFT_LIGHTHOUSE"),
                    help="lighthouse host:port (default: $TORCHFT_LIGHTHOUSE)")
     p.add_argument("--interval", type=float, default=5.0,
                    help="poll interval seconds (default 5)")

@@ -32,6 +32,10 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu import knobs  # noqa: E402
+
 ANSI_HOME_CLEAR = "\x1b[H\x1b[J"
 ANSI_BOLD = "\x1b[1m"
 ANSI_RED = "\x1b[31m"
@@ -173,7 +177,7 @@ def check_frame(fleet: Dict[str, Any], frame: str) -> List[str]:
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--lighthouse",
-                   default=os.environ.get("TORCHFT_LIGHTHOUSE", ""),
+                   default=knobs.get_str("TORCHFT_LIGHTHOUSE"),
                    help="lighthouse host:port (default: $TORCHFT_LIGHTHOUSE)")
     p.add_argument("--interval", type=float, default=1.0,
                    help="refresh interval seconds (default 1)")

@@ -22,6 +22,8 @@ import tempfile
 import time
 from typing import Optional
 
+from torchft_tpu import knobs
+
 # Verdict trust windows.  A CONFIRMED verdict (backend init returned —
 # alive, or errored outright — dead) is trusted long enough that
 # bench.py + dryrun_multichip in one driver round share a single probe.
@@ -101,10 +103,10 @@ def probe_device_count(
     about to spend minutes on a HEADLINE measurement should pay the
     fresh probe; cheap gate phases keep the cached verdict.
     """
-    env_timeout = os.environ.get("TORCHFT_PROBE_TIMEOUT")
+    env_timeout = knobs.get_raw("TORCHFT_PROBE_TIMEOUT")
     if env_timeout:
         timeout_s = float(env_timeout)
-    if os.environ.get("TORCHFT_PROBE_NO_CACHE") == "1":
+    if knobs.get_bool("TORCHFT_PROBE_NO_CACHE"):
         use_cache = False
 
     if use_cache:

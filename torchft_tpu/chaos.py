@@ -56,6 +56,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from torchft_tpu import knobs
+
 __all__ = [
     "ChaosError",
     "ChaosSpecError",
@@ -424,7 +426,7 @@ def init_from_env(force: bool = False) -> Optional[Chaos]:
     with _INIT_LOCK:
         if _INITED and not force:
             return _STATE
-        value = os.environ.get("TORCHFT_CHAOS", "")
+        value = knobs.get_str("TORCHFT_CHAOS")
         if value:
             seed, rules = parse_spec(value)
             _STATE = Chaos(seed, rules)

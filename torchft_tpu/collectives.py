@@ -21,6 +21,7 @@ from typing import Callable, List, Sequence, Tuple
 
 import numpy as np
 
+from torchft_tpu import knobs
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import DummyWork, FutureWork, Work
 
@@ -267,9 +268,7 @@ def allreduce_quantized_jax(
     # device path anyway (Pallas interpreter off-TPU; a no-op on TPU,
     # where the device path is already taken): the cross-path
     # wire-equality test drives it.
-    force_device = os.environ.get(
-        "TORCHFT_FORCE_DEVICE_QUANT", ""
-    ).lower() in ("1", "true", "yes")
+    force_device = knobs.get_bool("TORCHFT_FORCE_DEVICE_QUANT")
     host_quant = jax.default_backend() != "tpu" and not force_device
 
     # Device path: dispatch the quantize kernels NOW, on the caller's

@@ -26,15 +26,16 @@ from typing import Any, Dict, List, Optional
 
 from torchft_tpu import _net
 from torchft_tpu import chaos as _chaos
+from torchft_tpu import knobs
 
 # Client retry policy, shared by lighthouse and manager clients: bounded
 # exponential backoff with FULL jitter (delay ~ U[0, min(max, base*2^n)]),
 # mirroring the reference's retry.rs ExponentialBackoff. Jitter decorrelates
 # replicas that all lost the same server — without it every client of a
 # restarted lighthouse reconnect-storms in lockstep.
-_RETRY_ATTEMPTS = max(1, int(os.environ.get("TORCHFT_RPC_RETRIES", "3")))
-_RETRY_BASE_S = float(os.environ.get("TORCHFT_RPC_BACKOFF_BASE_S", "0.05"))
-_RETRY_MAX_S = float(os.environ.get("TORCHFT_RPC_BACKOFF_MAX_S", "1.0"))
+_RETRY_ATTEMPTS = max(1, knobs.get_int("TORCHFT_RPC_RETRIES"))
+_RETRY_BASE_S = knobs.get_float("TORCHFT_RPC_BACKOFF_BASE_S")
+_RETRY_MAX_S = knobs.get_float("TORCHFT_RPC_BACKOFF_MAX_S")
 
 _CPP_DIR = Path(__file__).resolve().parent / "_cpp"
 _BIN_DIR = _CPP_DIR / "bin"
@@ -90,7 +91,7 @@ def _ensure_built() -> None:
 
 def advertise_host() -> str:
     """Host other processes should use to reach servers on this machine."""
-    host = os.environ.get("TORCHFT_HOST_ADDR")
+    host = knobs.get_raw("TORCHFT_HOST_ADDR")
     if host:
         return host
     return "127.0.0.1"

@@ -21,6 +21,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Generator, Optional, Sequence
 
+from torchft_tpu import knobs
+
 WATCHDOG_INTERVAL = 0.1
 
 
@@ -73,7 +75,7 @@ class _TimeoutManager:
             self._watchdog.start()
 
     def _watchdog_loop(self) -> None:
-        timeout = float(os.environ.get("TORCHFT_WATCHDOG_TIMEOUT_SEC", "30"))
+        timeout = knobs.get_float("TORCHFT_WATCHDOG_TIMEOUT_SEC")
         while self._watchdog_enabled:
             time.sleep(timeout / 2)
             age = time.monotonic() - self._heartbeat

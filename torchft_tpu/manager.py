@@ -38,6 +38,7 @@ import numpy as np
 
 from torchft_tpu import chaos as _chaos
 from torchft_tpu import futures as ft_futures
+from torchft_tpu import knobs
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.coordination import ManagerClient, ManagerServer, QuorumResult
@@ -118,12 +119,12 @@ class Manager:
         self._pg = pg
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
-        self._timeout = float(os.environ.get("TORCHFT_TIMEOUT_SEC", timeout))
-        self._quorum_timeout = float(
-            os.environ.get("TORCHFT_QUORUM_TIMEOUT_SEC", quorum_timeout)
+        self._timeout = knobs.get_float("TORCHFT_TIMEOUT_SEC", timeout)
+        self._quorum_timeout = knobs.get_float(
+            "TORCHFT_QUORUM_TIMEOUT_SEC", quorum_timeout
         )
-        self._connect_timeout = float(
-            os.environ.get("TORCHFT_CONNECT_TIMEOUT_SEC", connect_timeout)
+        self._connect_timeout = knobs.get_float(
+            "TORCHFT_CONNECT_TIMEOUT_SEC", connect_timeout
         )
         self._init_sync = init_sync
         self._max_retries = max_retries
@@ -213,10 +214,10 @@ class Manager:
         # manager server (group rank 0) at most every
         # TORCHFT_DIGEST_INTERVAL_S so it rides the heartbeats to the
         # lighthouse. TORCHFT_DIGEST=0 turns the push off entirely.
-        self._digest_enabled = os.environ.get("TORCHFT_DIGEST", "1") != "0"
+        self._digest_enabled = knobs.get_raw("TORCHFT_DIGEST") != "0"
         try:
-            self._digest_interval_s = float(
-                os.environ.get("TORCHFT_DIGEST_INTERVAL_S", "1.0")
+            self._digest_interval_s = knobs.get_float(
+                "TORCHFT_DIGEST_INTERVAL_S"
             )
         except ValueError:
             self._digest_interval_s = 1.0
@@ -253,7 +254,7 @@ class Manager:
             run_id = str(uuid.uuid4())
             full_replica_id = f"{replica_id}:{run_id}" if replica_id else run_id
             if lighthouse_addr is None:
-                lighthouse_addr = os.environ["TORCHFT_LIGHTHOUSE"]
+                lighthouse_addr = knobs.require("TORCHFT_LIGHTHOUSE")
             self._manager_server = ManagerServer(
                 replica_id=full_replica_id,
                 lighthouse_addr=lighthouse_addr,

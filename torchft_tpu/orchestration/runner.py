@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from torchft_tpu import knobs
 from torchft_tpu.orchestration.launcher import ProcessSpec, render_topology
 
 logger = logging.getLogger(__name__)
@@ -118,7 +119,7 @@ class ReplicaGroupRunner:
             # delivery doesn't work in its container anyway.
             preexec_fn=(
                 _pdeathsig_preexec
-                if os.environ.get("TORCHFT_RUNNER_PDEATHSIG", "1") != "0"
+                if knobs.get_raw("TORCHFT_RUNNER_PDEATHSIG") != "0"
                 else None
             ),
         )

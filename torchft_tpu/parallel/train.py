@@ -121,9 +121,9 @@ def init_train_state(
 # Env-tunable (TORCHFT_LOSS_CHUNK) so the on-chip MFU sweep can A/B chunk
 # sizes without code edits — larger chunks = fewer scan iterations and
 # bigger head matmuls at proportionally more transient HBM.
-import os as _os
+from torchft_tpu import knobs as _knobs
 
-_LOSS_CHUNK = int(_os.environ.get("TORCHFT_LOSS_CHUNK", 128))
+_LOSS_CHUNK = _knobs.get_int("TORCHFT_LOSS_CHUNK")
 
 
 def _lm_head_projection(model: Transformer, params):

@@ -34,6 +34,7 @@ import numpy as np
 
 from torchft_tpu import _net
 from torchft_tpu import chaos as _chaos
+from torchft_tpu import knobs
 from torchft_tpu.store import StoreClient
 from torchft_tpu.telemetry import (
     add_bytes,
@@ -843,15 +844,15 @@ class ProcessGroupNative(ProcessGroupSocket):
         self._n_streams = int(
             n_streams
             if n_streams is not None
-            else os.environ.get("TORCHFT_NATIVE_STREAMS", "4")
+            else knobs.get_raw("TORCHFT_NATIVE_STREAMS")
         )
         self._pipeline_bytes = int(
             pipeline_bytes
             if pipeline_bytes is not None
-            else os.environ.get("TORCHFT_NATIVE_PIPELINE_BYTES", str(1 << 20))
+            else knobs.get_raw("TORCHFT_NATIVE_PIPELINE_BYTES")
         )
         self._wire = (
-            wire if wire is not None else os.environ.get("TORCHFT_PG_WIRE", "fp32")
+            wire if wire is not None else knobs.get_str("TORCHFT_PG_WIRE")
         ).lower()
         # Engine flight-record ring size (records). 0 disables recording
         # (the always-on per-peer byte/busy counters remain); the default
@@ -860,7 +861,7 @@ class ProcessGroupNative(ProcessGroupSocket):
         self._fr_capacity = int(
             fr_capacity
             if fr_capacity is not None
-            else os.environ.get("TORCHFT_NATIVE_FR_RING", "256")
+            else knobs.get_raw("TORCHFT_NATIVE_FR_RING")
         )
         self._fr_last_seq = 0
         self._chaos_last_seq = 0
@@ -1456,7 +1457,7 @@ def make_process_group(timeout: float = 60.0) -> ProcessGroup:
     or ``dummy`` (no-op test double). The env var — not a code change — is the
     switch so train scripts, drills and the process launcher all pick the
     backend uniformly, including across fork/spawn boundaries."""
-    backend = os.environ.get("TORCHFT_PG", "socket").strip().lower() or "socket"
+    backend = knobs.get_str("TORCHFT_PG").strip().lower() or "socket"
     if backend == "socket":
         return ProcessGroupSocket(timeout=timeout)
     if backend == "native":

@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
+from . import knobs
+
 __all__ = [
     "trace_span",
     "traced",
@@ -52,6 +54,7 @@ __all__ = [
     "timed",
     "MetricsLogger",
     "get_metrics_logger",
+    "EVENT_KINDS",
     "EventLog",
     "get_event_log",
     "StepDigest",
@@ -375,7 +378,7 @@ def get_metrics_logger() -> Optional[MetricsLogger]:
     """Process-wide metrics sink, enabled by ``TORCHFT_METRICS_FILE``.
     Returns None (and costs one env read) when unset."""
     global _METRICS_LOGGER
-    path = os.environ.get("TORCHFT_METRICS_FILE", "")
+    path = knobs.get_str("TORCHFT_METRICS_FILE")
     if not path:
         return None
     with _METRICS_LOCK:
@@ -389,6 +392,58 @@ def get_metrics_logger() -> Optional[MetricsLogger]:
 # ----------------------------------------------------------------------
 # Event journal (structured step-event JSONL)
 # ----------------------------------------------------------------------
+
+# Central schema registry of journal event kinds: every production
+# ``EventLog.emit(...)`` / ``Manager._journal(...)`` call site must use a
+# kind registered here, with a one-line meaning.  The contract linter
+# (``tools/tft_lint.py``, rule ``event-kind-registry``) enforces this
+# statically over ``torchft_tpu/`` and ``tools/`` — consumers
+# (``obs_report.py``, ``obs_trace.py``, ``chaos_soak.py``) key off these
+# exact strings, so an unregistered or misspelled kind silently drops
+# events from every downstream timeline.  Tests are exempt (they emit
+# throwaway kinds on purpose).  Runtime stays permissive: emit() does not
+# validate, so ad-hoc kinds in notebooks/tests still work.
+EVENT_KINDS: Dict[str, str] = {
+    # -- quorum / commit (manager.py) ----------------------------------
+    "quorum_start": "quorum attempt begins (async or sync path)",
+    "quorum_ready": "quorum returned; carries replica set + max_step",
+    "quorum_abort": "quorum failed or was aborted; collectives poisoned",
+    "commit_gate": "should_commit verdict for the step window",
+    "goodput": "per-commit goodput/step-rate sample",
+    # -- healing / checkpoint (manager.py, checkpointing/*) ------------
+    "heal_start": "this replica starts healing from a live peer",
+    "heal_done": "heal finished; weights/step adopted",
+    "heal_failed": "heal attempt failed; will retry or abort",
+    "heal_send_start": "serving a checkpoint to a healing peer begins",
+    "heal_send_done": "serving a checkpoint to a healing peer finished",
+    "ckpt_send": "checkpoint transport sent state to a peer",
+    "ckpt_recv": "checkpoint transport received state from a peer",
+    # -- allreduce lifecycle (manager.py) ------------------------------
+    "allreduce_issue": "outer-axis allreduce handed to the data plane",
+    "allreduce_complete": "outer-axis allreduce completed (or errored)",
+    # -- process group / native engine (process_group.py) --------------
+    "pg_configure": "process group (re)configured for a new quorum",
+    "pg_configure_failed": "process group configure attempt failed",
+    "pg_collective": "socket-PG collective issued (debug-level cadence)",
+    "pg_abort": "process group aborted in-flight collectives",
+    "pg_native_mesh": "native engine mesh established (peers, streams)",
+    "native_collective": "native-engine flight-recorder record drained",
+    "native_counters": "native-engine per-peer byte/busy counters snapshot",
+    # -- local SGD / DiLoCo (local_sgd.py) -----------------------------
+    "local_sgd_sync": "LocalSGD outer sync performed",
+    "fragment_prepare_sync": "DiLoCo fragment staged for outer sync",
+    "fragment_perform_sync": "DiLoCo fragment outer sync performed",
+    # -- control-plane RPC (coordination.py) ---------------------------
+    "rpc_retry": "idempotent control RPC retried after a failure",
+    "server_start": "lighthouse/manager server process started",
+    "server_stop": "lighthouse/manager server process stopped",
+    # -- chaos plane (chaos.py, process_group.py) ----------------------
+    "chaos_inject": "seeded fault injected (kind/plane/site/visit)",
+    # -- fleet observability tools (tools/obs_export.py) ---------------
+    "lighthouse_status": "periodic lighthouse status scrape snapshot",
+    "anomaly": "exporter-detected anomaly (straggler, hb gap, error)",
+}
+
 
 class EventLog:
     """Structured step-event journal: one JSON line per event,
@@ -425,7 +480,7 @@ class EventLog:
         self._path = path
         self._lock = threading.Lock()
         if replica_id is None:
-            replica_id = os.environ.get("TORCHFT_REPLICA_ID") or (
+            replica_id = knobs.get_raw("TORCHFT_REPLICA_ID") or (
                 _DEFAULT_REPLICA_ID
                 or os.environ.get("REPLICA_GROUP_ID", f"pid{os.getpid()}")
             )
@@ -438,7 +493,7 @@ class EventLog:
         )
         try:
             self._max_bytes = int(
-                float(os.environ.get("TORCHFT_JOURNAL_MAX_MB", "0") or "0")
+                float(knobs.get_raw("TORCHFT_JOURNAL_MAX_MB") or "0")
                 * (1 << 20)
             )
         except ValueError:
@@ -534,7 +589,7 @@ def set_default_replica_id(replica_id: str) -> None:
     global _DEFAULT_REPLICA_ID
     _DEFAULT_REPLICA_ID = replica_id
     with _EVENT_LOCK:
-        if _EVENT_LOG is not None and not os.environ.get("TORCHFT_REPLICA_ID"):
+        if _EVENT_LOG is not None and not knobs.get_raw("TORCHFT_REPLICA_ID"):
             _EVENT_LOG.replica_id = replica_id
 
 
@@ -542,10 +597,10 @@ def _journal_path_from_env() -> str:
     """Journal destination: ``TORCHFT_JOURNAL_FILE`` wins; else
     ``TORCHFT_JOURNAL_DIR`` derives a per-process filename. Empty when
     neither is set (journal disabled)."""
-    path = os.environ.get("TORCHFT_JOURNAL_FILE", "")
+    path = knobs.get_str("TORCHFT_JOURNAL_FILE")
     if path:
         return path
-    d = os.environ.get("TORCHFT_JOURNAL_DIR", "")
+    d = knobs.get_str("TORCHFT_JOURNAL_DIR")
     if not d:
         return ""
     rid = os.environ.get("REPLICA_GROUP_ID", "x")
@@ -843,11 +898,11 @@ def trace_window(step: int) -> None:
     (default 3) steps later, writing a perfetto/XPlane trace under the dir.
     An atexit hook closes a window still open when the run ends early.
     No-op otherwise (reference: train_ddp.py:169-174 scheduled windows)."""
-    trace_dir = os.environ.get("TORCHFT_TRACE_DIR", "")
+    trace_dir = knobs.get_str("TORCHFT_TRACE_DIR")
     if not trace_dir:
         return
-    start = int(os.environ.get("TORCHFT_TRACE_START", "5"))
-    count = int(os.environ.get("TORCHFT_TRACE_COUNT", "3"))
+    start = knobs.get_int("TORCHFT_TRACE_START")
+    count = knobs.get_int("TORCHFT_TRACE_COUNT")
     with _TRACE_LOCK:
         if (
             not _TRACE_STATE["active"]
@@ -961,7 +1016,7 @@ class FlightRecorder:
         ``$TORCHFT_FR_DIR or /tmp/torchft_tpu_fr_<pid>.json``); returns the
         path written."""
         if path is None:
-            d = os.environ.get("TORCHFT_FR_DIR", "/tmp")
+            d = knobs.get_str("TORCHFT_FR_DIR")
             # Timestamp (unique across process restarts with recycled
             # PIDs, e.g. PID 1 in a container) + per-process counter
             # (unique within a millisecond): a later dump can never
@@ -989,8 +1044,7 @@ class FlightRecorder:
     def maybe_dump_on_abort(self, reason: str) -> Optional[str]:
         """Dump iff TORCHFT_TRIGGER_FR_ON_ABORT is truthy (the reference's
         exact gate, process_group.py:91)."""
-        flag = os.environ.get("TORCHFT_TRIGGER_FR_ON_ABORT", "").lower()
-        if flag not in ("1", "true", "yes", "on"):
+        if not knobs.get_bool("TORCHFT_TRIGGER_FR_ON_ABORT"):
             return None
         try:
             return self.dump(reason)
