@@ -126,6 +126,9 @@ class Manager:
         self._connect_timeout = knobs.get_float(
             "TORCHFT_CONNECT_TIMEOUT_SEC", connect_timeout
         )
+        quorum_retries = knobs.get_int(
+            "TORCHFT_QUORUM_RETRIES", quorum_retries
+        )
         self._init_sync = init_sync
         self._max_retries = max_retries
         self._world_size_mode = world_size_mode
