@@ -814,6 +814,15 @@ class ManagerClient:
             retry=False,  # next digest push supersedes this one anyway
         )
 
+    def info(self, timeout: float = 2.0) -> Dict[str, Any]:
+        """Identity probe: replica_id / address / world_size of the
+        server behind this connection. Lets obs tooling confirm it is
+        talking to the replica it thinks it is before issuing kill or
+        drain."""
+        return self._client.call(
+            {"type": "info", "timeout_ms": int(timeout * 1000)}, timeout
+        )
+
     def kill(self, msg: str = "") -> None:
         try:
             self._client.call({"type": "kill", "msg": msg, "timeout_ms": 2000}, 2.0)
