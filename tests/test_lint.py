@@ -1,0 +1,335 @@
+"""Contract-linter tests: a clean run on the real tree, plus fixture
+trees injecting each drift class the linter exists to catch (mutated
+golden constant, unregistered journal event kind, raw env read, renamed
+RPC key, enum drift, ABI drift)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from torchft_tpu.lint import run_all
+from torchft_tpu.lint.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(root, rule):
+    found, ran = run_all(root, only={rule})
+    assert ran == [rule]
+    return found
+
+
+def _mk_tree(tmp_path, rel_files):
+    """Copies repo files into a fixture tree, preserving layout."""
+    root = tmp_path / "tree"
+    for rel in rel_files:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    return root
+
+
+def _mutate(root, rel, old, new):
+    p = os.path.join(root, rel)
+    text = open(p).read()
+    assert old in text, f"fixture drifted: {old!r} not in {rel}"
+    open(p, "w").write(text.replace(old, new))
+
+
+CHAOS_FILES = [
+    "torchft_tpu/chaos.py",
+    "torchft_tpu/_cpp/chaos.cc",
+    "torchft_tpu/_cpp/chaos.hpp",
+]
+
+
+# ----------------------------------------------------------------------
+# the clean tree
+# ----------------------------------------------------------------------
+
+
+def test_clean_tree_zero_findings():
+    findings, ran = run_all(REPO)
+    assert [name for name, _ in RULES] == ran
+    assert len(ran) >= 8  # the issue's floor on active rule classes
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_check_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tft_lint.py"),
+         "--check"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------------------------------
+# drift class: changed golden hash constant
+# ----------------------------------------------------------------------
+
+
+def test_mutated_py_golden_constant_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/chaos.py",
+            "0x9E3779B97F4A7C15", "0x9E3779B97F4A7C17")
+    found = _findings(root, "golden-constants")
+    assert any("splitmix64" in f.message for f in found)
+
+
+def test_mutated_cc_golden_constant_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/_cpp/chaos.cc",
+            "0xBF58476D1CE4E5B9", "0xBF58476D1CE4E5B8")
+    found = _findings(root, "golden-constants")
+    assert any("drifted" in f.message for f in found)
+
+
+def test_mutated_step_sentinel_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/_cpp/chaos.cc",
+            "int64_t(1) << 62", "int64_t(1) << 61")
+    found = _findings(root, "golden-constants")
+    assert any("sentinel" in f.message for f in found)
+
+
+def test_mutated_hash_unit_divisor_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/_cpp/chaos.cc",
+            "9007199254740992.0", "9007199254740993.0")
+    found = _findings(root, "golden-constants")
+    assert any("hash-unit" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# drift class: enum / grammar drift
+# ----------------------------------------------------------------------
+
+
+def test_renamed_kind_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/_cpp/chaos.cc",
+            '"rpc_delay"', '"rpc_slow"')
+    found = _findings(root, "chaos-enums")
+    assert any("fault kinds drifted" in f.message for f in found)
+
+
+def test_dropped_grammar_param_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/_cpp/chaos.cc",
+            'k == "every"', 'k == "evry"')
+    found = _findings(root, "chaos-grammar")
+    assert any("drifted" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# drift class: C ABI drift
+# ----------------------------------------------------------------------
+
+ABI_FILES = [
+    "torchft_tpu/_native.py",
+    "torchft_tpu/_cpp/collectives.hpp",
+    "torchft_tpu/_cpp/chaos.hpp",
+]
+
+
+def test_renamed_abi_symbol_fires(tmp_path):
+    root = _mk_tree(tmp_path, ABI_FILES)
+    _mutate(root, "torchft_tpu/_cpp/collectives.hpp",
+            "tft_coll_allreduce(", "tft_coll_all_reduce(")
+    found = _findings(root, "c-abi")
+    assert any("tft_coll_allreduce" in f.message for f in found)
+
+
+def test_clean_abi_tree_passes(tmp_path):
+    root = _mk_tree(tmp_path, ABI_FILES)
+    assert _findings(root, "c-abi") == []
+
+
+# ----------------------------------------------------------------------
+# drift class: renamed RPC key / method
+# ----------------------------------------------------------------------
+
+RPC_FILES = [
+    "torchft_tpu/coordination.py",
+    "torchft_tpu/telemetry.py",
+    "torchft_tpu/_cpp/lighthouse.cc",
+    "torchft_tpu/_cpp/manager_server.cc",
+]
+
+
+def test_renamed_rpc_key_fires(tmp_path):
+    root = _mk_tree(tmp_path, RPC_FILES)
+    # The lighthouse starts reading a key no client sends.
+    _mutate(root, "torchft_tpu/_cpp/lighthouse.cc",
+            'req.get("replica_id")', 'req.get("replicaid")')
+    found = _findings(root, "rpc-keys")
+    assert any('"replicaid"' in f.message for f in found)
+
+
+def test_renamed_rpc_type_fires(tmp_path):
+    root = _mk_tree(tmp_path, RPC_FILES)
+    _mutate(root, "torchft_tpu/_cpp/manager_server.cc",
+            'type == "should_commit"', 'type == "shouldcommit"')
+    found = _findings(root, "rpc-methods")
+    # Fires both ways: the client's type is no longer dispatched, and
+    # the server's new type has no sender.
+    assert any('"should_commit"' in f.message for f in found)
+    assert any('"shouldcommit"' in f.message for f in found)
+
+
+def test_digest_key_drift_fires(tmp_path):
+    root = _mk_tree(tmp_path, RPC_FILES)
+    _mutate(root, "torchft_tpu/_cpp/lighthouse.cc",
+            'digest.get("gp")', 'digest.get("goodput")')
+    found = _findings(root, "rpc-keys")
+    assert any('"goodput"' in f.message for f in found)
+
+
+def test_wire_budget_drift_fires(tmp_path):
+    root = _mk_tree(tmp_path, RPC_FILES)
+    _mutate(root, "torchft_tpu/telemetry.py",
+            "MAX_WIRE_BYTES = 512", "MAX_WIRE_BYTES = 1024")
+    found = _findings(root, "rpc-keys")
+    assert any("MAX_WIRE_BYTES" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# drift class: unregistered journal event kind
+# ----------------------------------------------------------------------
+
+
+def _write(root, rel, text):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+
+
+def test_unregistered_event_kind_fires(tmp_path):
+    root = tmp_path / "tree"
+    _write(root, "torchft_tpu/telemetry.py",
+           'EVENT_KINDS = {\n    "good_kind": "registered",\n}\n')
+    _write(root, "torchft_tpu/mod.py",
+           'def f(log):\n'
+           '    log.emit("good_kind", x=1)\n'
+           '    log.emit("rogue_kind", x=2)\n')
+    found = _findings(root, "event-kind-registry")
+    assert len(found) == 1
+    assert "rogue_kind" in found[0].message
+    assert found[0].file == "torchft_tpu/mod.py"
+    assert found[0].line == 3
+
+
+def test_dead_event_kind_fires(tmp_path):
+    root = tmp_path / "tree"
+    _write(root, "torchft_tpu/telemetry.py",
+           'EVENT_KINDS = {\n    "never_emitted": "dead",\n}\n')
+    _write(root, "torchft_tpu/mod.py", "x = 1\n")
+    found = _findings(root, "event-kind-registry")
+    assert len(found) == 1
+    assert "never_emitted" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# drift class: raw env read bypassing the knob registry
+# ----------------------------------------------------------------------
+
+
+def test_raw_env_read_fires(tmp_path):
+    root = _mk_tree(tmp_path, ["torchft_tpu/knobs.py", "docs/KNOBS.md"])
+    _write(root, "torchft_tpu/sneaky.py",
+           'import os\n'
+           'X = os.environ.get("TORCHFT_TIMEOUT_SEC", "10")\n')
+    found = _findings(root, "env-knob-registry")
+    raw = [f for f in found if "raw os.environ read" in f.message]
+    assert len(raw) == 1
+    assert raw[0].file == "torchft_tpu/sneaky.py"
+    assert raw[0].line == 2
+
+
+def test_unregistered_knob_accessor_fires(tmp_path):
+    root = _mk_tree(tmp_path, ["torchft_tpu/knobs.py", "docs/KNOBS.md"])
+    _write(root, "torchft_tpu/sneaky.py",
+           'from torchft_tpu import knobs\n'
+           'X = knobs.get_str("TORCHFT_NOT_A_KNOB")\n')
+    found = _findings(root, "env-knob-registry")
+    assert any("TORCHFT_NOT_A_KNOB" in f.message for f in found)
+
+
+def test_stale_knob_docs_fires(tmp_path):
+    root = _mk_tree(tmp_path, ["torchft_tpu/knobs.py", "docs/KNOBS.md"])
+    with open(os.path.join(root, "docs", "KNOBS.md"), "a") as fh:
+        fh.write("\nhand edit\n")
+    found = _findings(root, "env-knob-registry")
+    assert any("stale" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# drift class: wall clock in the chaos decision path
+# ----------------------------------------------------------------------
+
+
+def test_wallclock_in_decision_path_fires(tmp_path):
+    root = _mk_tree(tmp_path, CHAOS_FILES)
+    _mutate(root, "torchft_tpu/chaos.py",
+            "def _rule_fires(",
+            "def _rule_fires(self, *_a, **_k):\n"
+            "        import time as _t\n"
+            "        time.time()\n"
+            "        return False\n\n"
+            "    def _rule_fires_orig(")
+    found = _findings(root, "wallclock-free-chaos")
+    assert any("time.time" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# CLI surface
+# ----------------------------------------------------------------------
+
+
+def test_cli_report(tmp_path):
+    report = tmp_path / "LINT_REPORT.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tft_lint.py"),
+         "--report", str(report)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    data = json.loads(report.read_text())
+    assert data["finding_count"] == 0
+    assert len(data["rules_active"]) >= 8
+    assert data["provenance"]  # first-run fixes carry their history
+
+
+def test_cli_unknown_rule():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tft_lint.py"),
+         "--check", "--only", "no-such-rule"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 2
+
+
+@pytest.mark.parametrize("rule", [name for name, _ in RULES])
+def test_rule_crash_is_a_finding_not_an_exception(tmp_path, rule):
+    # An empty tree must not kill the linter: every rule either returns
+    # findings or reports its own crash as one.
+    root = tmp_path / "empty"
+    root.mkdir()
+    (root / "torchft_tpu").mkdir()
+    (root / "tools").mkdir()
+    found, ran = run_all(str(root), only={rule})
+    assert ran == [rule]
+    for f in found:
+        assert f.rule == rule

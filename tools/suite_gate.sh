@@ -31,6 +31,11 @@
 #                                       # it straggler WHILE running, obs_top
 #                                       # --once --check must render, digest
 #                                       # heartbeat overhead A/B must be <1%
+#        bash tools/suite_gate.sh lint  # contract linter: dual-language
+#                                       # invariants (golden constants, enums,
+#                                       # ABI, RPC surface, event kinds, env
+#                                       # knobs) proven from source; seconds,
+#                                       # pure Python, no build needed
 set -u
 cd "$(dirname "$0")/.."
 
@@ -56,6 +61,11 @@ fi
 if [ "${1:-}" = "fleet" ]; then
   echo "== fleet smoke: live straggler detection + obs_top + digest A/B =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/obs_fleet_smoke.py
+fi
+
+if [ "${1:-}" = "lint" ]; then
+  echo "== lint: dual-language contract linter (tools/tft_lint.py) =="
+  exec timeout 120 python tools/tft_lint.py --check --report LINT_REPORT.json
 fi
 
 if [ "${1:-}" = "pg" ]; then

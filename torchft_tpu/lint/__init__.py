@@ -1,0 +1,48 @@
+"""Repo-specific contract linter: statically proves the dual-language
+invariants the chaos/fleet planes only test dynamically.
+
+The framework implements several contracts TWICE — once in Python, once
+in C++ — and more that hold only by convention (journal event kinds,
+env knobs, RPC JSON keys).  This package parses both sides of each
+contract from SOURCE (Python via ``ast``, C++ via comment-stripped
+regex/brace slicing — never compiled artifacts) and cross-checks them:
+
+======================  ==============================================
+rule class              contract
+======================  ==============================================
+golden-constants        FNV-1a/splitmix64 constants, hash-unit
+                        divisor, step-window sentinel:
+                        ``chaos.py`` vs ``_cpp/chaos.cc``
+chaos-enums             fault kinds + planes: ``chaos.py`` vs
+                        ``chaos.cc``/``chaos.hpp``
+chaos-grammar           ``TORCHFT_CHAOS`` rule param keys, both parsers
+c-abi                   ``_native.py`` ctypes declarations vs the
+                        ``extern "C"`` prototypes, dtype/op codes
+rpc-methods             RPC ``type`` values sent vs dispatched,
+                        both directions, both servers
+rpc-keys                request JSON keys read by a server exist in
+                        what its clients send (incl. quorum member
+                        and ≤512 B heartbeat digest wire keys)
+event-kind-registry     every ``EventLog.emit``/``_journal`` kind is
+                        registered in ``telemetry.EVENT_KINDS`` (and
+                        no registered kind is dead)
+env-knob-registry       every ``TORCHFT_*`` env read goes through
+                        ``torchft_tpu/knobs.py``; registry matches
+                        actual reads (both languages) and
+                        ``docs/KNOBS.md``
+wallclock-free-chaos    no wall-clock/random calls inside the chaos
+                        decision path (replay determinism)
+artifact-hygiene        no build artifacts tracked in git; lint scans
+                        sources only
+======================  ==============================================
+
+Run ``python tools/tft_lint.py --check`` (the ``suite_gate.sh lint``
+lane).  See ``docs/STATIC_ANALYSIS.md`` for the contract model and how
+to add a new contract.
+"""
+
+from torchft_tpu.lint.rules import (  # noqa: F401
+    Finding,
+    RULES,
+    run_all,
+)

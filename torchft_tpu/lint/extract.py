@@ -1,0 +1,846 @@
+"""Source extractors for the contract linter.
+
+Python sides are parsed with ``ast`` (no imports of the target modules:
+the linter must work on a broken tree).  C++ sides are parsed from
+comment-stripped text with regexes plus brace-matched function slicing —
+deliberately shallow, anchored on the stable surface forms (an enum
+table, an ``extern "C"`` block, a ``k == "param"`` ladder) rather than a
+real C++ grammar.  Every extractor returns plain data (sets/dicts/ints)
+so the rules in ``rules.py`` stay pure comparisons.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+# ----------------------------------------------------------------------
+# generic helpers
+# ----------------------------------------------------------------------
+
+
+def strip_cc_comments(text: str) -> str:
+    """Removes ``//`` and ``/* */`` comments, preserving string literals
+    and line numbers (block comments are replaced by equivalent
+    newlines)."""
+    out: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"' or c == "'":
+            quote = c
+            out.append(c)
+            i += 1
+            while i < n:
+                out.append(text[i])
+                if text[i] == "\\":
+                    if i + 1 < n:
+                        out.append(text[i + 1])
+                        i += 2
+                        continue
+                elif text[i] == quote:
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("\n" * text.count("\n", i, j))
+            i = j
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def cc_function_body(text: str, name: str) -> Optional[str]:
+    """The brace-matched body of function ``name`` in comment-stripped
+    C++ ``text`` (first definition wins), or None."""
+    for m in re.finditer(rf"\b{re.escape(name)}\s*\(", text):
+        # Definition, not a call: find the '{' after the parameter list,
+        # allowing only whitespace/identifiers between ')' and '{'.
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "(":
+                depth += 1
+            elif text[i] == ")":
+                depth -= 1
+            i += 1
+        rest = text[i:]
+        m2 = re.match(r"\s*(const|noexcept|override)?\s*\{", rest)
+        if not m2:
+            continue
+        start = i + m2.end()
+        depth = 1
+        j = start
+        while j < len(text) and depth:
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+            j += 1
+        return text[start : j - 1]
+    return None
+
+
+def _fold_int(node: ast.AST) -> Optional[int]:
+    """Constant int, or a constant ``a << b`` / ``a * b`` fold."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left, right = _fold_int(node.left), _fold_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+    return None
+
+
+def _parse(path: str) -> ast.Module:
+    with open(path, "r") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _func(tree: ast.Module, name: str) -> Optional[ast.FunctionDef]:
+    """Module-level or method function def named ``name`` (dotted
+    ``Class.method`` form supported)."""
+    if "." in name:
+        cls_name, meth = name.split(".", 1)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                for sub in node.body:
+                    if (
+                        isinstance(sub, ast.FunctionDef)
+                        and sub.name == meth
+                    ):
+                        return sub
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+# ----------------------------------------------------------------------
+# golden constants (chaos.py vs chaos.cc)
+# ----------------------------------------------------------------------
+
+# Decision-hash functions mirrored bit-for-bit across the two languages.
+HASH_FUNCS = ("fnv1a64", "splitmix64", "decision_hash")
+
+
+def py_hash_constants(path: str) -> Dict[str, Dict[str, Any]]:
+    """Per decision function: the big integer constants (>= 256, i.e.
+    the golden multipliers/offsets) and the right-shift amounts."""
+    tree = _parse(path)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in HASH_FUNCS:
+        fn = _func(tree, name)
+        if fn is None:
+            out[name] = {"missing": True}
+            continue
+        big: Set[int] = set()
+        shifts: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, int
+            ):
+                if node.value >= 256:
+                    big.add(node.value)
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.RShift
+            ):
+                amt = _fold_int(node.right)
+                if amt is not None:
+                    shifts.append(amt)
+        out[name] = {"big_ints": big, "shifts": sorted(shifts)}
+    return out
+
+
+def py_hash_unit(path: str) -> Dict[str, Optional[int]]:
+    """``_hash_unit``: (right-shift amount, divisor) — top-53-bit unit
+    float contract."""
+    tree = _parse(path)
+    fn = _func(tree, "_hash_unit")
+    if fn is None:
+        return {"shift": None, "divisor": None}
+    shift = divisor = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.RShift):
+            shift = _fold_int(node.right)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.LShift):
+            divisor = _fold_int(node)
+    return {"shift": shift, "divisor": divisor}
+
+
+def py_step_sentinel(path: str) -> Set[int]:
+    """All distinct ``1 << N`` folds with N >= 32 in chaos.py — the
+    step-window sentinel(s)."""
+    tree = _parse(path)
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.LShift):
+            v = _fold_int(node)
+            if v is not None and v >= (1 << 32):
+                out.add(v)
+    return out
+
+
+_HEX = re.compile(r"0[xX][0-9a-fA-F]+")
+_RSHIFT = re.compile(r">>\s*(\d+)")
+
+
+def cc_hash_constants(path: str) -> Dict[str, Dict[str, Any]]:
+    text = strip_cc_comments(open(path).read())
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in HASH_FUNCS:
+        body = cc_function_body(text, name)
+        if body is None:
+            out[name] = {"missing": True}
+            continue
+        big = {
+            int(h, 16) for h in _HEX.findall(body) if int(h, 16) >= 256
+        }
+        shifts = sorted(int(s) for s in _RSHIFT.findall(body))
+        out[name] = {"big_ints": big, "shifts": shifts}
+    return out
+
+
+def cc_hash_unit(path: str) -> Dict[str, Optional[int]]:
+    """The ``(h >> S) / D.0`` unit-float expression in chaos.cc."""
+    text = strip_cc_comments(open(path).read())
+    m = re.search(r">>\s*(\d+)\)\s*/\s*(\d+)\.0", text)
+    if not m:
+        return {"shift": None, "divisor": None}
+    return {"shift": int(m.group(1)), "divisor": int(m.group(2))}
+
+
+def cc_step_sentinel(path: str) -> Optional[int]:
+    text = strip_cc_comments(open(path).read())
+    m = re.search(r"kStepMax\s*=\s*int64_t\(1\)\s*<<\s*(\d+)", text)
+    return (1 << int(m.group(1))) if m else None
+
+
+# ----------------------------------------------------------------------
+# chaos enums (kinds / planes) and grammar param keys
+# ----------------------------------------------------------------------
+
+
+def py_tuple_of_strings(path: str, name: str) -> Optional[Tuple[str, ...]]:
+    tree = _parse(path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        vals = []
+                        for elt in node.value.elts:
+                            if isinstance(
+                                elt, ast.Constant
+                            ) and isinstance(elt.value, str):
+                                vals.append(elt.value)
+                        return tuple(vals)
+    return None
+
+
+def cc_kind_names(path: str) -> Optional[Tuple[str, ...]]:
+    text = strip_cc_comments(open(path).read())
+    m = re.search(r"kKindNames\[\]\s*=\s*\{([^}]*)\}", text)
+    if not m:
+        return None
+    return tuple(re.findall(r'"([^"]+)"', m.group(1)))
+
+
+def cc_num_kinds(path: str) -> Optional[int]:
+    text = strip_cc_comments(open(path).read())
+    m = re.search(r"kNumKinds\s*=\s*(\d+)", text)
+    return int(m.group(1)) if m else None
+
+
+def cc_planes(path: str) -> Optional[Tuple[str, ...]]:
+    text = strip_cc_comments(open(path).read())
+    body = cc_function_body(text, "valid_plane")
+    if body is None:
+        return None
+    return tuple(re.findall(r'==\s*"([^"]+)"', body))
+
+
+def hpp_kind_enum(path: str) -> Optional[List[Tuple[str, Optional[int]]]]:
+    """``enum [class] Kind`` entries as (name, explicit value or None)."""
+    text = strip_cc_comments(open(path).read())
+    m = re.search(r"enum\s+(?:class\s+)?Kind[^{]*\{([^}]*)\}", text)
+    if not m:
+        return None
+    out: List[Tuple[str, Optional[int]]] = []
+    for entry in m.group(1).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        em = re.match(r"(\w+)(?:\s*=\s*(\d+))?", entry)
+        if em:
+            out.append(
+                (em.group(1), int(em.group(2)) if em.group(2) else None)
+            )
+    return out
+
+
+def kind_to_enum_name(kind: str) -> str:
+    """``connect_refuse`` -> ``kConnectRefuse`` (the naming convention
+    the C++ enum follows)."""
+    return "k" + "".join(w.capitalize() for w in kind.split("_"))
+
+
+def py_grammar_params(path: str) -> Set[str]:
+    """Param keys handled by chaos.py ``parse_rule`` (the
+    ``k == "peer"`` ladder)."""
+    tree = _parse(path)
+    fn = _func(tree, "parse_rule")
+    if fn is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if (
+                isinstance(node.left, ast.Name)
+                and node.left.id == "k"
+                and isinstance(node.ops[0], ast.Eq)
+                and isinstance(node.comparators[0], ast.Constant)
+                and isinstance(node.comparators[0].value, str)
+            ):
+                out.add(node.comparators[0].value)
+    return out
+
+
+def cc_grammar_params(path: str) -> Set[str]:
+    text = strip_cc_comments(open(path).read())
+    body = cc_function_body(text, "parse_rule")
+    if body is None:
+        return set()
+    return set(re.findall(r'\bk\s*==\s*"(\w+)"', body))
+
+
+# ----------------------------------------------------------------------
+# C ABI (_native.py _declare vs extern "C" prototypes)
+# ----------------------------------------------------------------------
+
+
+def py_abi(path: str) -> Dict[str, Dict[str, Any]]:
+    """``{fn: {"nargs": int, "void": bool}}`` from ``_declare``'s
+    ``lib.<fn>.restype/.argtypes`` assignments."""
+    tree = _parse(path)
+    fn = _func(tree, "_declare")
+    out: Dict[str, Dict[str, Any]] = {}
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Attribute)
+            and isinstance(tgt.value.value, ast.Name)
+            and tgt.value.value.id == "lib"
+        ):
+            continue
+        fname, field = tgt.value.attr, tgt.attr
+        entry = out.setdefault(fname, {})
+        if field == "restype":
+            entry["void"] = (
+                isinstance(node.value, ast.Constant)
+                and node.value.value is None
+            )
+        elif field == "argtypes":
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                entry["nargs"] = len(node.value.elts)
+    return out
+
+
+def cc_abi(path: str) -> Dict[str, Dict[str, Any]]:
+    """Same shape from a header's ``extern "C" { ... }`` block(s)."""
+    text = strip_cc_comments(open(path).read())
+    out: Dict[str, Dict[str, Any]] = {}
+    for m in re.finditer(r'extern\s+"C"\s*\{', text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        block = text[m.end() : i - 1]
+        for proto in block.split(";"):
+            proto = " ".join(proto.split())
+            pm = re.match(
+                r"(?P<ret>[\w:<>]+(?:\s*\*+)?)\s+(?P<name>tft_\w+)\s*"
+                r"\((?P<args>[^)]*)\)$",
+                proto,
+            )
+            if not pm:
+                continue
+            args = pm.group("args").strip()
+            nargs = (
+                0
+                if args in ("", "void")
+                else len(re.split(r",", args))
+            )
+            out[pm.group("name")] = {
+                "nargs": nargs,
+                "void": pm.group("ret").strip() == "void",
+            }
+    return out
+
+
+def py_dtype_codes(path: str) -> Optional[Dict[str, int]]:
+    tree = _parse(path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "DTYPE_CODES":
+                    if isinstance(node.value, ast.Dict):
+                        return {
+                            k.value: v.value
+                            for k, v in zip(
+                                node.value.keys, node.value.values
+                            )
+                            if isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)
+                        }
+    return None
+
+
+def py_op_codes(path: str) -> Optional[Dict[str, int]]:
+    """``OP_SUM, OP_MAX, OP_MIN = 0, 1, 2`` -> {"SUM": 0, ...}."""
+    tree = _parse(path)
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.targets[0], ast.Tuple)
+            and isinstance(node.value, ast.Tuple)
+        ):
+            names = [
+                t.id
+                for t in node.targets[0].elts
+                if isinstance(t, ast.Name)
+            ]
+            if names and all(n.startswith("OP_") for n in names):
+                vals = [
+                    v.value
+                    for v in node.value.elts
+                    if isinstance(v, ast.Constant)
+                ]
+                if len(vals) == len(names):
+                    return {
+                        n[len("OP_") :]: v for n, v in zip(names, vals)
+                    }
+    return None
+
+
+_CC_DT_NAMES = {"F32": "float32", "F64": "float64", "I32": "int32",
+                "I64": "int64"}
+
+
+def cc_dtype_codes(path: str) -> Dict[str, int]:
+    text = strip_cc_comments(open(path).read())
+    out: Dict[str, int] = {}
+    for m in re.finditer(r"TFT_DT_(\w+)\s*=\s*(\d+)", text):
+        name = _CC_DT_NAMES.get(m.group(1), m.group(1))
+        out[name] = int(m.group(2))
+    return out
+
+
+def cc_op_codes(path: str) -> Dict[str, int]:
+    text = strip_cc_comments(open(path).read())
+    return {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(r"TFT_OP_(\w+)\s*=\s*(\d+)", text)
+    }
+
+
+# ----------------------------------------------------------------------
+# RPC methods and JSON keys
+# ----------------------------------------------------------------------
+
+
+def py_rpc_clients(path: str) -> Dict[str, Dict[str, Set[str]]]:
+    """Per client class in coordination.py:
+    ``{"types": RPC type values sent, "keys": all request keys sent}``.
+    Keys come from dict literals that contain a ``"type"`` entry plus
+    any ``var["key"] = ...`` subscript assignment in the same class."""
+    tree = _parse(path)
+    out: Dict[str, Dict[str, Set[str]]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        types: Set[str] = set()
+        keys: Set[str] = set()
+        dict_vars: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                entry_keys = [
+                    k.value
+                    for k in sub.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                ]
+                if "type" in entry_keys:
+                    keys.update(entry_keys)
+                    for k, v in zip(sub.keys, sub.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "type"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                        ):
+                            types.add(v.value)
+        # second pass: subscript assignments onto request dicts
+        # (req["digest"] = ..., req["hb_interval_ms"] = ...)
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Subscript)
+            ):
+                sl = sub.targets[0].slice
+                if isinstance(sl, ast.Constant) and isinstance(
+                    sl.value, str
+                ):
+                    keys.add(sl.value)
+        if types:
+            out[node.name] = {"types": types, "keys": keys,
+                              "dict_vars": dict_vars}
+    return out
+
+
+def py_method_dict_keys(path: str, qualname: str) -> Set[str]:
+    """Constant string keys of dict literals (plus ``x["k"] =``
+    assignments) inside one function/method — e.g.
+    ``QuorumMember.to_json`` or ``StepDigest.to_wire``."""
+    tree = _parse(path)
+    fn = _func(tree, qualname)
+    if fn is None:
+        return set()
+    keys: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Dict):
+            for k in sub.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, str
+                ):
+                    keys.add(k.value)
+        if (
+            isinstance(sub, ast.Assign)
+            and len(sub.targets) == 1
+            and isinstance(sub.targets[0], ast.Subscript)
+        ):
+            sl = sub.targets[0].slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                keys.add(sl.value)
+    return keys
+
+
+def py_class_int_attr(
+    path: str, cls: str, attr: str
+) -> Optional[int]:
+    tree = _parse(path)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and tgt.id == attr
+                        ):
+                            return _fold_int(sub.value)
+    return None
+
+
+def cc_dispatch_types(path: str) -> Set[str]:
+    """RPC types a C++ server dispatches (``type == "X"`` ladders)."""
+    text = strip_cc_comments(open(path).read())
+    return set(re.findall(r'\btype\s*==\s*"(\w+)"', text))
+
+
+def cc_sent_types(path: str) -> Set[str]:
+    """RPC types a C++ file originates: every string literal on the RHS
+    of a ``...["type"] = Json::of(...)`` assignment (covers the ternary
+    form too)."""
+    text = strip_cc_comments(open(path).read())
+    out: Set[str] = set()
+    for m in re.finditer(r'\["type"\]\s*=\s*Json::of\(([^)]*)\)', text):
+        out.update(re.findall(r'"(\w+)"', m.group(1)))
+    return out
+
+
+def cc_req_keys(path: str) -> Set[str]:
+    """Request keys a C++ server reads (``req.get("K")``)."""
+    text = strip_cc_comments(open(path).read())
+    return set(re.findall(r'\breq\.get\("(\w+)"\)', text))
+
+
+def cc_assigned_keys(path: str) -> Set[str]:
+    """All JSON keys a C++ file assigns (``x["k"] = ...``) — requests it
+    builds and responses it fills."""
+    text = strip_cc_comments(open(path).read())
+    return set(re.findall(r'\["(\w+)"\]\s*=', text))
+
+
+def cc_digest_keys(path: str) -> Set[str]:
+    """Digest wire keys the lighthouse reads
+    (``<expr>digest.get("K")``)."""
+    text = strip_cc_comments(open(path).read())
+    return set(re.findall(r'digest\.get\("(\w+)"\)', text))
+
+
+def cc_member_keys(path: str) -> Set[str]:
+    """Quorum-member keys lighthouse.cc parses (``p.get("K")`` in its
+    member-parsing loop)."""
+    text = strip_cc_comments(open(path).read())
+    return set(re.findall(r'\bp\.get\("(\w+)"\)', text))
+
+
+# ----------------------------------------------------------------------
+# journal event kinds
+# ----------------------------------------------------------------------
+
+
+def py_event_kinds_registry(path: str) -> Optional[Dict[str, str]]:
+    """The ``EVENT_KINDS`` dict literal in telemetry.py."""
+    tree = _parse(path)
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Name)
+                and tgt.id == "EVENT_KINDS"
+                and isinstance(value, ast.Dict)
+            ):
+                return {
+                    k.value: v.value
+                    for k, v in zip(value.keys, value.values)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(v, ast.Constant)
+                }
+    return None
+
+
+def py_emitted_kinds(paths: List[str]) -> Dict[str, List[Tuple[str, int]]]:
+    """``{kind: [(file, line), ...]}`` for every ``emit(...)`` /
+    ``_journal(...)`` call with a string-literal first argument."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for path in paths:
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else (fn.id if isinstance(fn, ast.Name) else None)
+            )
+            if name not in ("emit", "_journal"):
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Constant) and isinstance(
+                arg0.value, str
+            ):
+                out.setdefault(arg0.value, []).append(
+                    (path, node.lineno)
+                )
+    return out
+
+
+# ----------------------------------------------------------------------
+# env knobs
+# ----------------------------------------------------------------------
+
+
+def py_knob_registry(path: str) -> Optional[Dict[str, Dict[str, Any]]]:
+    """The ``_k("NAME", type, default, doc, scope=...)`` entries in
+    knobs.py, without importing it."""
+    tree = _parse(path)
+    out: Dict[str, Dict[str, Any]] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_k"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            name = node.args[0].value
+            scope = "py"
+            for kw in node.keywords:
+                if kw.arg == "scope" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    scope = kw.value.value
+            if len(node.args) >= 4 and isinstance(
+                node.args[3], ast.Constant
+            ):
+                pass
+            out[name] = {"scope": scope}
+    return out or None
+
+
+def py_raw_env_reads(
+    paths: List[str], prefix: str = "TORCHFT_"
+) -> List[Tuple[str, int, str]]:
+    """Direct ``os.environ``/``os.getenv`` READS of ``TORCHFT_*`` names:
+    ``environ.get(X)``, ``environ[X]`` loads, ``getenv(X)``.  Writes,
+    ``pop``/``del``, and ``"... in os.environ"`` checks are allowed
+    (launchers set child env all the time)."""
+    found: List[Tuple[str, int, str]] = []
+    for path in paths:
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            # os.environ.get("X") / os.getenv("X")
+            if isinstance(node, ast.Call):
+                fn = node.func
+                attr = fn.attr if isinstance(fn, ast.Attribute) else None
+                is_environ_get = (
+                    attr == "get"
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "environ"
+                )
+                is_getenv = attr == "getenv" or (
+                    isinstance(fn, ast.Name) and fn.id == "getenv"
+                )
+                if (is_environ_get or is_getenv) and node.args:
+                    arg0 = node.args[0]
+                    if isinstance(arg0, ast.Constant) and isinstance(
+                        arg0.value, str
+                    ):
+                        if arg0.value.startswith(prefix):
+                            found.append(
+                                (path, node.lineno, arg0.value)
+                            )
+            # os.environ["X"] as a LOAD (writes have Store ctx)
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "environ"
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+                and node.slice.value.startswith(prefix)
+            ):
+                found.append((path, node.lineno, node.slice.value))
+    return found
+
+
+def py_knob_accessor_calls(
+    paths: List[str],
+) -> List[Tuple[str, int, str]]:
+    """Every ``knobs.get_*("NAME")`` / ``knobs.require("NAME")`` call."""
+    accessors = {
+        "get_raw", "get_str", "get_int", "get_float", "get_bool",
+        "require",
+    }
+    found: List[Tuple[str, int, str]] = []
+    for path in paths:
+        try:
+            tree = _parse(path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in accessors
+                and isinstance(fn.value, ast.Name)
+                and "knobs" in fn.value.id
+            ):
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Constant) and isinstance(
+                arg0.value, str
+            ):
+                found.append((path, node.lineno, arg0.value))
+    return found
+
+
+def cc_env_reads(paths: List[str], prefix: str = "TORCHFT_") -> Set[str]:
+    """``getenv("TORCHFT_X")`` names across the C++ sources."""
+    out: Set[str] = set()
+    for path in paths:
+        text = strip_cc_comments(open(path).read())
+        out.update(
+            n
+            for n in re.findall(r'getenv\("(\w+)"\)', text)
+            if n.startswith(prefix)
+        )
+    return out
+
+
+# ----------------------------------------------------------------------
+# wall-clock-free chaos decision path
+# ----------------------------------------------------------------------
+
+# Functions forming the deterministic decision path: same (seed, spec,
+# visit sequence) must produce the same injections on any host at any
+# time — so no clocks, no RNG, no PIDs in here.
+DECISION_FUNCS = (
+    "fnv1a64",
+    "splitmix64",
+    "decision_hash",
+    "_hash_unit",
+    "parse_rule",
+    "parse_spec",
+    "Chaos._rule_fires",
+    "Chaos.pick",
+)
+
+_FORBIDDEN_MODULES = {"time", "random", "datetime", "os", "uuid"}
+
+
+def py_wallclock_calls(path: str) -> List[Tuple[str, int, str]]:
+    """Calls to time/random/datetime/os/uuid inside the decision path
+    (``(func, line, offending call)``)."""
+    tree = _parse(path)
+    bad: List[Tuple[str, int, str]] = []
+    for qual in DECISION_FUNCS:
+        fn = _func(tree, qual)
+        if fn is None:
+            bad.append((qual, 0, "<function missing>"))
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _FORBIDDEN_MODULES
+            ):
+                bad.append(
+                    (qual, node.lineno, f"{f.value.id}.{f.attr}")
+                )
+    return bad
