@@ -364,6 +364,7 @@ FlightRec* CollectiveEngine::fr_begin(int32_t op_code, int32_t dtype,
   if (seq > static_cast<uint64_t>(fr_cap_))
     fr_dropped_.fetch_add(1, std::memory_order_relaxed);
   FlightRec* rec = &fr_ring_[(seq - 1) % fr_cap_];
+  std::lock_guard<std::mutex> fr_lk(fr_mu_);
   // seq=0 marks the slot torn while we reset it; a concurrent snapshot
   // skips it instead of reporting a half-old half-new record.
   rec->seq.store(0, std::memory_order_release);
@@ -388,10 +389,11 @@ FlightRec* CollectiveEngine::fr_begin(int32_t op_code, int32_t dtype,
 
 void CollectiveEngine::fr_end(FlightRec* rec, bool ok) {
   if (rec == nullptr) return;
+  const std::string err = ok ? std::string() : last_error();
+  std::lock_guard<std::mutex> fr_lk(fr_mu_);
   rec->t_end_ns = now_realtime_ns();
   int32_t st = 1;
   if (!ok) {
-    const std::string err = last_error();
     const size_t n = std::min(err.size(), sizeof(rec->cause) - 1);
     memcpy(rec->cause, err.data(), n);
     rec->cause[n] = '\0';
@@ -408,7 +410,10 @@ void CollectiveEngine::fr_end(FlightRec* rec, bool ok) {
 void CollectiveEngine::fr_step(FlightRec* rec) {
   if (rec == nullptr) return;
   const uint32_t i = rec->nsteps.fetch_add(1, std::memory_order_relaxed);
-  if (i < kFrMaxSteps) rec->step_ns[i] = now_realtime_ns();
+  if (i < kFrMaxSteps) {
+    std::lock_guard<std::mutex> fr_lk(fr_mu_);
+    rec->step_ns[i] = now_realtime_ns();
+  }
 }
 
 void CollectiveEngine::fr_job(FlightRec* rec, int peer, int stripe, int dir,
@@ -431,6 +436,7 @@ void CollectiveEngine::fr_job(FlightRec* rec, int peer, int stripe, int dir,
   if (rec == nullptr) return;
   const uint32_t li = rec->lane_n.fetch_add(1, std::memory_order_relaxed);
   if (li >= static_cast<uint32_t>(kFrMaxLanes)) return;
+  std::lock_guard<std::mutex> fr_lk(fr_mu_);
   FlightLane& L = rec->lanes[li];
   L.peer = static_cast<int16_t>(peer);
   L.stripe = static_cast<int8_t>(stripe);
@@ -444,8 +450,9 @@ void CollectiveEngine::fr_job(FlightRec* rec, int peer, int stripe, int dir,
 
 namespace {
 
-// Snapshot strings may be read torn (the ring wraps under the reader): keep
-// only printable ASCII so the emitted JSON always parses.
+// Snapshot reads are serialized with writers by fr_mu_, but the strings are
+// still caller-supplied byte buffers: keep only printable ASCII so the
+// emitted JSON always parses.
 std::string fr_sanitize(const char* s, size_t cap) {
   std::string out;
   for (size_t i = 0; i < cap && s[i] != '\0'; ++i) {
@@ -521,6 +528,7 @@ std::string CollectiveEngine::fr_snapshot(uint64_t since_seq) const {
   }
   root["peers"] = std::move(peers);
   Json recs = Json::array();
+  std::lock_guard<std::mutex> fr_lk(fr_mu_);
   if (fr_cap_ > 0 && hi > 0) {
     const uint64_t lo0 = hi > static_cast<uint64_t>(fr_cap_)
                              ? hi - static_cast<uint64_t>(fr_cap_)

@@ -260,6 +260,12 @@ class CollectiveEngine {
   std::atomic<uint64_t> fr_dropped_{0};
   std::atomic<uint64_t> spin_total_{0};
   std::unique_ptr<PeerCounters[]> peer_counters_;  // sized world_ at connect
+  // Serializes ring-record field mutation (fr_begin/end/step/job) against
+  // fr_snapshot. The per-record seq/status/nsteps/lane_n atomics stay for
+  // wrap detection and slot claiming; the mutex covers the plain fields a
+  // snapshot would otherwise read torn. Held for ns — collective jobs spend
+  // their time in socket I/O, not here.
+  mutable std::mutex fr_mu_;
   mutable std::mutex trace_mu_;
   char trace_tag_[kFrTagLen] = {0};
 };

@@ -36,13 +36,15 @@ void Lighthouse::stop() {
   if (!running_.exchange(false)) return;
   cv_.notify_all();
   conns_.shutdown_all();  // interrupt in-flight frames so handlers drain fast
+  // shutdown() unblocks the accept loop; close() + reset must wait until
+  // the thread is joined — accept_loop reads listen_fd_ until then.
+  if (listen_fd_ >= 0) shutdown(listen_fd_, SHUT_RDWR);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (tick_thread_.joinable()) tick_thread_.join();
   if (listen_fd_ >= 0) {
-    shutdown(listen_fd_, SHUT_RDWR);
     close(listen_fd_);
     listen_fd_ = -1;
   }
-  if (accept_thread_.joinable()) accept_thread_.join();
-  if (tick_thread_.joinable()) tick_thread_.join();
   conns_.wait_idle(10000);
 }
 
