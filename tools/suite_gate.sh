@@ -36,6 +36,9 @@
 #                                       # ABI, RPC surface, event kinds, env
 #                                       # knobs) proven from source; seconds,
 #                                       # pure Python, no build needed
+#        bash tools/suite_gate.sh san   # sanitizer lane: cpp_tests + the
+#                                       # 2-replica allreduce/abort drill
+#                                       # under TSan, ASan(+LSan) and UBSan
 set -u
 cd "$(dirname "$0")/.."
 
@@ -66,6 +69,11 @@ fi
 if [ "${1:-}" = "lint" ]; then
   echo "== lint: dual-language contract linter (tools/tft_lint.py) =="
   exec timeout 120 python tools/tft_lint.py --check --report LINT_REPORT.json
+fi
+
+if [ "${1:-}" = "san" ]; then
+  echo "== san: cpp_tests + san_drill under TSan / ASan / UBSan =="
+  exec timeout 3600 make -C torchft_tpu/_cpp san
 fi
 
 if [ "${1:-}" = "pg" ]; then
