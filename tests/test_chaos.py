@@ -487,9 +487,12 @@ def test_native_abi_arm_disarm():
 def test_native_reset_latches_error_like_socket(store):
     """Socket-vs-native parity: the same spec produces the same observable
     outcome — a failed collective, a latched errored(), and a clean
-    recovery on reconfigure."""
+    recovery on reconfigure. The rule is UNLIMITED (no count=): a
+    single-stripe reset now fails over to the surviving stripes
+    (tests/test_wan.py), so forcing the abort path requires killing every
+    stripe and every handoff attempt."""
     groups = _make_native_group(store, 2, prefix="nchr")
-    _native.chaos_init("seed:5,spec:reset@data:match=c1:count=1")
+    _native.chaos_init("seed:5,spec:reset@data:match=c1")
 
     def run(rank):
         try:

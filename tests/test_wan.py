@@ -1,0 +1,365 @@
+"""Degraded-network survival tests: per-peer link policy (TORCHFT_LINKS),
+in-collective stripe failover in the native engine, and the two-region
+partition/heal contract the WAN drill (tools/wan_drill.py) soaks at scale.
+
+The failover contract under test:
+
+- one stripe of a striped peer link dying MID-collective re-assigns its
+  byte range to the surviving stripes and the collective completes
+  bitwise-identical to an unfaulted run — no abort, no latched error;
+- every such handoff is journaled as a ``stripe_failover`` flight-recorder
+  event on both ends;
+- ALL stripes dying keeps the existing abort/poison/latch contract
+  (tests/test_chaos.py::test_native_reset_latches_error_like_socket);
+- dead stripes are re-dialed in the background and re-activated at a
+  negotiated collective boundary, restoring the full stripe set.
+"""
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu import _native, chaos
+from torchft_tpu.process_group import (
+    LinkPolicy,
+    ProcessGroupNative,
+    ProcessGroupSocket,
+    ReduceOp,
+    parse_links,
+)
+from torchft_tpu.store import TCPStoreServer
+
+native = pytest.mark.skipif(
+    not _native.is_available(), reason="native collective engine unavailable"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv("TORCHFT_CHAOS", raising=False)
+    monkeypatch.delenv("TORCHFT_LINKS", raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+    if _native.is_available():
+        _native.chaos_init(" ")
+
+
+def _run_parallel(fns, timeout=90):
+    with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture
+def store():
+    server = TCPStoreServer()
+    yield server
+    server.shutdown()
+
+
+def _make_native(store, world, prefix, timeout=20.0):
+    groups = [ProcessGroupNative(timeout=timeout) for _ in range(world)]
+    _run_parallel(
+        [
+            lambda r=r: groups[r].configure(
+                f"{store.address()}/{prefix}", r, world
+            )
+            for r in range(world)
+        ]
+    )
+    return groups
+
+
+def _failovers(group):
+    snap = group._engine.fr_snapshot(group._engine.fr_seq())
+    return snap.get("failovers", [])
+
+
+def _alive_masks(group):
+    snap = group._engine.fr_snapshot(group._engine.fr_seq())
+    return [int(p.get("alive_mask", -1)) for p in snap.get("peers", [])]
+
+
+# ---------------------------------------------------------------------------
+# Link-policy grammar and plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_links_round_trip():
+    default, per_peer = parse_links(
+        "*=wan,streams=8,io_ms=900;1=local,connect_ms=250;2=dcn,q8=1"
+    )
+    assert default == LinkPolicy(
+        cls="wan", connect_ms=15000, io_ms=900, streams=8, q8=True
+    )
+    assert per_peer[1] == LinkPolicy(
+        cls="local", connect_ms=250, io_ms=0, streams=0, q8=False
+    )
+    assert per_peer[2].cls == "dcn" and per_peer[2].q8
+    # Unset spec: plain dcn defaults everywhere.
+    assert parse_links("") == (LinkPolicy(), {})
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "1",  # no '='
+        "1=mars",  # unknown class
+        "1=wan,streams",  # override without '='
+        "1=wan,zz=3",  # unknown key
+        "x=wan",  # non-integer peer
+        "1=wan,streams=x",  # non-integer value
+    ],
+)
+def test_parse_links_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_links(bad)
+
+
+def test_link_policy_selection(monkeypatch):
+    monkeypatch.setenv("TORCHFT_LINKS", "*=local;2=wan,streams=2")
+    pg = ProcessGroupSocket()
+    assert pg.link_policy(0).cls == "local"
+    assert pg.link_policy(2).cls == "wan" and pg.link_policy(2).streams == 2
+
+
+@native
+def test_native_engine_applies_link_policy(store, monkeypatch):
+    """A symmetric TORCHFT_LINKS spec shows up in the engine snapshot (link
+    class + per-stripe health entries) and a wan/q8 link elevates the wire
+    codec when TORCHFT_PG_WIRE doesn't pin one."""
+    monkeypatch.setenv("TORCHFT_LINKS", "*=wan,streams=2,q8=1")
+    monkeypatch.delenv("TORCHFT_PG_WIRE", raising=False)
+    groups = _make_native(store, 2, prefix="lp")
+    try:
+        assert all(g._wire == "int8" for g in groups)
+        arrs = [np.ones(4096, np.float32) * (r + 1) for r in range(2)]
+        _run_parallel(
+            [
+                lambda r=r: groups[r]
+                .allreduce(arrs[r], ReduceOp.SUM)
+                .wait(timeout=20)
+                for r in range(2)
+            ]
+        )
+        np.testing.assert_allclose(arrs[0], 3.0)
+        for g in groups:
+            snap = g._engine.fr_snapshot(g._engine.fr_seq())
+            (peer,) = snap.get("peers", [])
+            assert peer["link"] == "wan"
+            assert len(peer["stripes"]) == 2  # streams=2 override applied
+            assert int(peer["alive_mask"]) == 0b11
+    finally:
+        for g in groups:
+            g.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# In-collective stripe failover
+# ---------------------------------------------------------------------------
+
+
+@native
+def test_one_stripe_kill_mid_allreduce_completes_bitwise(store):
+    """Kill 1 of 4 stripes mid-64MiB allreduce: the collective completes
+    with a bitwise-identical result, no abort, no latched error, and both
+    ends journal the handoff as stripe_failover events."""
+    groups = _make_native(store, 2, prefix="wf")
+    try:
+        n = 1 << 24  # 64 MiB of fp32
+        ref = [np.arange(n, dtype=np.float32) + r for r in range(2)]
+        _run_parallel(
+            [
+                lambda r=r: groups[r]
+                .allreduce(ref[r], ReduceOp.SUM)
+                .wait(timeout=60)
+                for r in range(2)
+            ]
+        )
+        # Second collective (tag c2): reset every I/O on stripe 1 only.
+        _native.chaos_init("seed:7,spec:reset@data:match=c2|s1")
+        arrs = [np.arange(n, dtype=np.float32) + r for r in range(2)]
+        _run_parallel(
+            [
+                lambda r=r: groups[r]
+                .allreduce(arrs[r], ReduceOp.SUM)
+                .wait(timeout=60)
+                for r in range(2)
+            ]
+        )
+        _native.chaos_init(" ")
+        for r in range(2):
+            np.testing.assert_array_equal(arrs[r], ref[r])  # bitwise
+        assert all(g.errored() is None for g in groups)
+        for g in groups:
+            evs = _failovers(g)
+            assert any(
+                f["stripe"] == 1 and f["tag"] == "c2" and f["to_stripe"] >= 0
+                for f in evs
+            ), evs
+            # Stripe 1 is dead until the rejoin janitor brings it back.
+            assert all(m & 0b10 == 0 or m == 0b1111 for m in _alive_masks(g))
+    finally:
+        _native.chaos_init(" ")
+        for g in groups:
+            g.shutdown()
+
+
+@native
+def test_dead_stripe_rejoins_in_background(store):
+    """After a stripe dies, the background janitor re-dials it and a later
+    collective re-activates it: the alive mask returns to full, journaled
+    as a dir=rejoin failover event."""
+    groups = _make_native(store, 2, prefix="rj")
+    try:
+        _native.chaos_init("seed:7,spec:reset@data:match=c1|s2")
+        arrs = [np.ones(1 << 20, np.float32) for _ in range(2)]
+        _run_parallel(
+            [
+                lambda r=r: groups[r]
+                .allreduce(arrs[r], ReduceOp.SUM)
+                .wait(timeout=30)
+                for r in range(2)
+            ]
+        )
+        _native.chaos_init(" ")
+        assert all(m == 0b1011 for g in groups for m in _alive_masks(g))
+        healed = False
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not healed:
+            time.sleep(0.4)
+            small = [np.ones(512, np.float32) for _ in range(2)]
+            _run_parallel(
+                [
+                    lambda r=r: groups[r]
+                    .allreduce(small[r], ReduceOp.SUM)
+                    .wait(timeout=20)
+                    for r in range(2)
+                ]
+            )
+            healed = all(
+                m == 0b1111 for g in groups for m in _alive_masks(g)
+            )
+        assert healed, [_alive_masks(g) for g in groups]
+        assert any(f["dir"] == "rejoin" for f in _failovers(groups[0]))
+    finally:
+        _native.chaos_init(" ")
+        for g in groups:
+            g.shutdown()
+
+
+@native
+def test_all_stripes_dead_still_aborts_and_latches(store):
+    """The failover ladder bottoms out exactly where the old contract
+    lived: every stripe (and every handoff) dead -> the collective fails,
+    errored() latches, and reconfigure recovers — the abort/poison/latch
+    path of test_chaos.py, unchanged."""
+    groups = _make_native(store, 2, prefix="wa")
+    try:
+        _native.chaos_init("seed:7,spec:reset@data:match=c1")
+
+        def run(rank):
+            try:
+                groups[rank].allreduce(np.ones(256, np.float32)).wait(
+                    timeout=20
+                )
+                return None
+            except Exception as e:  # noqa: BLE001 - the point of the test
+                return e
+
+        errors = [
+            e
+            for e in _run_parallel([lambda r=r: run(r) for r in range(2)])
+            if e
+        ]
+        assert errors, "all-stripe kill must fail the collective"
+        assert any(g.errored() is not None for g in groups)
+        _native.chaos_init(" ")
+
+        def reconfigure(rank):
+            groups[rank].configure(f"{store.address()}/wa2", rank, 2)
+            arr = np.full(8, float(rank + 1), np.float32)
+            groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+            return arr
+
+        a, _ = _run_parallel([lambda r=r: reconfigure(r) for r in range(2)])
+        np.testing.assert_allclose(a, 3.0)
+        assert all(g.errored() is None for g in groups)
+    finally:
+        _native.chaos_init(" ")
+        for g in groups:
+            g.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Two-region partition + heal smoke (CPU-only, fast)
+# ---------------------------------------------------------------------------
+
+
+@native
+def test_two_region_partition_and_heal(store, monkeypatch):
+    """Miniature of tools/wan_drill.py: two 'regions' (one rank each)
+    joined by a wan-class link, throttled by a link-scoped token bucket;
+    a full partition (all stripes reset) latches the error, and the heal
+    (reconfigure) restores agreement."""
+    monkeypatch.setenv("TORCHFT_LINKS", "*=wan,streams=2,connect_ms=1000")
+    monkeypatch.delenv("TORCHFT_PG_WIRE", raising=False)
+    groups = _make_native(store, 2, prefix="tr")
+    try:
+        # Degraded-but-alive: a link-scoped throttle paces the wire without
+        # failing anything.
+        _native.chaos_init(
+            "seed:11,spec:throttle@data:link=wan:rate=268435456:bucket=1048576"
+        )
+        arrs = [np.full(1 << 16, float(r + 1), np.float32) for r in range(2)]
+        _run_parallel(
+            [
+                lambda r=r: groups[r]
+                .allreduce(arrs[r], ReduceOp.SUM)
+                .wait(timeout=30)
+                for r in range(2)
+            ]
+        )
+        np.testing.assert_allclose(arrs[0], 3.0)
+        assert all(g.errored() is None for g in groups)
+
+        # Partition: kill the cross-region link entirely.
+        _native.chaos_init("seed:11,spec:reset@data:link=wan")
+
+        def run(rank):
+            try:
+                groups[rank].allreduce(np.ones(256, np.float32)).wait(
+                    timeout=20
+                )
+                return None
+            except Exception as e:  # noqa: BLE001
+                return e
+
+        errors = [
+            e
+            for e in _run_parallel([lambda r=r: run(r) for r in range(2)])
+            if e
+        ]
+        assert errors, "a full cross-region partition must fail collectives"
+
+        # Heal: drop the fault, reconfigure, verify agreement.
+        _native.chaos_init(" ")
+
+        def reconfigure(rank):
+            groups[rank].configure(f"{store.address()}/tr2", rank, 2)
+            arr = np.full(16, float(rank + 1), np.float32)
+            groups[rank].allreduce(arr, ReduceOp.SUM).wait(timeout=30)
+            return arr
+
+        a, b = _run_parallel([lambda r=r: reconfigure(r) for r in range(2)])
+        np.testing.assert_allclose(a, 3.0)
+        np.testing.assert_allclose(b, 3.0)
+        assert all(g.errored() is None for g in groups)
+    finally:
+        _native.chaos_init(" ")
+        for g in groups:
+            g.shutdown()

@@ -39,6 +39,12 @@
 #        bash tools/suite_gate.sh san   # sanitizer lane: cpp_tests + the
 #                                       # 2-replica allreduce/abort drill
 #                                       # under TSan, ASan(+LSan) and UBSan
+#        bash tools/suite_gate.sh wan   # degraded-network drill: 2-region
+#                                       # DiLoCo over a throttled wan link
+#                                       # with mid-collective stripe tears
+#                                       # -> BENCH_WAN.json, then a same-seed
+#                                       # replay asserting the injection
+#                                       # multiset is identical
 set -u
 cd "$(dirname "$0")/.."
 
@@ -69,6 +75,15 @@ fi
 if [ "${1:-}" = "lint" ]; then
   echo "== lint: dual-language contract linter (tools/tft_lint.py) =="
   exec timeout 120 python tools/tft_lint.py --check --report LINT_REPORT.json
+fi
+
+if [ "${1:-}" = "wan" ]; then
+  echo "== wan drill: 2-region DiLoCo over a degraded striped link =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/wan_drill.py --quick \
+    || exit 1
+  echo "== wan replay: same seed must reproduce the injection multiset =="
+  exec timeout 600 env JAX_PLATFORMS=cpu python tools/wan_drill.py \
+    --replay BENCH_WAN.json
 fi
 
 if [ "${1:-}" = "san" ]; then

@@ -30,22 +30,24 @@ constexpr int64_t kStepMax = int64_t(1) << 62;
 constexpr size_t kEventRing = 1024;
 
 const char* kKindNames[] = {
-    "connect_refuse", "reset",      "stall",      "partial_write",
-    "rpc_delay",      "rpc_drop",   "abort_heal", "ckpt_truncate",
+    "connect_refuse", "reset",    "stall",      "partial_write", "rpc_delay",
+    "rpc_drop",       "abort_heal", "ckpt_truncate", "throttle",
 };
-constexpr int32_t kNumKinds = 8;
+constexpr int32_t kNumKinds = 9;
 
 struct Rule {
   int32_t kind = -1;
   std::string plane;  // ctrl | data | heal | srv | any
   int32_t index = 0;
-  bool has_peer = false, has_match = false;
-  std::string peer, match;
+  bool has_peer = false, has_match = false, has_link = false;
+  std::string peer, match, link;
   int64_t step_lo = -1, step_hi = kStepMax;
   double p = 1.0;
   int64_t after = 0, every = 1, count = -1;  // count -1 = unlimited
   int64_t ms = 100;
   double frac = 0.5;
+  int64_t rate = int64_t(1) << 20;    // throttle: bytes/second sustained
+  int64_t bucket = int64_t(1) << 16;  // throttle: burst bytes
 };
 
 struct Event {
@@ -55,7 +57,18 @@ struct Event {
   int32_t rule = 0;
   int64_t visit = 0, step = -1, ms = 0;
   double frac = 0.0;
+  int64_t rate = 0, bucket = 0;
   uint64_t ts_ns = 0;
+};
+
+// Wall-clock token bucket pacing an activated throttle site. Which visit
+// activates it is the seeded pick(); the pacing itself (like a stall's
+// sleep) is not part of the replayed decision sequence.
+struct Bucket {
+  int64_t rate = 0;  // 0 == not yet configured
+  int64_t cap = 1;
+  double tokens = 0.0;
+  int64_t t_last_ms = 0;
 };
 
 struct State {
@@ -67,6 +80,8 @@ struct State {
   int64_t seq = 0;
   std::deque<Event> events;
   std::unordered_map<std::string, uint64_t> site_hash;
+  std::map<std::string, Bucket> buckets;  // site -> active throttle
+  bool has_throttle = false;              // any kThrottle rule in `rules`
 };
 
 // Never freed once armed: hooks on detached threads may outlive main.
@@ -74,6 +89,26 @@ State* g_state = nullptr;
 std::atomic<bool> g_armed{false};
 std::atomic<int64_t> g_step{-1};
 std::mutex g_init_mu;
+
+// Peer -> link class, fed from TORCHFT_LINKS via tft_chaos_set_link.
+// Own mutex: written at configure time, read in pick() only for rules that
+// carry a link filter.
+std::map<std::string, std::string>* g_links = nullptr;  // never freed
+std::mutex g_links_mu;
+
+// Serializes throttle activation (bucket check + pick + create) so
+// concurrent stripe threads at one site produce a deterministic number of
+// activation visits; also guards State::buckets and pacing math.
+std::mutex g_throttle_mu;
+
+// True when the rule's link filter matches the current thread's peer.
+bool link_matches(const Rule& r, const std::string& peer) {
+  if (!r.has_link) return true;
+  std::lock_guard<std::mutex> lk(g_links_mu);
+  if (g_links == nullptr) return false;
+  auto it = g_links->find(peer);
+  return it != g_links->end() && it->second == r.link;
+}
 
 struct Ctx {
   bool set = false;
@@ -173,6 +208,9 @@ bool parse_rule(const std::string& text, int32_t index, Rule* out,
       } else if (k == "match") {
         r.has_match = true;
         r.match = v;
+      } else if (k == "link") {
+        r.has_link = true;
+        r.link = v;
       } else if (k == "step") {
         size_t dash = v.find('-');
         std::string lo = dash == std::string::npos ? v : v.substr(0, dash);
@@ -194,6 +232,12 @@ bool parse_rule(const std::string& text, int32_t index, Rule* out,
       } else if (k == "frac") {
         r.frac = std::stod(v);
         if (r.frac < 0.0 || r.frac > 1.0) throw std::runtime_error("frac");
+      } else if (k == "rate") {
+        r.rate = std::stoll(v);
+        if (r.rate <= 0) throw std::runtime_error("rate");
+      } else if (k == "bucket") {
+        r.bucket = std::stoll(v);
+        if (r.bucket <= 0) throw std::runtime_error("bucket");
       } else {
         *err = "rule '" + text + "': unknown param '" + k + "'";
         return false;
@@ -287,6 +331,7 @@ bool init_from_spec(const std::string& spec, std::string* err) {
       delete st;
       return false;
     }
+    if (r.kind == kThrottle) st->has_throttle = true;
     st->rules.push_back(r);
     ++index;
   }
@@ -359,6 +404,7 @@ Decision pick(int32_t kind, const std::string& site) {
     if (r.has_peer && t_ctx.peer.find(r.peer) == std::string::npos) continue;
     if (r.has_match && t_ctx.match.find(r.match) == std::string::npos)
       continue;
+    if (!link_matches(r, t_ctx.peer)) continue;
     if (r.step_lo >= 0 &&
         (step < 0 || step < r.step_lo || step > r.step_hi))
       continue;
@@ -376,6 +422,7 @@ Decision pick(int32_t kind, const std::string& site) {
         continue;
       if (r.has_match && t_ctx.match.find(r.match) == std::string::npos)
         continue;
+      if (!link_matches(r, t_ctx.peer)) continue;
       if (r.step_lo >= 0) {  // windowed rule: needs a known step
         if (step < 0 || step < r.step_lo || step > r.step_hi) continue;
       }
@@ -407,6 +454,10 @@ Decision pick(int32_t kind, const std::string& site) {
       d.kind = kind;
       d.ms = r.ms;
       d.frac = r.frac;
+      if (kind == kThrottle) {
+        d.rate = r.rate;
+        d.bucket = r.bucket;
+      }
       ev.seq = st.seq;
       ev.kind = kind;
       ev.plane = t_ctx.plane;
@@ -416,6 +467,8 @@ Decision pick(int32_t kind, const std::string& site) {
       ev.step = step;
       ev.ms = r.ms;
       ev.frac = r.frac;
+      ev.rate = d.rate;
+      ev.bucket = d.bucket;
       ev.ts_ns = now_realtime_ns();
       st.events.push_back(ev);
       if (st.events.size() > kEventRing) st.events.pop_front();
@@ -425,17 +478,58 @@ Decision pick(int32_t kind, const std::string& site) {
   return d;
 }
 
+namespace {
+
+// Milliseconds a paced I/O of `len` bytes must sleep under the bucket.
+int64_t bucket_consume(Bucket& b, size_t len) {
+  const int64_t now = now_ms();
+  b.tokens = std::min(static_cast<double>(b.cap),
+                      b.tokens + static_cast<double>(now - b.t_last_ms) *
+                                     static_cast<double>(b.rate) / 1000.0);
+  b.t_last_ms = now;
+  b.tokens -= static_cast<double>(len);
+  if (b.tokens >= 0.0) return 0;
+  // Cap per-call sleeps so one huge buffered write can't wedge a
+  // deadline-driven transfer longer than a stall rule could.
+  return std::min<int64_t>(
+      static_cast<int64_t>(-b.tokens * 1000.0 / b.rate), 2000);
+}
+
+// Throttle hook body: once a seeded throttle pick fires for `site`, a token
+// bucket paces every later I/O there without further picks (one journaled
+// activation, visit-deterministic because activation is serialized under
+// g_throttle_mu).
+int64_t throttle_ms(State& st, const std::string& site, size_t len) {
+  if (!st.has_throttle) return 0;  // schedules without throttle: lock-free
+  std::lock_guard<std::mutex> lk(g_throttle_mu);
+  auto it = st.buckets.find(site);
+  if (it == st.buckets.end()) {
+    Decision t = pick(kThrottle, site);
+    if (t.kind < 0) return 0;
+    Bucket b;
+    b.rate = std::max<int64_t>(1, t.rate);
+    b.cap = std::max<int64_t>(1, t.bucket);
+    b.tokens = static_cast<double>(b.cap);
+    b.t_last_ms = now_ms();
+    it = st.buckets.emplace(site, b).first;
+  }
+  return bucket_consume(it->second, len);
+}
+
+}  // namespace
+
 Decision on_write(int fd, size_t len) {
   (void)fd;
-  (void)len;
   Decision none;
   if (!g_armed.load(std::memory_order_acquire) || !t_ctx.set) return none;
-  // Skip the site-string allocation and the three pick() scans when the
-  // armed schedule cannot touch this ctx (bench_pg --chaos-ab measures
-  // exactly this path).
+  // Skip the site-string allocation and the pick() scans when the armed
+  // schedule cannot touch this ctx (bench_pg --chaos-ab measures exactly
+  // this path).
   if (!ctx_maybe(*g_state)) return none;
   const std::string site =
       "send:" + (t_ctx.peer.empty() ? std::string("?") : t_ctx.peer);
+  int64_t tms = throttle_ms(*g_state, site, len);
+  if (tms > 0) sleep_ms(tms);
   Decision s = pick(kStall, site);
   if (s.kind == kStall) sleep_ms(s.ms);
   Decision pw = pick(kPartialWrite, site);
@@ -443,13 +537,15 @@ Decision on_write(int fd, size_t len) {
   return pick(kReset, site);
 }
 
-Decision on_read(int fd) {
+Decision on_read(int fd, size_t len) {
   (void)fd;
   Decision none;
   if (!g_armed.load(std::memory_order_acquire) || !t_ctx.set) return none;
   if (!ctx_maybe(*g_state)) return none;
   const std::string site =
       "recv:" + (t_ctx.peer.empty() ? std::string("?") : t_ctx.peer);
+  int64_t tms = throttle_ms(*g_state, site, len);
+  if (tms > 0) sleep_ms(tms);
   Decision s = pick(kStall, site);
   if (s.kind == kStall) sleep_ms(s.ms);
   return pick(kReset, site);
@@ -462,6 +558,21 @@ bool on_connect(const std::string& host, int port) {
                          : t_ctx.peer;
   const std::string site = "connect:" + peer;
   return pick(kConnectRefuse, site).kind >= 0;
+}
+
+void set_link_class(const std::string& peer, const std::string& cls) {
+  std::lock_guard<std::mutex> lk(g_links_mu);
+  if (g_links == nullptr) g_links = new std::map<std::string, std::string>();
+  (*g_links)[peer] = cls;
+}
+
+double backoff_unit(const std::string& key, uint64_t attempt) {
+  uint64_t seed = 0;
+  if (g_armed.load(std::memory_order_acquire)) seed = g_state->seed;
+  uint64_t h =
+      splitmix64(seed ^ fnv1a64(key) ^ (attempt * 0x9E3779B97F4A7C15ull));
+  // Top 53 bits as a unit float, same as chaos.py _hash_unit.
+  return static_cast<double>(h >> 11) / 9007199254740992.0;
 }
 
 bool server_rpc(const std::string& rpc_type) {
@@ -498,6 +609,11 @@ int32_t tft_chaos_armed() { return tft::chaos::armed() ? 1 : 0; }
 
 void tft_chaos_set_step(int64_t step) { tft::chaos::set_step(step); }
 
+void tft_chaos_set_link(const char* peer, const char* cls) {
+  if (peer == nullptr || cls == nullptr) return;
+  tft::chaos::set_link_class(peer, cls);
+}
+
 int64_t tft_chaos_seq() {
   using namespace tft::chaos;
   if (!armed()) return 0;
@@ -530,6 +646,8 @@ int64_t tft_chaos_snapshot(int64_t since_seq, char* buf, int64_t cap) {
       je["step"] = Json::of(ev.step);
       je["ms"] = Json::of(ev.ms);
       je["frac"] = Json::of(ev.frac);
+      je["rate"] = Json::of(ev.rate);
+      je["bucket"] = Json::of(ev.bucket);
       je["ts_ns"] = Json::of(static_cast<int64_t>(ev.ts_ns));
       events.push(std::move(je));
     }

@@ -36,6 +36,7 @@ enum Kind : int32_t {
   kRpcDrop = 5,
   kAbortHeal = 6,
   kCkptTruncate = 7,
+  kThrottle = 8,
 };
 
 // Parses `spec` (TORCHFT_CHAOS grammar) and arms the global schedule.
@@ -60,6 +61,8 @@ struct Decision {
   int32_t kind = -1;  // -1: nothing fired
   int64_t ms = 0;
   double frac = 0.0;
+  int64_t rate = 0;    // throttle: sustained bytes/second
+  int64_t bucket = 0;  // throttle: burst bytes
 };
 
 // One eligible visit at `site` for `kind` under the current thread context.
@@ -82,12 +85,14 @@ class ScopedCtx {
   bool prev_maybe_;
 };
 
-// Hook for net.cc write_all: stall sleeps in place; returns a Decision
-// whose kind is kReset or kPartialWrite when the write should be torn.
+// Hook for net.cc write_all: throttle paces (sleeps, token bucket), stall
+// sleeps in place; returns a Decision whose kind is kReset or kPartialWrite
+// when the write should be torn.
 Decision on_write(int fd, size_t len);
 
-// Hook for net.cc read_all/read_exact: stall sleeps; kReset tears.
-Decision on_read(int fd);
+// Hook for net.cc read_all/read_exact: throttle paces, stall sleeps;
+// kReset tears. `len` is the expected read size (throttle accounting).
+Decision on_read(int fd, size_t len);
 
 // Hook for net.cc tcp_connect: true == refuse (caller returns -1).
 bool on_connect(const std::string& host, int port);
@@ -96,6 +101,16 @@ bool on_connect(const std::string& host, int port);
 // rpc_delay (sleeps) and rpc_drop/reset (returns false: drop the
 // connection without replying — the client sees a torn RPC).
 bool server_rpc(const std::string& rpc_type);
+
+// Tags `peer` with a link class so `link=<class>` rules apply to it
+// (mirrors chaos.py set_link_class; fed from TORCHFT_LINKS by the process
+// group via tft_chaos_set_link).
+void set_link_class(const std::string& peer, const std::string& cls);
+
+// Seeded full-jitter unit in [0, 1) for backoff delays, deterministic in
+// (chaos seed, key, attempt); seed 0 when no schedule is armed. Mirrors
+// chaos.py backoff_jitter (which multiplies by the caller's cap).
+double backoff_unit(const std::string& key, uint64_t attempt);
 
 // Decision hash primitives (exposed for cpp_tests parity checks against
 // the Python implementation).
@@ -116,6 +131,8 @@ int32_t tft_chaos_init(const char* spec);
 int32_t tft_chaos_armed();
 // Mirrors chaos.py set_step for step-windowed rules on this plane.
 void tft_chaos_set_step(int64_t step);
+// Mirrors chaos.py set_link_class for link=<class> rule scoping.
+void tft_chaos_set_link(const char* peer, const char* cls);
 // Monotonic count of injections fired so far.
 int64_t tft_chaos_seq();
 // JSON {"seq": N, "events": [{seq, kind, plane, site, rule, visit, step,

@@ -198,16 +198,52 @@ struct CollectiveEngine::Waiter {
 
 CollectiveEngine::CollectiveEngine(int n_streams, int64_t pipeline_bytes,
                                    int fr_capacity)
-    : n_streams_(std::max(1, n_streams)),
+    // 32 stripes bounds the per-peer alive bitmask (failover bookkeeping).
+    : n_streams_(std::min(32, std::max(1, n_streams))),
       pipeline_bytes_(std::max<int64_t>(64 * 1024, pipeline_bytes)),
       fr_cap_(std::max(0, fr_capacity)) {
   if (fr_cap_ > 0) fr_ring_ = std::make_unique<FlightRec[]>(fr_cap_);
 }
 
 CollectiveEngine::~CollectiveEngine() {
+  stopping_.store(true);
   abort("engine destroyed");
+  if (janitor_.joinable()) janitor_.join();
+  if (acceptor_.joinable()) acceptor_.join();
   pool_.reset();  // joins workers; queued jobs fail fast on shut-down fds
   close_all();
+}
+
+void CollectiveEngine::set_link_policy(int peer, const LinkPolicy& pol) {
+  // Frozen once connect_mesh ran: the janitor and leg jobs read policies
+  // without a lock.
+  if (world_ != 0) return;
+  LinkPolicy p = pol;
+  if (p.n_streams > 32) p.n_streams = 32;
+  if (p.connect_ms <= 0) p.connect_ms = 5000;
+  if (peer < 0)
+    default_policy_ = p;
+  else
+    link_policies_[peer] = p;
+}
+
+LinkPolicy CollectiveEngine::link_policy(int peer) const {
+  auto it = link_policies_.find(peer);
+  return it != link_policies_.end() ? it->second : default_policy_;
+}
+
+int CollectiveEngine::stripes_for(int peer) const {
+  const int n = link_policy(peer).n_streams;
+  return n > 0 ? std::min(n, 32) : n_streams_;
+}
+
+int CollectiveEngine::first_alive(int peer) const {
+  // Header frames must ride a stripe both ends agree on, so this consults
+  // the per-op frozen mask (see begin_op), like launch_group's partition.
+  if (peer < 0 || peer >= static_cast<int>(op_mask_.size())) return 0;
+  const uint32_t mask = op_mask_[peer];
+  if (mask == 0) return -1;
+  return __builtin_ctz(mask);
 }
 
 void CollectiveEngine::set_error(const std::string& msg) {
@@ -245,7 +281,17 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
   world_ = world;
   results_.assign(world, {});
   peer_fds_.assign(world, {});
+  peer_addrs_ = peers;
   peer_counters_ = std::make_unique<PeerCounters[]>(world);
+  alive_mask_ = std::make_unique<std::atomic<uint32_t>[]>(world);
+  op_mask_.assign(world, 0);
+  stripe_gibs_.assign(world, {});
+  for (int p = 0; p < world; ++p) {
+    const int ns = p == rank ? 0 : stripes_for(p);
+    alive_mask_[p].store(ns >= 32 ? ~0u : ((1u << ns) - 1));
+    op_mask_[p] = alive_mask_[p].load();
+    stripe_gibs_[p].assign(ns, 0.0);
+  }
   if (world <= 1) {
     pool_ = std::make_unique<TaskPool>(1);
     return true;
@@ -254,20 +300,24 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
     return fail("connect_mesh: need one address per rank");
   const int64_t deadline = now_ms() + timeout_ms;
   // Deterministic full mesh (same shape as ProcessGroupSocket.configure):
-  // connect n_streams sockets to every lower rank, accept from higher ranks.
+  // connect the link's stripe count to every lower rank, accept from higher
+  // ranks. Per-peer counts come from the link policy; both ends must be
+  // configured symmetrically (the acceptor validates against ITS policy).
   for (int p = 0; p < rank; ++p) {
     std::string host;
     int port = 0;
     if (!split_host_port(peers[p], &host, &port))
       return fail("connect_mesh: bad peer address " + peers[p]);
-    peer_fds_[p].assign(n_streams_, -1);
-    for (int s = 0; s < n_streams_; ++s) {
+    const LinkPolicy pol = link_policy(p);
+    const int ns = stripes_for(p);
+    peer_fds_[p].assign(ns, -1);
+    for (int s = 0; s < ns; ++s) {
       const int64_t remaining = deadline - now_ms();
       if (remaining <= 0 || aborted_.load())
         return fail("timeout: data plane connect to rank " +
                     std::to_string(p));
       chaos::ScopedCtx cctx("data", std::to_string(p), "configure");
-      int fd = tcp_connect_retry(host, port, remaining);
+      int fd = tcp_connect_retry(host, port, remaining, pol.connect_ms);
       if (fd < 0)
         return fail("timeout: data plane connect to rank " +
                     std::to_string(p));
@@ -283,7 +333,8 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
       peer_fds_[p][s] = fd;
     }
   }
-  const int expected = (world - 1 - rank) * n_streams_;
+  int expected = 0;
+  for (int p = rank + 1; p < world; ++p) expected += stripes_for(p);
   for (int i = 0; i < expected; ++i) {
     const int64_t remaining = deadline - now_ms();
     if (remaining <= 0 || aborted_.load())
@@ -301,21 +352,35 @@ bool CollectiveEngine::connect_mesh(int rank, int world,
       close(fd);
       return fail("connect_mesh: bad hello frame");
     }
+    // A janitor of an already-meshed higher rank can dial while we are
+    // still collecting mesh sockets; don't let its rejoin hello consume a
+    // mesh slot (the dial self-heals: no reply arrives, it retries later).
+    if (hello.get("rejoin").as_int(0) != 0) {
+      close(fd);
+      --i;
+      continue;
+    }
     const int p = static_cast<int>(hello.get("rank").as_int(-1));
     const int s = static_cast<int>(hello.get("stripe").as_int(-1));
-    if (p <= rank || p >= world || s < 0 || s >= n_streams_) {
+    if (p <= rank || p >= world || s < 0 || s >= stripes_for(p)) {
       close(fd);
       return fail("connect_mesh: hello from unexpected rank/stripe");
     }
-    if (peer_fds_[p].empty()) peer_fds_[p].assign(n_streams_, -1);
+    if (peer_fds_[p].empty()) peer_fds_[p].assign(stripes_for(p), -1);
     peer_fds_[p][s] = fd;
   }
   // Worst concurrent job count: the compressed alltoall runs two striped
   // sends + two striped recvs per peer at once. Undersizing the pool could
   // fill every worker with blocked senders and deadlock the mesh.
-  const int n_threads =
-      std::min(64, std::max(2, 4 * n_streams_ * (world - 1)));
+  int total_stripes = 0;
+  for (int p = 0; p < world; ++p)
+    if (p != rank) total_stripes += stripes_for(p);
+  const int n_threads = std::min(64, std::max(2, 4 * total_stripes));
   pool_ = std::make_unique<TaskPool>(n_threads);
+  // Stripe-rejoin plumbing: the connector side redials dead stripes, the
+  // acceptor side absorbs those dials after the mesh is up.
+  janitor_ = std::thread([this] { janitor_loop(); });
+  acceptor_ = std::thread([this] { acceptor_loop(); });
   return true;
 }
 
@@ -326,9 +391,14 @@ void CollectiveEngine::abort(const std::string& why) {
   // and any caller mid-collective fail immediately; fds stay valid until
   // the destructor so no job can race a close/reuse.
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  // reconn_mu_ also orders this against begin_op's fd installs so the scan
+  // below never reads a peer_fds_ slot mid-write.
+  std::lock_guard<std::mutex> lk(reconn_mu_);
   for (auto& fds : peer_fds_)
     for (int fd : fds)
       if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  for (const Staged& st : staged_) ::shutdown(st.fd, SHUT_RDWR);
+  for (int fd : retired_fds_) ::shutdown(fd, SHUT_RDWR);
 }
 
 void CollectiveEngine::close_all() {
@@ -338,12 +408,193 @@ void CollectiveEngine::close_all() {
     for (int fd : fds)
       if (fd >= 0) close(fd);
   peer_fds_.clear();
+  for (const Staged& st : staged_) close(st.fd);
+  staged_.clear();
+  for (int fd : retired_fds_) close(fd);
+  retired_fds_.clear();
 }
 
-void CollectiveEngine::stripe_range(uint64_t units, int s, uint64_t* off,
-                                    uint64_t* len) const {
-  *off = split_off(units, n_streams_, s);
-  *len = split_size(units, n_streams_, s);
+// ---------------------------------------------------------------------------
+// Stripe rejoin: janitor (connector side), acceptor, and activation
+// ---------------------------------------------------------------------------
+
+void CollectiveEngine::begin_op() {
+  std::lock_guard<std::mutex> lk(reconn_mu_);
+  ++op_seq_;
+  for (auto it = staged_.begin(); it != staged_.end();) {
+    if (it->activate_at > op_seq_) {
+      ++it;
+      continue;
+    }
+    // Both ends negotiated the same activation number, so (barring a dial
+    // racing >8 collectives ahead — then the masks diverge and the next
+    // transfer fails back into the abort/heal path) they swap the fd in
+    // before the same collective and the stripe partitions agree again.
+    const int old = peer_fds_[it->peer][it->stripe];
+    if (old >= 0) {
+      ::shutdown(old, SHUT_RDWR);
+      retired_fds_.push_back(old);
+    }
+    peer_fds_[it->peer][it->stripe] = it->fd;
+    alive_mask_[it->peer].fetch_or(1u << it->stripe);
+    record_failover(it->peer, it->stripe, -1, /*dir=*/3, 0, "rejoin");
+    it = staged_.erase(it);
+  }
+  // Freeze the partition mask for this collective. Groups launched during
+  // the op must NOT re-read alive_mask_: a leg death observed by one
+  // direction's epilogue mid-collective would repartition the other
+  // direction's (or the next step's) launch on this end only, while the
+  // peer — which observes the death on its own schedule — still partitions
+  // over the old stripe set, desynchronizing the byte ranges. With a frozen
+  // mask both ends keep launching legs on the dead stripe for the rest of
+  // the op; those fail instantly (the fd is shut down) and the handoff
+  // protocol re-routes them — identically on both ends.
+  for (int p = 0; p < world_; ++p)
+    op_mask_[p] = alive_mask_[p].load(std::memory_order_acquire);
+}
+
+bool CollectiveEngine::try_rejoin(int peer, int stripe) {
+  if (peer < 0 || peer >= static_cast<int>(peer_addrs_.size())) return false;
+  std::string host;
+  int port = 0;
+  if (!split_host_port(peer_addrs_[peer], &host, &port)) return false;
+  const LinkPolicy pol = link_policy(peer);
+  chaos::ScopedCtx cctx("data", std::to_string(peer), "rejoin");
+  int fd = tcp_connect(host, port, std::max<int64_t>(1, pol.connect_ms));
+  if (fd < 0) return false;
+  set_data_plane_opts(fd);
+  uint64_t my_seq;
+  {
+    std::lock_guard<std::mutex> lk(reconn_mu_);
+    my_seq = op_seq_;
+  }
+  Json hello = Json::object();
+  hello["rank"] = Json::of(static_cast<int64_t>(rank_));
+  hello["stripe"] = Json::of(static_cast<int64_t>(stripe));
+  hello["rejoin"] = Json::of(static_cast<int64_t>(1));
+  hello["op_seq"] = Json::of(static_cast<int64_t>(my_seq));
+  std::string raw;
+  Json reply;
+  if (!send_frame(fd, hello.dump(), 2000) || !recv_frame(fd, &raw, 5000) ||
+      !Json::parse(raw, &reply)) {
+    close(fd);  // never shared: safe to close directly
+    return false;
+  }
+  const int64_t act = reply.get("op_seq").as_int(-1);
+  if (act < 0) {
+    close(fd);
+    return false;
+  }
+  std::lock_guard<std::mutex> lk(reconn_mu_);
+  staged_.push_back({peer, stripe, fd, static_cast<uint64_t>(act)});
+  return true;
+}
+
+void CollectiveEngine::janitor_loop() {
+  uint64_t attempt = 0;
+  const std::string key = "stripe_rejoin:" + std::to_string(rank_);
+  while (!stopping_.load() && !aborted_.load()) {
+    // Seeded full-jitter backoff (~50ms..2s): deterministic under a chaos
+    // seed, desynchronized across ranks by the key.
+    const int64_t cap =
+        std::min<int64_t>(2000, 200 << std::min<uint64_t>(attempt, 4));
+    int64_t pause =
+        50 + static_cast<int64_t>(chaos::backoff_unit(key, attempt) *
+                                  static_cast<double>(cap));
+    while (pause > 0 && !stopping_.load() && !aborted_.load()) {
+      const int64_t step = std::min<int64_t>(50, pause);
+      sleep_ms(step);
+      pause -= step;
+    }
+    bool any_dead = false;
+    for (int p = 0; p < rank_ && !stopping_.load() && !aborted_.load(); ++p) {
+      const int ns = stripes_for(p);
+      const uint32_t full = ns >= 32 ? ~0u : ((1u << ns) - 1);
+      uint32_t dead = full & ~alive_mask_[p].load(std::memory_order_acquire);
+      {
+        std::lock_guard<std::mutex> lk(reconn_mu_);
+        for (const Staged& st : staged_)
+          if (st.peer == p) dead &= ~(1u << st.stripe);
+      }
+      while (dead != 0 && !stopping_.load() && !aborted_.load()) {
+        const int s = __builtin_ctz(dead);
+        dead &= ~(1u << s);
+        any_dead = true;
+        try_rejoin(p, s);
+      }
+    }
+    attempt = any_dead ? attempt + 1 : 0;
+  }
+}
+
+void CollectiveEngine::acceptor_loop() {
+  while (!stopping_.load() && !aborted_.load()) {
+    int fd = tcp_accept(listen_fd_, 250);
+    if (fd < 0) continue;
+    set_data_plane_opts(fd);
+    std::string raw;
+    Json hello;
+    if (!recv_frame(fd, &raw, 2000) || !Json::parse(raw, &hello)) {
+      close(fd);
+      continue;
+    }
+    const int p = static_cast<int>(hello.get("rank").as_int(-1));
+    const int s = static_cast<int>(hello.get("stripe").as_int(-1));
+    if (hello.get("rejoin").as_int(0) != 1 || p <= rank_ || p >= world_ ||
+        s < 0 || s >= stripes_for(p) ||
+        (alive_mask_[p].load(std::memory_order_acquire) & (1u << s)) != 0) {
+      close(fd);
+      continue;
+    }
+    bool staged_ok = false;
+    uint64_t act = 0;
+    {
+      std::lock_guard<std::mutex> lk(reconn_mu_);
+      bool dup = false;
+      for (const Staged& st : staged_)
+        if (st.peer == p && st.stripe == s) {
+          dup = true;
+          break;
+        }
+      if (!dup) {
+        const uint64_t theirs = static_cast<uint64_t>(
+            std::max<int64_t>(0, hello.get("op_seq").as_int(0)));
+        // +8 gives the reply a few collectives of headroom to cross the
+        // wire before either end reaches the activation number.
+        act = std::max(theirs, op_seq_) + 8;
+        staged_.push_back({p, s, fd, act});
+        staged_ok = true;
+      }
+    }
+    if (!staged_ok) {
+      close(fd);
+      continue;
+    }
+    Json reply = Json::object();
+    reply["op_seq"] = Json::of(static_cast<int64_t>(act));
+    // A lost reply self-heals: the stripe activates here, comes up dead on
+    // the next transfer, and fails over again.
+    send_frame(fd, reply.dump(), 2000);
+  }
+}
+
+void CollectiveEngine::record_failover(int peer, int stripe, int to_stripe,
+                                       int dir, uint64_t moved_bytes,
+                                       const char* tag) {
+  std::lock_guard<std::mutex> lk(fo_mu_);
+  FailoverEvent ev{};
+  ev.seq = ++fo_seq_;
+  ev.peer = static_cast<int16_t>(peer);
+  ev.stripe = static_cast<int8_t>(stripe);
+  ev.to_stripe = static_cast<int8_t>(to_stripe);
+  ev.dir = static_cast<int8_t>(dir);
+  ev.bytes = moved_bytes;
+  ev.t_ns = now_realtime_ns();
+  const size_t n = std::min(strlen(tag), sizeof(ev.tag) - 1);
+  memcpy(ev.tag, tag, n);
+  ev.tag[n] = '\0';
+  failovers_.push_back(ev);
+  if (failovers_.size() > 256) failovers_.pop_front();
 }
 
 // ---------------------------------------------------------------------------
@@ -432,6 +683,19 @@ void CollectiveEngine::fr_job(FlightRec* rec, int peer, int stripe, int dir,
       pc.rx_busy_ns.fetch_add(t1 - t0_ns, std::memory_order_relaxed);
     }
     pc.spins.fetch_add(spins, std::memory_order_relaxed);
+  }
+  // Per-stripe throughput EWMA (fr_snapshot "stripes"): slow-decaying so a
+  // WAN drill can read steady-state per-link-class GiB/s off one snapshot.
+  if (bytes > 0 && t1 > t0_ns && peer >= 0 &&
+      peer < static_cast<int>(stripe_gibs_.size())) {
+    const double gibs = static_cast<double>(bytes) /
+                        (static_cast<double>(t1 - t0_ns) / 1e9) /
+                        static_cast<double>(1ull << 30);
+    std::lock_guard<std::mutex> lk(health_mu_);
+    if (stripe >= 0 && stripe < static_cast<int>(stripe_gibs_[peer].size())) {
+      double& e = stripe_gibs_[peer][stripe];
+      e = e == 0.0 ? gibs : 0.8 * e + 0.2 * gibs;
+    }
   }
   if (rec == nullptr) return;
   const uint32_t li = rec->lane_n.fetch_add(1, std::memory_order_relaxed);
@@ -523,10 +787,48 @@ std::string CollectiveEngine::fr_snapshot(uint64_t since_seq) const {
       jp["tx_busy_ns"] = fr_u64(pc.tx_busy_ns.load(std::memory_order_relaxed));
       jp["rx_busy_ns"] = fr_u64(pc.rx_busy_ns.load(std::memory_order_relaxed));
       jp["spins"] = fr_u64(pc.spins.load(std::memory_order_relaxed));
+      jp["link"] = Json::of(link_policy(p).cls);
+      if (alive_mask_) {
+        const uint32_t mask =
+            alive_mask_[p].load(std::memory_order_relaxed);
+        jp["alive_mask"] = Json::of(static_cast<int64_t>(mask));
+        Json stripes = Json::array();
+        const int ns = p < static_cast<int>(stripe_gibs_.size())
+                           ? static_cast<int>(stripe_gibs_[p].size())
+                           : 0;
+        std::lock_guard<std::mutex> hl(health_mu_);
+        for (int s = 0; s < ns; ++s) {
+          Json js = Json::object();
+          js["stripe"] = Json::of(s);
+          js["alive"] = Json::of(static_cast<int64_t>((mask >> s) & 1));
+          js["gibs"] = Json::of(stripe_gibs_[p][s]);
+          stripes.push(std::move(js));
+        }
+        jp["stripes"] = std::move(stripes);
+      }
       peers.push(std::move(jp));
     }
   }
   root["peers"] = std::move(peers);
+  // Failover ring: every in-collective stripe handoff plus janitor rejoins.
+  // Python drains by the monotonic per-event seq (journal stripe_failover).
+  Json fos = Json::array();
+  {
+    std::lock_guard<std::mutex> fo_lk(fo_mu_);
+    for (const auto& ev : failovers_) {
+      Json je = Json::object();
+      je["seq"] = Json::of(ev.seq);
+      je["peer"] = Json::of(static_cast<int>(ev.peer));
+      je["stripe"] = Json::of(static_cast<int>(ev.stripe));
+      je["to_stripe"] = Json::of(static_cast<int>(ev.to_stripe));
+      je["dir"] = Json::of(ev.dir == 3 ? "rejoin" : fr_dir_name(ev.dir));
+      je["bytes"] = fr_u64(ev.bytes);
+      je["t_ns"] = fr_u64(ev.t_ns);
+      je["tag"] = Json::of(fr_sanitize(ev.tag, sizeof(ev.tag)));
+      fos.push(std::move(je));
+    }
+  }
+  root["failovers"] = std::move(fos);
   Json recs = Json::array();
   std::lock_guard<std::mutex> fr_lk(fr_mu_);
   if (fr_cap_ > 0 && hi > 0) {
@@ -578,103 +880,358 @@ std::string CollectiveEngine::fr_snapshot(uint64_t since_seq) const {
   return root.dump();
 }
 
+// ---------------------------------------------------------------------------
+// Leg groups: striped transfer with in-collective failover
+// ---------------------------------------------------------------------------
+
+// All stripes of one (peer, direction) transfer. The group resolves its
+// Waiter slot exactly once, from whichever pool thread finishes last; that
+// thread also runs the failover epilogue inline (its group-mates are done,
+// so the survivor sockets are quiescent and handoff bytes follow the
+// normal stripe bytes in order).
+struct CollectiveEngine::LegGroup {
+  int peer = -1;
+  int dir = 0;  // 0 send, 1 recv, 2 recv-reduce
+  uint64_t esize = 1;
+  int64_t deadline_ms = 0;
+  Waiter* w = nullptr;
+  FlightRec* rec = nullptr;
+  // Transfer base. Send legs only read through it (the const_cast at
+  // construction is confined to this struct).
+  char* base = nullptr;
+  // recv-reduce only.
+  int32_t dtype = -1;
+  int32_t op = -1;
+  uint64_t block_elems = 0;
+  uint32_t mask0 = 0;  // alive-mask snapshot the partition was built on
+  std::mutex mu;
+  int remaining = 0;
+  struct Leg {
+    int stripe = -1;
+    int fd = -1;
+    uint64_t uoff = 0;
+    uint64_t ulen = 0;
+    uint64_t done_units = 0;  // recv-reduce: units already folded into dst
+    bool ok = false;
+  };
+  std::vector<Leg> legs;  // ascending stripe order (failover determinism)
+};
+
+namespace {
+
+// Handoff frame: {magic u32, original stripe u32, ulen u64}. Lets the
+// receiving end detect asymmetric failure detection (the ends disagreeing
+// about which stripe died) instead of misparsing payload bytes.
+constexpr uint32_t kHandoffMagic = 0x46414F56;  // "VOAF"
+
+// Pipelined receive-reduce over one contiguous element span: consume the
+// wire in sub-blocks and fold each into dst while the peer (and the kernel
+// socket buffer) keeps the next sub-block in flight. `skip_elems` consumes
+// but does not reduce the leading elements (handoff resends a failed
+// stripe's FULL range; the live end must not re-reduce what it already
+// folded). `done_out` reports consumed-and-folded progress even on failure
+// so a later handoff knows where to resume reducing.
+template <typename T>
+bool recv_reduce_span(int fd, T* dst, uint64_t elems, int32_t op,
+                      uint64_t block_elems, int64_t deadline_ms,
+                      std::atomic<uint64_t>* bytes_rx, uint64_t skip_elems,
+                      uint64_t* done_out, uint64_t* reduce_ns_out) {
+  std::vector<T> scratch(std::min(elems, block_elems));
+  uint64_t done = 0;
+  uint64_t reduce_ns = 0;
+  bool ok = true;
+  while (done < elems) {
+    const uint64_t m = std::min(block_elems, elems - done);
+    const int64_t remaining = deadline_ms - now_ms();
+    if (remaining <= 0 ||
+        !read_exact(fd, reinterpret_cast<char*>(scratch.data()),
+                    m * sizeof(T), remaining)) {
+      ok = false;
+      break;
+    }
+    *bytes_rx += m * sizeof(T);
+    const uint64_t lo = std::max(done, skip_elems);
+    if (lo < done + m) {
+      // Per-chunk wire-vs-reduce split for the flight recorder: the lane's
+      // total minus reduce_ns is time blocked on the wire.
+      const uint64_t r0 = now_realtime_ns();
+      reduce_into<T>(dst + lo, scratch.data() + (lo - done), done + m - lo,
+                     op);
+      reduce_ns += now_realtime_ns() - r0;
+    }
+    done += m;
+  }
+  if (done_out != nullptr) *done_out = done;
+  if (reduce_ns_out != nullptr) *reduce_ns_out = reduce_ns;
+  return ok;
+}
+
+bool recv_reduce_dispatch(int32_t dtype, int fd, char* base, uint64_t uoff,
+                          uint64_t ulen, int32_t op, uint64_t block_elems,
+                          int64_t deadline_ms,
+                          std::atomic<uint64_t>* bytes_rx, uint64_t skip,
+                          uint64_t* done_out, uint64_t* reduce_ns_out) {
+  switch (dtype) {
+    case TFT_DT_F32:
+      return recv_reduce_span<float>(fd, reinterpret_cast<float*>(base) + uoff,
+                                     ulen, op, block_elems, deadline_ms,
+                                     bytes_rx, skip, done_out, reduce_ns_out);
+    case TFT_DT_F64:
+      return recv_reduce_span<double>(
+          fd, reinterpret_cast<double*>(base) + uoff, ulen, op, block_elems,
+          deadline_ms, bytes_rx, skip, done_out, reduce_ns_out);
+    case TFT_DT_I32:
+      return recv_reduce_span<int32_t>(
+          fd, reinterpret_cast<int32_t*>(base) + uoff, ulen, op, block_elems,
+          deadline_ms, bytes_rx, skip, done_out, reduce_ns_out);
+    case TFT_DT_I64:
+      return recv_reduce_span<int64_t>(
+          fd, reinterpret_cast<int64_t*>(base) + uoff, ulen, op, block_elems,
+          deadline_ms, bytes_rx, skip, done_out, reduce_ns_out);
+  }
+  return false;
+}
+
+}  // namespace
+
+void CollectiveEngine::launch_group(std::shared_ptr<LegGroup> g,
+                                    uint64_t units) {
+  const int peer = g->peer;
+  const int ns = stripes_for(peer);
+  // Partition over the mask FROZEN at begin_op, not the live alive_mask_ —
+  // see begin_op for why (mid-op repartitioning desyncs the two ends).
+  const uint32_t mask = peer < static_cast<int>(op_mask_.size())
+                            ? op_mask_[peer]
+                            : (ns >= 32 ? ~0u : ((1u << ns) - 1));
+  if (mask == 0) {
+    g->w->add(1);
+    g->w->done(false, false, "all stripes to peer dead");
+    return;
+  }
+  g->mask0 = mask;
+  // Partition over the LIVE stripes only (np.array_split semantics over the
+  // survivor count). Both ends hold the same mask after a symmetric
+  // failure, so their partitions agree without a control round-trip.
+  std::vector<int> alive;
+  alive.reserve(ns);
+  for (int s = 0; s < ns; ++s)
+    if (mask & (1u << s)) alive.push_back(s);
+  const int parts = static_cast<int>(alive.size());
+  for (int i = 0; i < parts; ++i) {
+    const uint64_t ulen = split_size(units, parts, i);
+    if (ulen == 0) continue;
+    LegGroup::Leg leg;
+    leg.stripe = alive[i];
+    leg.fd = peer_fds_[peer][alive[i]];
+    leg.uoff = split_off(units, parts, i);
+    leg.ulen = ulen;
+    g->legs.push_back(leg);
+  }
+  if (g->legs.empty()) return;
+  g->remaining = static_cast<int>(g->legs.size());
+  g->w->add(1);
+  for (size_t i = 0; i < g->legs.size(); ++i)
+    pool_->submit([this, g, i] { run_leg(g, i); });
+}
+
+void CollectiveEngine::run_leg(std::shared_ptr<LegGroup> g, size_t li) {
+  LegGroup::Leg& leg = g->legs[li];
+  const uint64_t t0 = now_realtime_ns();
+  const uint64_t sp0 = net_spin_count();
+  // Chaos scope: stall/partial_write/reset/throttle rules fire inside
+  // write_all/read_exact, attributed to (peer rank, collective tag). The
+  // "|s<stripe>" suffix lets a rule pin one stripe (match=|s2).
+  chaos::ScopedCtx cctx(
+      "data", std::to_string(g->peer),
+      (g->rec != nullptr ? std::string(g->rec->tag) : std::string()) + "|s" +
+          std::to_string(leg.stripe));
+  // An io_ms budget fails a stalled stripe early enough for the group to
+  // hand its range over; without one a stall rides to the collective
+  // deadline and can only abort.
+  const LinkPolicy pol = link_policy(g->peer);
+  int64_t leg_deadline = g->deadline_ms;
+  if (pol.io_ms > 0)
+    leg_deadline = std::min(leg_deadline, now_ms() + pol.io_ms);
+  const uint64_t len = leg.ulen * g->esize;
+  uint64_t reduce_ns = 0;
+  uint64_t done_units = 0;
+  bool ok = false;
+  const int64_t remaining = leg_deadline - now_ms();
+  if (remaining > 0 && !aborted_.load()) {
+    switch (g->dir) {
+      case 0:
+        ok = write_all(leg.fd, g->base + leg.uoff * g->esize, len, remaining);
+        if (ok) bytes_tx_ += len;
+        break;
+      case 1:
+        ok = read_exact(leg.fd, g->base + leg.uoff * g->esize, len,
+                        remaining);
+        if (ok) bytes_rx_ += len;
+        break;
+      default:
+        ok = recv_reduce_dispatch(g->dtype, leg.fd, g->base, leg.uoff,
+                                  leg.ulen, g->op, g->block_elems,
+                                  leg_deadline, &bytes_rx_, /*skip=*/0,
+                                  &done_units, &reduce_ns);
+        break;
+    }
+  }
+  fr_job(g->rec, g->peer, leg.stripe, g->dir, ok ? len : 0, t0, sp0,
+         reduce_ns);
+  bool last;
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    leg.ok = ok;
+    leg.done_units = done_units;
+    last = --g->remaining == 0;
+  }
+  if (last) leg_epilogue(std::move(g));
+}
+
+bool CollectiveEngine::handoff_leg(LegGroup& g, size_t li, int to) {
+  LegGroup::Leg& leg = g.legs[li];
+  const int fd = peer_fds_[g.peer][to];
+  int64_t remaining = g.deadline_ms - now_ms();
+  if (fd < 0 || remaining <= 0) return false;
+  chaos::ScopedCtx cctx(
+      "data", std::to_string(g.peer),
+      (g.rec != nullptr ? std::string(g.rec->tag) : std::string()) +
+          "|handoff");
+  const uint64_t t0 = now_realtime_ns();
+  const uint64_t sp0 = net_spin_count();
+  const uint64_t len = leg.ulen * g.esize;
+  char hdr[16];
+  const uint32_t magic = kHandoffMagic;
+  uint64_t reduce_ns = 0;
+  bool ok = false;
+  if (g.dir == 0) {
+    const uint32_t s32 = static_cast<uint32_t>(leg.stripe);
+    memcpy(hdr, &magic, 4);
+    memcpy(hdr + 4, &s32, 4);
+    memcpy(hdr + 8, &leg.ulen, 8);
+    ok = write_all(fd, hdr, 16, remaining);
+    remaining = g.deadline_ms - now_ms();
+    ok = ok && remaining > 0 &&
+         write_all(fd, g.base + leg.uoff * g.esize, len, remaining);
+    if (ok) bytes_tx_ += len;
+  } else {
+    ok = read_exact(fd, hdr, 16, remaining);
+    if (ok) {
+      uint32_t m2 = 0, s2 = 0;
+      uint64_t ul = 0;
+      memcpy(&m2, hdr, 4);
+      memcpy(&s2, hdr + 4, 4);
+      memcpy(&ul, hdr + 8, 8);
+      ok = m2 == magic && s2 == static_cast<uint32_t>(leg.stripe) &&
+           ul == leg.ulen;
+    }
+    remaining = g.deadline_ms - now_ms();
+    ok = ok && remaining > 0;
+    if (ok) {
+      if (g.dir == 1) {
+        ok = read_exact(fd, g.base + leg.uoff * g.esize, len, remaining);
+        if (ok) bytes_rx_ += len;
+      } else {
+        uint64_t done2 = 0;
+        ok = recv_reduce_dispatch(g.dtype, fd, g.base, leg.uoff, leg.ulen,
+                                  g.op, g.block_elems, g.deadline_ms,
+                                  &bytes_rx_, /*skip=*/leg.done_units, &done2,
+                                  &reduce_ns);
+      }
+    }
+  }
+  // The handoff shows up as a lane on the carrier stripe, so obs_trace
+  // recovery lanes render it next to the leg it replaced.
+  fr_job(g.rec, g.peer, to, g.dir, ok ? len : 0, t0, sp0, reduce_ns);
+  return ok;
+}
+
+void CollectiveEngine::leg_epilogue(std::shared_ptr<LegGroup> g) {
+  // No lock needed: remaining hit 0 under g->mu, publishing every leg.
+  std::vector<size_t> failed;
+  for (size_t i = 0; i < g->legs.size(); ++i)
+    if (!g->legs[i].ok) failed.push_back(i);
+  if (failed.empty()) {
+    g->w->done(true, false, "");
+    return;
+  }
+  uint32_t mask = g->mask0;
+  for (size_t i : failed) {
+    mask &= ~(1u << g->legs[i].stripe);
+    alive_mask_[g->peer].fetch_and(~(1u << g->legs[i].stripe));
+  }
+  auto give_up = [&](const char* what) {
+    g->w->done(false, now_ms() >= g->deadline_ms && !aborted_.load(), what);
+  };
+  if (aborted_.load()) {
+    give_up("stripe transfer aborted");
+    return;
+  }
+  if (mask == 0) {
+    give_up("all stripes to peer dead");
+    return;
+  }
+  if (g->deadline_ms - now_ms() <= 0) {
+    give_up("timeout: stripe failover budget spent");
+    return;
+  }
+  // Hand each failed leg's FULL range to the lowest live stripe. Both ends
+  // walk their failed legs in ascending stripe order over the same mask, so
+  // the carrier choice needs no control round-trip; if a carrier dies too,
+  // both ends see it (symmetric detection) and cascade identically.
+  const char* tag = g->rec != nullptr ? g->rec->tag : "";
+  for (size_t i : failed) {
+    bool moved = false;
+    while (mask != 0) {
+      const int to = __builtin_ctz(mask);
+      if (handoff_leg(*g, i, to)) {
+        record_failover(g->peer, g->legs[i].stripe, to, g->dir,
+                        g->legs[i].ulen * g->esize, tag);
+        moved = true;
+        break;
+      }
+      mask &= ~(1u << to);
+      alive_mask_[g->peer].fetch_and(~(1u << to));
+    }
+    if (!moved) {
+      give_up(mask == 0 ? "all stripes to peer dead"
+                        : "stripe handoff failed");
+      return;
+    }
+  }
+  g->w->done(true, false, "");
+}
+
 void CollectiveEngine::send_stripes(int peer, const char* data,
                                     uint64_t nbytes, uint64_t esize,
                                     int64_t deadline_ms, Waiter* w,
                                     FlightRec* rec) {
   if (nbytes == 0) return;
-  const uint64_t units = nbytes / esize;
-  for (int s = 0; s < n_streams_; ++s) {
-    uint64_t uoff, ulen;
-    stripe_range(units, s, &uoff, &ulen);
-    if (ulen == 0) continue;
-    const int fd = peer_fds_[peer][s];
-    const char* p = data + uoff * esize;
-    const uint64_t len = ulen * esize;
-    w->add(1);
-    pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
-      const uint64_t t0 = now_realtime_ns();
-      const uint64_t sp0 = net_spin_count();
-      // Chaos scope: stall/partial_write/reset rules fire inside write_all,
-      // attributed to (peer rank, collective tag).
-      chaos::ScopedCtx cctx(
-          "data", std::to_string(peer),
-          rec != nullptr ? std::string(rec->tag) : std::string());
-      const int64_t remaining = deadline_ms - now_ms();
-      const bool ok = remaining > 0 && !aborted_.load() &&
-                      write_all(fd, p, len, remaining);
-      if (ok) bytes_tx_ += len;
-      fr_job(rec, peer, s, /*dir=*/0, ok ? len : 0, t0, sp0, 0);
-      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
-              "stripe send failed");
-    });
-  }
+  auto g = std::make_shared<LegGroup>();
+  g->peer = peer;
+  g->dir = 0;
+  g->esize = esize;
+  g->deadline_ms = deadline_ms;
+  g->w = w;
+  g->rec = rec;
+  g->base = const_cast<char*>(data);  // send legs never write through base
+  launch_group(std::move(g), nbytes / esize);
 }
 
 void CollectiveEngine::recv_stripes(int peer, char* data, uint64_t nbytes,
                                     uint64_t esize, int64_t deadline_ms,
                                     Waiter* w, FlightRec* rec) {
   if (nbytes == 0) return;
-  const uint64_t units = nbytes / esize;
-  for (int s = 0; s < n_streams_; ++s) {
-    uint64_t uoff, ulen;
-    stripe_range(units, s, &uoff, &ulen);
-    if (ulen == 0) continue;
-    const int fd = peer_fds_[peer][s];
-    char* p = data + uoff * esize;
-    const uint64_t len = ulen * esize;
-    w->add(1);
-    pool_->submit([this, peer, s, fd, p, len, deadline_ms, w, rec] {
-      const uint64_t t0 = now_realtime_ns();
-      const uint64_t sp0 = net_spin_count();
-      chaos::ScopedCtx cctx(
-          "data", std::to_string(peer),
-          rec != nullptr ? std::string(rec->tag) : std::string());
-      const int64_t remaining = deadline_ms - now_ms();
-      const bool ok = remaining > 0 && !aborted_.load() &&
-                      read_exact(fd, p, len, remaining);
-      if (ok) bytes_rx_ += len;
-      fr_job(rec, peer, s, /*dir=*/1, ok ? len : 0, t0, sp0, 0);
-      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
-              "stripe recv failed");
-    });
-  }
+  auto g = std::make_shared<LegGroup>();
+  g->peer = peer;
+  g->dir = 1;
+  g->esize = esize;
+  g->deadline_ms = deadline_ms;
+  g->w = w;
+  g->rec = rec;
+  g->base = data;
+  launch_group(std::move(g), nbytes / esize);
 }
-
-namespace {
-
-// Pipelined receive-reduce for one stripe: consume the wire in sub-blocks
-// and fold each into dst while the peer (and the kernel socket buffer)
-// keeps the next sub-block in flight — the "reduce chunk k while chunk k+1
-// is on the wire" half of the double buffer.
-template <typename T>
-bool recv_reduce_stripe(int fd, T* dst, uint64_t elems, int32_t op,
-                        uint64_t block_elems, int64_t deadline_ms,
-                        std::atomic<uint64_t>* bytes_rx,
-                        uint64_t* reduce_ns_out) {
-  std::vector<T> scratch(std::min(elems, block_elems));
-  uint64_t done = 0;
-  uint64_t reduce_ns = 0;
-  while (done < elems) {
-    const uint64_t m = std::min(block_elems, elems - done);
-    const int64_t remaining = deadline_ms - now_ms();
-    if (remaining <= 0) return false;
-    if (!read_exact(fd, reinterpret_cast<char*>(scratch.data()),
-                    m * sizeof(T), remaining))
-      return false;
-    *bytes_rx += m * sizeof(T);
-    // Per-chunk wire-vs-reduce split for the flight recorder: the lane's
-    // total minus reduce_ns is time blocked on the wire.
-    const uint64_t r0 = now_realtime_ns();
-    reduce_into<T>(dst + done, scratch.data(), m, op);
-    reduce_ns += now_realtime_ns() - r0;
-    done += m;
-  }
-  if (reduce_ns_out != nullptr) *reduce_ns_out = reduce_ns;
-  return true;
-}
-
-}  // namespace
 
 void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
                                            int32_t dtype, int32_t op,
@@ -682,53 +1239,19 @@ void CollectiveEngine::recv_reduce_stripes(int peer, void* dst, uint64_t count,
                                            FlightRec* rec) {
   if (count == 0) return;
   const uint64_t esize = dtype_size(dtype);
-  const uint64_t block_elems =
+  auto g = std::make_shared<LegGroup>();
+  g->peer = peer;
+  g->dir = 2;
+  g->esize = esize;
+  g->deadline_ms = deadline_ms;
+  g->w = w;
+  g->rec = rec;
+  g->base = static_cast<char*>(dst);
+  g->dtype = dtype;
+  g->op = op;
+  g->block_elems =
       std::max<uint64_t>(1, static_cast<uint64_t>(pipeline_bytes_) / esize);
-  for (int s = 0; s < n_streams_; ++s) {
-    uint64_t uoff, ulen;
-    stripe_range(count, s, &uoff, &ulen);
-    if (ulen == 0) continue;
-    const int fd = peer_fds_[peer][s];
-    w->add(1);
-    pool_->submit([this, peer, s, fd, dst, uoff, ulen, esize, dtype, op,
-                   block_elems, deadline_ms, w, rec] {
-      const uint64_t t0 = now_realtime_ns();
-      const uint64_t sp0 = net_spin_count();
-      chaos::ScopedCtx cctx(
-          "data", std::to_string(peer),
-          rec != nullptr ? std::string(rec->tag) : std::string());
-      uint64_t reduce_ns = 0;
-      bool ok = false;
-      if (!aborted_.load()) {
-        switch (dtype) {
-          case TFT_DT_F32:
-            ok = recv_reduce_stripe<float>(fd, static_cast<float*>(dst) + uoff,
-                                           ulen, op, block_elems, deadline_ms,
-                                           &bytes_rx_, &reduce_ns);
-            break;
-          case TFT_DT_F64:
-            ok = recv_reduce_stripe<double>(
-                fd, static_cast<double*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_, &reduce_ns);
-            break;
-          case TFT_DT_I32:
-            ok = recv_reduce_stripe<int32_t>(
-                fd, static_cast<int32_t*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_, &reduce_ns);
-            break;
-          case TFT_DT_I64:
-            ok = recv_reduce_stripe<int64_t>(
-                fd, static_cast<int64_t*>(dst) + uoff, ulen, op, block_elems,
-                deadline_ms, &bytes_rx_, &reduce_ns);
-            break;
-        }
-      }
-      fr_job(rec, peer, s, /*dir=*/2, ok ? ulen * esize : 0, t0, sp0,
-             reduce_ns);
-      w->done(ok, !ok && now_ms() >= deadline_ms && !aborted_.load(),
-              "stripe recv-reduce failed");
-    });
-  }
+  launch_group(std::move(g), count);
 }
 
 template <typename T>
@@ -782,6 +1305,7 @@ bool CollectiveEngine::allreduce(void* data, uint64_t count, int32_t dtype,
   if (world_ <= 1) return true;
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
+  begin_op();
   const int64_t deadline = now_ms() + timeout_ms;
   FlightRec* rec = fr_begin(0, dtype, op, count * dtype_size(dtype));
   bool ok = false;
@@ -815,6 +1339,7 @@ bool CollectiveEngine::allreduce_q8(float* data, uint64_t count,
   if (world_ <= 1) return true;
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
+  begin_op();
   FlightRec* rec = fr_begin(1, TFT_DT_F32, TFT_OP_SUM, count * sizeof(float));
   const bool ok = allreduce_q8_inner(data, count, timeout_ms, rec);
   fr_end(rec, ok);
@@ -951,6 +1476,7 @@ bool CollectiveEngine::allgather(const std::string& meta, const void* data,
   if (world_ <= 1) return true;
   if (aborted_.load()) return false;
   if (pool_ == nullptr) return fail("engine not connected");
+  begin_op();
   FlightRec* rec = fr_begin(2, -1, -1, nbytes);
   const bool ok = allgather_inner(meta, data, nbytes, timeout_ms, rec);
   fr_end(rec, ok);
@@ -961,9 +1487,11 @@ bool CollectiveEngine::allgather_inner(const std::string& meta,
                                        const void* data, uint64_t nbytes,
                                        int64_t timeout_ms, FlightRec* rec) {
   const int64_t deadline = now_ms() + timeout_ms;
-  // Phase A: fixed-size headers + meta on stripe 0 of every peer link. The
-  // barrier before phase B guarantees the header precedes stripe-0 payload
-  // bytes on the same socket, and that every receive buffer is sized.
+  // Phase A: fixed-size headers + meta on the first LIVE stripe of every
+  // peer link (both ends agree on the alive mask, so they pick the same
+  // one). The barrier before phase B guarantees the header precedes that
+  // stripe's payload bytes on the same socket, and that every receive
+  // buffer is sized.
   char hdr[12];
   const uint32_t mlen = static_cast<uint32_t>(meta.size());
   memcpy(hdr, &mlen, 4);
@@ -974,7 +1502,8 @@ bool CollectiveEngine::allgather_inner(const std::string& meta,
     Waiter w;
     for (int p = 0; p < world_; ++p) {
       if (p == rank_) continue;
-      const int fd0 = peer_fds_[p][0];
+      const int fa = first_alive(p);
+      const int fd0 = peer_fds_[p][fa < 0 ? 0 : fa];
       w.add(2);
       pool_->submit([this, fd0, &hdr_full, deadline, w_ptr = &w] {
         const int64_t remaining = deadline - now_ms();
@@ -1044,6 +1573,7 @@ bool CollectiveEngine::broadcast(const std::string& meta, const void* data,
   if (pool_ == nullptr) return fail("engine not connected");
   if (root < 0 || root >= world_)
     return fail("broadcast: bad root " + std::to_string(root));
+  begin_op();
   FlightRec* rec = fr_begin(3, -1, -1, nbytes);
   const bool ok = broadcast_inner(meta, data, nbytes, root, timeout_ms, rec);
   fr_end(rec, ok);
@@ -1068,7 +1598,8 @@ bool CollectiveEngine::broadcast_inner(const std::string& meta,
       Waiter w;
       for (int p = 0; p < world_; ++p) {
         if (p == rank_) continue;
-        const int fd0 = peer_fds_[p][0];
+        const int fa = first_alive(p);
+        const int fd0 = peer_fds_[p][fa < 0 ? 0 : fa];
         w.add(1);
         pool_->submit([this, fd0, &hdr_full, deadline, w_ptr = &w] {
           const int64_t remaining = deadline - now_ms();
@@ -1095,9 +1626,10 @@ bool CollectiveEngine::broadcast_inner(const std::string& meta,
                   std::string("broadcast payload: ") + w.err);
     return true;
   }
-  // Non-root: header from root on stripe 0 (caller thread), then striped
-  // payload into the result slot.
-  const int fd0 = peer_fds_[root][0];
+  // Non-root: header from root on its first live stripe (caller thread),
+  // then striped payload into the result slot.
+  const int fa = first_alive(root);
+  const int fd0 = peer_fds_[root][fa < 0 ? 0 : fa];
   char h[12];
   int64_t remaining = deadline - now_ms();
   if (remaining <= 0 || !read_exact(fd0, h, 12, remaining))
@@ -1176,6 +1708,18 @@ int32_t tft_coll_connect(void* h, int32_t rank, int32_t world,
 
 void tft_coll_abort(void* h, const char* why) {
   eng(h)->abort(why ? why : "abort");
+}
+
+void tft_coll_set_link(void* h, int32_t peer, const char* cls,
+                       int64_t connect_ms, int64_t io_ms, int32_t n_streams,
+                       int32_t q8) {
+  tft::LinkPolicy pol;
+  if (cls != nullptr && cls[0] != '\0') pol.cls = cls;
+  pol.connect_ms = connect_ms;
+  pol.io_ms = io_ms;
+  pol.n_streams = n_streams;
+  pol.q8 = q8 != 0;
+  eng(h)->set_link_policy(peer, pol);
 }
 
 int32_t tft_coll_allreduce(void* h, void* data, uint64_t count, int32_t dtype,

@@ -35,12 +35,42 @@
 // time per engine (the Python PG already serializes ops on one executor
 // thread); abort() may be called concurrently from any thread and shuts
 // down every socket so blocked calls fail fast instead of timing out.
+//
+// Degraded-network survival (per-peer link policy + stripe failover):
+//
+//  - Every peer link carries a LinkPolicy (class local|dcn|wan, per-attempt
+//    connect clamp, optional per-leg I/O budget, stripe count, wire
+//    preference), pushed from TORCHFT_LINKS before connect_mesh. Policies
+//    must be configured symmetrically: rank A's policy for B and B's for A
+//    agree on stripe count, or the mesh handshake fails.
+//  - A striped transfer no longer aborts the collective on one socket
+//    error: the stripes of one (peer, direction) leg group report into the
+//    group, and the last leg to finish re-assigns every failed stripe's
+//    byte range to the lowest-indexed surviving stripe (both ends compute
+//    the identical handoff from the shared alive mask + split logic, so no
+//    extra control round-trip is needed). Dead stripes are excluded from
+//    later transfers via a per-peer alive bitmask; only when ALL stripes to
+//    a peer are dead (or the deadline is already spent) does the engine
+//    fall back to the abort/poison path. Failovers are recorded in a ring
+//    exposed by fr_snapshot ("failovers") and journaled by the Python PG as
+//    stripe_failover events.
+//  - Failover relies on SYMMETRIC detection (a reset/shutdown propagates to
+//    the peer mid-leg, so both ends fail the same stripe in the same leg
+//    group). An asymmetric failure — receiver errors while the sender's
+//    bytes all fit in the kernel socket buffer — leaves the ends with
+//    different masks and falls back to deadline -> abort -> heal.
+//  - A background janitor reconnects dead stripes (seeded jittered backoff,
+//    original connect direction) and stages the new socket on both ends
+//    with an activation collective number negotiated in the rejoin
+//    handshake, so both ends swap the fd in before the same collective.
 #pragma once
 
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <queue>
@@ -118,6 +148,21 @@ struct PeerCounters {
   std::atomic<uint64_t> spins{0};
 };
 
+// Per-peer link policy, pushed from TORCHFT_LINKS (knobs.py) before
+// connect_mesh. Both ends of a link must agree on n_streams (the mesh
+// handshake validates stripe indices against the local policy). `q8` is
+// consumed by the Python wire-format selection, not the engine; it rides
+// here so one registry owns the whole policy.
+struct LinkPolicy {
+  std::string cls = "dcn";     // local | dcn | wan (chaos link:<class> scope)
+  int64_t connect_ms = 5000;   // per-attempt clamp inside tcp_connect_retry
+  int64_t io_ms = 0;           // per-leg I/O budget; 0 = collective deadline.
+                               // A stripe stalled past this fails early enough
+                               // for the leg group to hand its range over.
+  int n_streams = 0;           // stripes on this link; 0 = engine default
+  bool q8 = false;             // prefer int8 wire compression on this link
+};
+
 // Fixed-size worker pool for concurrent striped send/recv jobs. Sized so
 // every stripe to and from every peer can progress at once — a smaller pool
 // could fill up with blocked senders and deadlock the mesh.
@@ -153,6 +198,11 @@ class CollectiveEngine {
   // Shuts down every socket (listener included). Safe from any thread while
   // a collective is blocked; that collective returns an error promptly.
   void abort(const std::string& why);
+
+  // Installs the link policy for `peer` (-1 = default for unlisted peers).
+  // Must be called before connect_mesh; ignored afterwards (the janitor
+  // reads policies without a lock once the mesh is up).
+  void set_link_policy(int peer, const LinkPolicy& pol);
 
   // In-place ring allreduce over `count` elements of `dtype`. AVG is the
   // caller's job (SUM then divide), matching ProcessGroupSocket.
@@ -191,19 +241,26 @@ class CollectiveEngine {
 
  private:
   struct Waiter;
+  struct LegGroup;
 
   void set_error(const std::string& msg);
   bool fail(const std::string& msg);  // set_error + return false
   void close_all();
 
-  // Contiguous slice of [0, units) carried by stripe s (deterministic on
-  // both ends: base + 1 spare unit for the first units % n_streams stripes).
-  void stripe_range(uint64_t units, int s, uint64_t* off, uint64_t* len) const;
+  // Effective policy / stripe count for a peer (clamped to the 32-bit alive
+  // mask; both ends must agree — symmetric TORCHFT_LINKS configuration).
+  LinkPolicy link_policy(int peer) const;
+  int stripes_for(int peer) const;
+  // Lowest-indexed live stripe to `peer` (header/metadata traffic), or -1.
+  int first_alive(int peer) const;
 
-  // Enqueue striped transfer jobs against `peer`; each job reports into *w.
-  // `esize` keeps stripe boundaries on element boundaries (both ends must
-  // pass the same esize or the slices would interleave mid-element).
-  // `rec` (nullable) collects per-stripe flight-recorder lanes.
+  // Enqueue striped transfer jobs against `peer`; the stripes of one call
+  // form a leg group that reports ONE completion into *w — individual
+  // stripe failures are handled inside the group (handoff to a surviving
+  // stripe) before the group resolves. `esize` keeps stripe boundaries on
+  // element boundaries (both ends must pass the same esize or the slices
+  // would interleave mid-element). `rec` (nullable) collects per-stripe
+  // flight-recorder lanes.
   void send_stripes(int peer, const char* data, uint64_t nbytes,
                     uint64_t esize, int64_t deadline_ms, Waiter* w,
                     FlightRec* rec = nullptr);
@@ -214,6 +271,32 @@ class CollectiveEngine {
   void recv_reduce_stripes(int peer, void* dst, uint64_t count, int32_t dtype,
                            int32_t op, int64_t deadline_ms, Waiter* w,
                            FlightRec* rec = nullptr);
+
+  // Partitions [0, units) over the live stripes of g->peer and submits one
+  // pool job per leg; the group resolves g->w exactly once (leg_epilogue).
+  void launch_group(std::shared_ptr<LegGroup> g, uint64_t units);
+  // One stripe leg: transfer, flight-recorder lane, group bookkeeping.
+  void run_leg(std::shared_ptr<LegGroup> g, size_t li);
+  // Runs on the pool thread of the LAST stripe job of a group to finish:
+  // re-assigns every failed stripe's byte range to survivors (or fails the
+  // group), then resolves the group's Waiter slot exactly once.
+  void leg_epilogue(std::shared_ptr<LegGroup> g);
+  // Re-runs failed leg `li` in full over surviving stripe `to` (16-byte
+  // {magic, stripe, ulen} header so both ends can detect disagreement).
+  bool handoff_leg(LegGroup& g, size_t li, int to);
+  // One rejoin dial for a dead stripe (janitor). Stages the socket with the
+  // activation number the acceptor picked. False = retry next sweep.
+  bool try_rejoin(int peer, int stripe);
+  // Records one handoff in the failover ring (fr_snapshot "failovers").
+  void record_failover(int peer, int stripe, int to_stripe, int dir,
+                       uint64_t moved_bytes, const char* tag);
+
+  // Collective entry: bumps op_seq_ and installs janitor-staged rejoin
+  // sockets whose negotiated activation number has arrived (both ends
+  // install before the same collective, so stripe partitions agree).
+  void begin_op();
+  void janitor_loop();   // connector side: redial dead stripes to lower ranks
+  void acceptor_loop();  // acceptor side: absorb rejoin dials from higher ranks
 
   template <typename T>
   bool ring_allreduce_t(T* data, uint64_t count, int32_t dtype, int32_t op,
@@ -245,6 +328,58 @@ class CollectiveEngine {
   int port_ = -1;
   std::vector<std::vector<int>> peer_fds_;  // [peer][stripe]; self empty
   std::unique_ptr<TaskPool> pool_;
+
+  // -- link policy / stripe health ----------------------------------------
+  LinkPolicy default_policy_;
+  std::map<int, LinkPolicy> link_policies_;  // frozen once connect_mesh runs
+  std::vector<std::string> peer_addrs_;      // "host:port" per rank (janitor)
+  // Bit s set = stripe s to that peer is usable. Cleared by leg groups on
+  // symmetric failure detection, restored by the rejoin janitor. 32 bits
+  // bounds stripes per link at 32 (ctor clamps).
+  std::unique_ptr<std::atomic<uint32_t>[]> alive_mask_;
+  // alive_mask_ snapshot frozen at begin_op: the partition mask every group
+  // launched during one collective uses, so mid-op leg deaths (observed at
+  // different times on the two ends) cannot desynchronize the byte ranges.
+  // Written in begin_op (under reconn_mu_) and read by launch_group /
+  // first_alive on the same caller thread that ran begin_op.
+  std::vector<uint32_t> op_mask_;
+  // Per-(peer, stripe) throughput EWMA in GiB/s, updated per leg (fr_job).
+  mutable std::mutex health_mu_;
+  std::vector<std::vector<double>> stripe_gibs_;
+
+  // -- failover ring (fr_snapshot "failovers") ----------------------------
+  struct FailoverEvent {
+    int64_t seq;
+    int16_t peer;
+    int8_t stripe;     // stripe whose range moved (or rejoined)
+    int8_t to_stripe;  // surviving carrier; -1 for a rejoin event
+    int8_t dir;        // 0 send 1 recv 2 recv-reduce 3 rejoin
+    uint64_t bytes;
+    uint64_t t_ns;
+    char tag[kFrTagLen];
+  };
+  mutable std::mutex fo_mu_;
+  std::deque<FailoverEvent> failovers_;  // capped; Python drains by seq
+  int64_t fo_seq_ = 0;
+
+  // -- rejoin janitor -----------------------------------------------------
+  // Lock order: reconn_mu_ is a leaf (never held across I/O or other locks).
+  std::mutex reconn_mu_;
+  uint64_t op_seq_ = 0;  // collectives started; rejoin activation unit
+  struct Staged {
+    int peer;
+    int stripe;
+    int fd;
+    uint64_t activate_at;  // install when op_seq_ reaches this
+  };
+  std::vector<Staged> staged_;
+  // fds replaced by a rejoin: already shut down, kept open until the
+  // destructor so a stripe job blocked on one fails instead of touching a
+  // recycled descriptor (same lifetime rule as peer_fds_).
+  std::vector<int> retired_fds_;
+  std::thread janitor_;
+  std::thread acceptor_;
+  std::atomic<bool> stopping_{false};
   std::vector<std::pair<std::string, std::string>> results_;  // meta, payload
   std::atomic<bool> aborted_{false};
   std::atomic<uint64_t> bytes_tx_{0};
@@ -286,6 +421,12 @@ int32_t tft_coll_listen(void* h, const char* host);  // port or -1
 int32_t tft_coll_connect(void* h, int32_t rank, int32_t world,
                          const char* peers_json, int64_t timeout_ms);
 void tft_coll_abort(void* h, const char* why);
+// Link policy for `peer` (-1 = default). cls: "local"|"dcn"|"wan".
+// n_streams 0 = engine default; q8 nonzero = prefer int8 wire. Call before
+// tft_coll_connect; ignored afterwards.
+void tft_coll_set_link(void* h, int32_t peer, const char* cls,
+                       int64_t connect_ms, int64_t io_ms, int32_t n_streams,
+                       int32_t q8);
 int32_t tft_coll_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
                            int32_t op, int64_t timeout_ms);
 int32_t tft_coll_allreduce_q8(void* h, float* data, uint64_t count,

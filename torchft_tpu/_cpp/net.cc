@@ -151,20 +151,32 @@ int tcp_connect(const std::string& host, int port, int64_t timeout_ms) {
   return fd;
 }
 
-int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms) {
+int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms,
+                      int64_t attempt_ms) {
   // Exponential backoff mirroring reference net.rs/retry.rs:
   // 100ms initial, x1.5 multiplier, 10s max interval, until deadline.
+  // Full jitter (seeded, deterministic per (host:port, attempt) — see
+  // chaos::backoff_unit) keeps a fleet of reconnecting peers from retrying
+  // in lockstep after a partition heals.
   int64_t deadline = now_ms() + timeout_ms;
   int64_t backoff = 100;
+  if (attempt_ms <= 0) attempt_ms = 5000;
+  const std::string key = host + ":" + std::to_string(port);
+  uint64_t attempt = 0;
   while (true) {
     int64_t remaining = deadline - now_ms();
     if (remaining <= 0) return -1;
-    int fd = tcp_connect(host, port, std::min<int64_t>(remaining, 5000));
+    int fd = tcp_connect(host, port, std::min<int64_t>(remaining, attempt_ms));
     if (fd >= 0) return fd;
     remaining = deadline - now_ms();
     if (remaining <= 0) return -1;
-    sleep_ms(std::min(backoff, remaining));
+    const int64_t cap = std::min(backoff, remaining);
+    const int64_t jittered = std::max<int64_t>(
+        10, static_cast<int64_t>(chaos::backoff_unit(key, attempt) *
+                                 static_cast<double>(cap)));
+    sleep_ms(std::min(jittered, remaining));
     backoff = std::min<int64_t>(static_cast<int64_t>(backoff * 1.5), 10000);
+    ++attempt;
   }
 }
 
@@ -273,7 +285,7 @@ static bool read_all(int fd, char* data, size_t len, int64_t deadline) {
 bool read_exact(int fd, char* data, size_t len, int64_t timeout_ms) {
   int64_t deadline = now_ms() + timeout_ms;
   if (chaos::armed()) {
-    chaos::Decision d = chaos::on_read(fd);
+    chaos::Decision d = chaos::on_read(fd, len);
     if (d.kind == chaos::kReset) {
       shutdown(fd, SHUT_RDWR);
       return false;

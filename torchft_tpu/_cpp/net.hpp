@@ -58,8 +58,14 @@ int tcp_accept(int listen_fd, int timeout_ms);
 int tcp_connect(const std::string& host, int port, int64_t timeout_ms);
 
 // Connect with exponential backoff retries until deadline, mirroring the
-// reference's net.rs connect(): 100ms initial, x1.5, max 10s interval.
-int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms);
+// reference's net.rs connect(): 100ms initial, x1.5, max 10s interval —
+// with seeded full jitter on each sleep (chaos::backoff_unit) so mass
+// reconnects after a partition heal don't stampede in lockstep.
+// `attempt_ms` clamps each individual connect attempt (link-policy budget:
+// WAN links legitimately need more than the old hardcoded 5000, local
+// links much less).
+int tcp_connect_retry(const std::string& host, int port, int64_t timeout_ms,
+                      int64_t attempt_ms = 5000);
 
 // Splits "host:port" (also accepts "[v6]:port"). Returns false on parse error.
 bool split_host_port(const std::string& addr, std::string* host, int* port);

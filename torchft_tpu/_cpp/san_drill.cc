@@ -14,6 +14,7 @@
 #include <thread>
 #include <vector>
 
+#include "chaos.hpp"
 #include "collectives.hpp"
 #include "json.hpp"
 #include "net.hpp"
@@ -114,9 +115,68 @@ static void drill_abort_race() {
   }
 }
 
+// Stripe tears racing live collectives: a seeded chaos rule pins resets
+// to stripe 1's legs (the handoff context never matches, so every tear
+// MUST be absorbed in-collective) while both ranks pump pipelined
+// allreduces and a sampler hammers rank 0's flight recorder. Each tear
+// exercises the failover machinery across threads — the leg epilogue
+// clearing alive bits, the deterministic range handoff on the surviving
+// sockets, the rejoin janitor redialing in the background and begin_op
+// installing the staged fd — exactly the shared state the stripe-failover
+// subsystem added. The inter-round sleep sweeps op start against the
+// janitor's redial timing so rejoin activation lands at different points
+// of the collective's life across rounds. Runs LAST: the armed schedule
+// is process-global.
+static void drill_stripe_tear_race() {
+  std::string err;
+  if (!chaos::init_from_spec("seed:9,spec:reset@data:match=s1:every=5:count=8",
+                             &err)) {
+    fprintf(stderr, "san_drill FAIL: chaos arm: %s\n", err.c_str());
+    ++g_failures;
+    return;
+  }
+  const int ws = 2;
+  auto es = mesh(ws, 4, 128);
+  std::atomic<bool> stop{false};
+  std::thread sampler([&] {
+    while (!stop.load()) {
+      Json snap;
+      if (!Json::parse(es[0]->fr_snapshot(0), &snap)) {
+        fprintf(stderr, "san_drill FAIL: unparseable fr_snapshot\n");
+        ++g_failures;
+        return;
+      }
+    }
+  });
+  for (int iter = 0; iter < 30; ++iter) {
+    sleep_ms(iter % 5);
+    std::vector<std::vector<float>> bufs(ws);
+    for (int r = 0; r < ws; ++r) bufs[r].assign(1 << 15, float(r + 1));
+    std::vector<std::thread> ts;
+    std::vector<int> oks(ws, 0);
+    for (int r = 0; r < ws; ++r)
+      ts.emplace_back([&, r] {
+        oks[r] = es[r]->allreduce(bufs[r].data(), bufs[r].size(), TFT_DT_F32,
+                                  TFT_OP_SUM, 8000);
+      });
+    for (auto& t : ts) t.join();
+    for (int r = 0; r < ws; ++r) {
+      REQUIRE(oks[r]);
+      REQUIRE(bufs[r][0] == 3.0f);  // tears absorbed, result still exact
+    }
+  }
+  stop.store(true);
+  sampler.join();
+  Json snap;
+  REQUIRE(Json::parse(es[0]->fr_snapshot(0), &snap));
+  REQUIRE(snap.get("failovers").is_array() &&
+          !snap.get("failovers").arr.empty());
+}
+
 int main() {
   drill_allreduce_with_sampler();
   drill_abort_race();
+  drill_stripe_tear_race();
   fprintf(stderr, "san_drill: %s (%d failure(s))\n",
           g_failures == 0 ? "PASS" : "FAIL", g_failures);
   return g_failures == 0 ? 0 : 1;

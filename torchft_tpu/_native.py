@@ -52,6 +52,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tft_coll_connect.argtypes = [P, I32, I32, CP, I64]
     lib.tft_coll_abort.restype = None
     lib.tft_coll_abort.argtypes = [P, CP]
+    lib.tft_coll_set_link.restype = None
+    lib.tft_coll_set_link.argtypes = [P, I32, CP, I64, I64, I32, I32]
     lib.tft_coll_allreduce.restype = I32
     lib.tft_coll_allreduce.argtypes = [P, P, U64, I32, I32, I64]
     lib.tft_coll_allreduce_q8.restype = I32
@@ -90,6 +92,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tft_chaos_seq.argtypes = []
     lib.tft_chaos_snapshot.restype = I64
     lib.tft_chaos_snapshot.argtypes = [I64, P, I64]
+    lib.tft_chaos_set_link.restype = None
+    lib.tft_chaos_set_link.argtypes = [CP, CP]
 
 
 def _load() -> ctypes.CDLL:
@@ -168,6 +172,15 @@ def chaos_snapshot(since_seq: int = 0) -> dict:
             return json.loads(buf.value.decode(errors="replace"))
         cap = -int(got) + 4096
     raise RuntimeError("native chaos_snapshot: buffer kept growing")
+
+
+def chaos_set_link(peer: str, cls: str) -> None:
+    """Register peer -> link class ("local"/"dcn"/"wan") in the native chaos
+    plane so ``link:<class>``-scoped rules resolve identically to Python's
+    registry. Safe when chaos is off (the map is only consulted by armed
+    rules)."""
+    if _lib is not None:
+        _lib.tft_chaos_set_link(peer.encode(), cls.encode())
 
 
 def is_available() -> bool:
@@ -267,6 +280,32 @@ class NativeEngine:
         raise RuntimeError(f"native {op}: {msg}")
 
     # -- mesh lifecycle ----------------------------------------------------
+
+    def set_link(
+        self,
+        peer: int,
+        cls: str,
+        connect_ms: int,
+        io_ms: int,
+        n_streams: int,
+        q8: bool,
+    ) -> None:
+        """Install the link policy for ``peer`` (-1 = default for peers
+        without an explicit entry). Must be called before ``connect``; the
+        engine freezes policies once the mesh is up."""
+        h = self._begin()
+        try:
+            self._lib.tft_coll_set_link(
+                h,
+                int(peer),
+                cls.encode(),
+                int(connect_ms),
+                int(io_ms),
+                int(n_streams),
+                1 if q8 else 0,
+            )
+        finally:
+            self._end()
 
     def listen(self, host: str = "0.0.0.0") -> int:
         h = self._begin()

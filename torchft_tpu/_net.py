@@ -41,6 +41,14 @@ def _chaos_io(sock: socket.socket, op: str, payload=None, timeout=None) -> None:
     inj = st.pick("stall", plane, site, peer=peer, match=match)
     if inj is not None:
         time.sleep(inj.ms / 1000.0)
+    if payload is not None:
+        # Token-bucket pacing: a fired throttle rule installs a bucket at
+        # this site and every subsequent frame pays for its bytes.
+        delay = st.throttle_delay(
+            plane, site, len(payload), peer=peer, match=match
+        )
+        if delay > 0.0:
+            time.sleep(delay)
     if op == "send" and payload is not None:
         inj = st.pick("partial_write", plane, site, peer=peer, match=match)
         if inj is not None:
@@ -101,10 +109,19 @@ def parse_addr(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
-def connect(addr: str, timeout: float) -> socket.socket:
+def connect(
+    addr: str, timeout: float, attempt_timeout: float = 5.0
+) -> socket.socket:
     """Connects with exponential backoff retries until ``timeout`` seconds,
-    mirroring the reference's net.rs connect() (100ms -> 10s, x1.5)."""
+    mirroring the reference's net.rs connect() (100ms -> 10s, x1.5) with
+    seeded full jitter on each retry sleep (chaos.backoff_jitter, mirroring
+    tcp_connect_retry in _cpp/net.cc) so mass reconnects after a partition
+    heal don't stampede in lockstep. ``attempt_timeout`` clamps each
+    individual connect attempt — a link-policy budget: WAN links legitimately
+    need more than the old hardcoded 5s, local links much less."""
     host, port = parse_addr(addr)
+    if attempt_timeout <= 0:
+        attempt_timeout = 5.0
     if _chaos_armed():
         st, ctx = _chaos.active(), _chaos._scope_ctx()
         if st is not None and ctx is not None:
@@ -120,6 +137,8 @@ def connect(addr: str, timeout: float) -> socket.socket:
                 raise ConnectionRefusedError(f"[chaos] connection refused: {inj}")
     deadline = time.monotonic() + timeout
     backoff = 0.1
+    attempt = 0
+    jitter_key = f"{host}:{port}"
     last_err: Optional[Exception] = None
     while True:
         remaining = deadline - time.monotonic()
@@ -137,7 +156,7 @@ def connect(addr: str, timeout: float) -> socket.socket:
             ):
                 sock = socket.socket(family, stype, proto)
                 set_buffer_sizes(sock)
-                sock.settimeout(min(remaining, 5.0))
+                sock.settimeout(min(remaining, attempt_timeout))
                 try:
                     sock.connect(addr_tuple)
                 except OSError as exc:
@@ -149,8 +168,12 @@ def connect(addr: str, timeout: float) -> socket.socket:
             raise last_exc or OSError(f"no addresses for {host}")
         except OSError as e:  # noqa: PERF203
             last_err = e
-            time.sleep(min(backoff, max(deadline - time.monotonic(), 0)))
+            remaining = max(deadline - time.monotonic(), 0)
+            cap = min(backoff, remaining)
+            jittered = max(0.01, _chaos.backoff_jitter(jitter_key, attempt, cap))
+            time.sleep(min(jittered, remaining))
             backoff = min(backoff * 1.5, 10.0)
+            attempt += 1
 
 
 def send_frame(
@@ -201,6 +224,17 @@ def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> bytearra
     (length,) = struct.unpack(">I", header)
     if length > MAX_FRAME:
         raise FrameError(f"frame too large: {length}")
+    if _chaos_armed():
+        # Throttle the receive side too, once the frame length is known —
+        # an inbound WAN link is just as bandwidth-bound as the outbound one.
+        st, ctx = _chaos.active(), _chaos._scope_ctx()
+        if st is not None and ctx is not None:
+            plane, peer, match = ctx
+            delay = st.throttle_delay(
+                plane, f"recv:{peer or '?'}", length, peer=peer, match=match
+            )
+            if delay > 0.0:
+                time.sleep(delay)
     return _recv_exact(sock, length, deadline)
 
 

@@ -12,17 +12,28 @@ single source of truth for what gets injected where:
       rule = <kind>@<plane>[:<param>=<value>]...
 
   Kinds: ``connect_refuse``, ``reset``, ``stall``, ``partial_write``,
-  ``rpc_delay``, ``rpc_drop``, ``abort_heal``, ``ckpt_truncate``.
+  ``rpc_delay``, ``rpc_drop``, ``abort_heal``, ``ckpt_truncate``,
+  ``throttle``.
   Planes: ``ctrl`` (framed-RPC client/server path), ``data`` (process-group
   send/recv, both socket and native backends), ``heal`` (checkpoint
   transport), or ``any``.
   Params (all optional): ``peer=<substr>``, ``match=<substr>`` (RPC type or
-  collective tag), ``step=<a>-<b>`` (inclusive window; see :func:`set_step`),
+  collective tag), ``link=<class>`` (only peers whose registered link class
+  — see :func:`set_link_class` — equals this, e.g. ``wan``),
+  ``step=<a>-<b>`` (inclusive window; see :func:`set_step`),
   ``p=<float>`` (per-visit probability, default 1), ``after=<n>`` (skip the
   first n eligible visits), ``every=<n>`` (then fire each n-th, default 1),
   ``count=<n>`` (max fires, default unlimited), ``ms=<int>`` (stall/delay
   duration, default 100), ``frac=<float>`` (fraction written before the cut,
-  default 0.5).
+  default 0.5), ``rate=<bytes/s>`` + ``bucket=<bytes>`` (throttle token
+  bucket: sustained rate and burst size, defaults 1 MiB/s and 64 KiB).
+
+  ``throttle`` is special: the seeded decision (after/every/p/count, per
+  visit) picks *when a site's bandwidth cap switches on*; from that visit on
+  the site is paced by a token bucket without further decisions, so one
+  ``chaos_inject`` journal line marks the activation rather than one per
+  sub-transfer. Pacing sleeps are wall-clock (like ``stall``); which visits
+  activate is hash-only and replays exactly.
 
   Example — reset the 3rd+ quorum RPC and stall data sends to peer 1::
 
@@ -73,7 +84,11 @@ __all__ = [
     "scope",
     "maybe",
     "maybe_stall",
+    "maybe_throttle",
     "check_connect",
+    "set_link_class",
+    "link_class",
+    "backoff_jitter",
 ]
 
 _M64 = (1 << 64) - 1
@@ -87,6 +102,7 @@ KINDS = (
     "rpc_drop",
     "abort_heal",
     "ckpt_truncate",
+    "throttle",
 )
 
 PLANES = ("ctrl", "data", "heal", "srv", "any")
@@ -149,6 +165,7 @@ class Rule:
     index: int = 0
     peer: Optional[str] = None
     match: Optional[str] = None
+    link: Optional[str] = None
     step_lo: int = -1
     step_hi: int = 1 << 62
     p: float = 1.0
@@ -157,6 +174,8 @@ class Rule:
     count: Optional[int] = None
     ms: int = 100
     frac: float = 0.5
+    rate: int = 1 << 20
+    bucket: int = 1 << 16
 
     def spec(self) -> str:
         """Round-trip the rule back to grammar form (for CHAOS_SOAK.json)."""
@@ -165,6 +184,8 @@ class Rule:
             parts.append(f"peer={self.peer}")
         if self.match is not None:
             parts.append(f"match={self.match}")
+        if self.link is not None:
+            parts.append(f"link={self.link}")
         if self.step_lo >= 0 or self.step_hi < (1 << 62):
             hi = self.step_hi if self.step_hi < (1 << 62) else ""
             parts.append(f"step={self.step_lo}-{hi}")
@@ -180,6 +201,10 @@ class Rule:
             parts.append(f"ms={self.ms}")
         if self.kind in ("partial_write", "ckpt_truncate") or self.frac != 0.5:
             parts.append(f"frac={self.frac}")
+        if self.kind == "throttle" or self.rate != (1 << 20):
+            parts.append(f"rate={self.rate}")
+        if self.kind == "throttle" or self.bucket != (1 << 16):
+            parts.append(f"bucket={self.bucket}")
         return ":".join(parts)
 
 
@@ -202,6 +227,8 @@ def parse_rule(text: str, index: int) -> Rule:
                 r.peer = v
             elif k == "match":
                 r.match = v
+            elif k == "link":
+                r.link = v
             elif k == "step":
                 lo, _, hi = v.partition("-")
                 r.step_lo = int(lo) if lo else 0
@@ -222,6 +249,14 @@ def parse_rule(text: str, index: int) -> Rule:
                 r.frac = float(v)
                 if not (0.0 <= r.frac <= 1.0):
                     raise ValueError("frac outside [0,1]")
+            elif k == "rate":
+                r.rate = int(v)
+                if r.rate <= 0:
+                    raise ValueError("rate must be > 0")
+            elif k == "bucket":
+                r.bucket = int(v)
+                if r.bucket <= 0:
+                    raise ValueError("bucket must be > 0")
             else:
                 raise ValueError(f"unknown param '{k}'")
         except ChaosSpecError:
@@ -270,12 +305,45 @@ class Injection:
     seq: int
     ms: int
     frac: float
+    rate: int = 0
+    bucket: int = 0
 
     def __str__(self) -> str:
         return (
             f"chaos[{self.seq}] {self.kind}@{self.plane} site={self.site} "
             f"rule={self.rule} visit={self.visit}"
         )
+
+
+class _TokenBucket:
+    """Wall-clock token bucket pacing an activated throttle site. Lives in
+    the hook layer, not the decision layer: *which* visit activates a
+    throttle is hash-only, *how long* a paced write sleeps is not part of
+    the replayed injection sequence (like a stall's sleep duration)."""
+
+    # Cap per-call sleeps so one huge buffered write can't wedge a
+    # deadline-driven transfer for longer than a stall rule could.
+    MAX_SLEEP_S = 2.0
+
+    def __init__(self, rate: int, bucket: int) -> None:
+        self.rate = max(1, int(rate))  # bytes/second sustained
+        self.cap = max(1, int(bucket))  # burst bytes
+        self._tokens = float(self.cap)
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def consume(self, nbytes: int) -> float:
+        """Takes ``nbytes`` tokens; returns seconds the caller must sleep."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                float(self.cap), self._tokens + (now - self._t_last) * self.rate
+            )
+            self._t_last = now
+            self._tokens -= float(nbytes)
+            if self._tokens >= 0.0:
+                return 0.0
+            return min(-self._tokens / self.rate, self.MAX_SLEEP_S)
 
 
 class Chaos:
@@ -289,6 +357,11 @@ class Chaos:
         self._fired: Dict[int, int] = {}
         self._seq = 0
         self._site_hash: Dict[str, int] = {}
+        self._buckets: Dict[str, _TokenBucket] = {}  # site -> active throttle
+        # Serializes throttle activation (check + pick + create) per process
+        # so concurrent hooks at one site produce a deterministic number of
+        # activation visits — the journal replays bit-for-bit.
+        self._throttle_lock = threading.Lock()
 
     def spec(self) -> str:
         body = ";".join(r.spec() for r in self.rules)
@@ -335,6 +408,10 @@ class Chaos:
             and (r.peer is None or (peer is not None and r.peer in peer))
             and (r.match is None or (match is not None and r.match in match))
             and (
+                r.link is None
+                or (peer is not None and _LINK_CLASSES.get(peer) == r.link)
+            )
+            and (
                 r.step_lo < 0
                 or (step is not None and r.step_lo <= step <= r.step_hi)
             )
@@ -350,6 +427,10 @@ class Chaos:
                 if r.peer is not None and (peer is None or r.peer not in peer):
                     continue
                 if r.match is not None and (match is None or r.match not in match):
+                    continue
+                if r.link is not None and (
+                    peer is None or _LINK_CLASSES.get(peer) != r.link
+                ):
                     continue
                 if r.step_lo >= 0:  # windowed rule: needs a known step
                     if step is None or not (r.step_lo <= step <= r.step_hi):
@@ -369,6 +450,8 @@ class Chaos:
                         seq=self._seq,
                         ms=r.ms,
                         frac=r.frac,
+                        rate=r.rate if r.kind == "throttle" else 0,
+                        bucket=r.bucket if r.kind == "throttle" else 0,
                     )
         if inj is not None:
             self._journal(inj, peer=peer, match=match, step=step)
@@ -397,11 +480,40 @@ class Chaos:
                     seq=inj.seq,
                     ms=inj.ms,
                     frac=inj.frac,
+                    rate=inj.rate,
+                    bucket=inj.bucket,
                     peer=peer,
                     match=match,
                 )
         except Exception:
             pass  # chaos must never break the path it injects into
+
+    def throttle_delay(
+        self,
+        plane: str,
+        site: str,
+        nbytes: int,
+        peer: Optional[str] = None,
+        match: Optional[str] = None,
+        step: Optional[int] = None,
+    ) -> float:
+        """Seconds this I/O must sleep under an active throttle (0 when the
+        site has no active bucket and no throttle rule fires this visit)."""
+        b = self._buckets.get(site)
+        if b is None:
+            with self._throttle_lock:
+                b = self._buckets.get(site)
+                if b is None:
+                    inj = self.pick(
+                        "throttle", plane, site, peer=peer, match=match,
+                        step=step,
+                    )
+                    if inj is None:
+                        return 0.0
+                    b = self._buckets[site] = _TokenBucket(
+                        inj.rate, inj.bucket
+                    )
+        return b.consume(nbytes)
 
     def injections_fired(self) -> int:
         with self._lock:
@@ -412,6 +524,12 @@ class Chaos:
 _STATE: Optional[Chaos] = None
 _INIT_LOCK = threading.Lock()
 _INITED = False
+
+# Peer -> link class ("local"/"dcn"/"wan"), fed by the process group from
+# TORCHFT_LINKS so `link=<class>` rules can scope faults to a whole class of
+# links without enumerating peers. Plain dict: writes happen at configure
+# time, reads are GIL-atomic lookups on the hook path.
+_LINK_CLASSES: Dict[str, str] = {}
 
 _GLOBAL_STEP: Optional[int] = None
 _STEP_LISTENERS: List[Callable[[int], None]] = []
@@ -445,13 +563,14 @@ def active() -> Optional[Chaos]:
 
 
 def reset() -> None:
-    """Forgets the installed schedule and step (tests)."""
+    """Forgets the installed schedule, step and link classes (tests)."""
     global _STATE, _INITED, _GLOBAL_STEP
     with _INIT_LOCK:
         _STATE = None
         _INITED = False
         _GLOBAL_STEP = None
         _STEP_LISTENERS.clear()
+        _LINK_CLASSES.clear()
 
 
 def install(seed: int, rules: List[Rule]) -> Chaos:
@@ -490,6 +609,23 @@ def on_step_change(cb: Callable[[int], None]) -> None:
     ProcessGroupNative forwarding the step into the C++ chaos mirror)."""
     if cb not in _STEP_LISTENERS:
         _STEP_LISTENERS.append(cb)
+
+
+# ----------------------------------------------------------------------
+# Link classes (TORCHFT_LINKS -> `link=<class>` rule scoping)
+# ----------------------------------------------------------------------
+
+
+def set_link_class(peer: str, cls: str) -> None:
+    """Tags ``peer`` (rank string or "host:port") with a link class so
+    ``link=<class>`` rules apply to it. The process group calls this from
+    its TORCHFT_LINKS policy at configure time; the native mirror is fed
+    separately through ``tft_chaos_set_link``."""
+    _LINK_CLASSES[str(peer)] = str(cls)
+
+
+def link_class(peer: str) -> Optional[str]:
+    return _LINK_CLASSES.get(str(peer))
 
 
 # ----------------------------------------------------------------------
@@ -549,9 +685,42 @@ def maybe_stall(
     return inj
 
 
+def maybe_throttle(
+    plane: str,
+    site: str,
+    nbytes: int,
+    peer: Optional[str] = None,
+    match: Optional[str] = None,
+) -> None:
+    """Throttle hook: paces ``nbytes`` of I/O at ``site`` when a throttle
+    rule has activated a token bucket there (sleeping as needed)."""
+    st = active()
+    if st is None:
+        return
+    delay = st.throttle_delay(plane, site, nbytes, peer=peer, match=match)
+    if delay > 0.0:
+        time.sleep(delay)
+
+
 def check_connect(plane: str, peer: str) -> None:
     """Connect hook: raises ConnectionRefusedError when a connect_refuse
     rule fires for this peer."""
     inj = maybe("connect_refuse", plane, f"connect:{peer}", peer=peer)
     if inj is not None:
         raise ConnectionRefusedError(f"[chaos] connection refused: {inj}")
+
+
+def backoff_jitter(key: str, attempt: int, cap_s: float) -> float:
+    """Seeded full-jitter backoff delay in ``[0, cap_s)``.
+
+    Deterministic in ``(chaos seed, key, attempt)`` via the same
+    splitmix64/FNV-1a fold as the decision hash (seed 0 when no schedule is
+    installed), so mass reconnects after a partition heal de-stampede
+    without breaking same-seed chaos replay. Mirrored bit-for-bit by
+    ``backoff_unit`` in ``_cpp/chaos.cc``."""
+    st = active()
+    seed = st.seed if st is not None else 0
+    h = splitmix64(
+        (seed ^ fnv1a64(key) ^ ((attempt * 0x9E3779B97F4A7C15) & _M64)) & _M64
+    )
+    return _hash_unit(h) * cap_s

@@ -365,12 +365,13 @@ class _FramedClient:
         self, rpc: str, attempt: int, deadline: float, err: Exception
     ) -> None:
         """Full-jitter exponential backoff before attempt N+1, clipped to
-        the remaining call budget; journaled so retry storms are visible."""
-        import random
-
+        the remaining call budget; journaled so retry storms are visible.
+        The jitter is seeded (chaos.backoff_jitter keyed on addr+rpc), not
+        random.uniform: same-seed chaos replays must sleep the same amounts
+        or the journal's rpc_retry delays diverge run to run."""
         cap = min(_RETRY_MAX_S, _RETRY_BASE_S * (2.0 ** (attempt - 1)))
         delay = min(
-            random.uniform(0.0, cap),
+            _chaos.backoff_jitter(f"{self._addr}|{rpc}", attempt, cap),
             max(deadline - time.monotonic() - 0.001, 0.0),
         )
         from torchft_tpu.telemetry import get_event_log

@@ -237,6 +237,13 @@ _ALL = [
         "Socket streams per peer link in the native collective engine.",
     ),
     _k(
+        "TORCHFT_LINKS",
+        "spec",
+        None,
+        "Per-peer link policy, `<peer>=<class>[,k=v]...[;...]` with classes `local`/`dcn`/`wan` and keys `connect_ms`/`io_ms`/`streams`/`q8`; `*` sets the default. Must be symmetric across ranks. Parsed in Python; the native engine receives the resolved policies via `tft_coll_set_link`, the chaos plane via `tft_chaos_set_link`.",
+        scope="py",
+    ),
+    _k(
         "TORCHFT_NATIVE_PIPELINE_BYTES",
         "int",
         str(1 << 20),

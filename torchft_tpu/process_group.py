@@ -19,6 +19,7 @@ analog, SURVEY.md hard-part #2).
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import json
 import os
@@ -60,6 +61,100 @@ def _as_list(tensors: Any) -> List[np.ndarray]:
     if isinstance(tensors, (list, tuple)):
         return [np.asarray(t) for t in tensors]
     return [np.asarray(tensors)]
+
+
+# -- per-peer link policy (TORCHFT_LINKS) ------------------------------------
+
+# class -> (connect_ms, io_ms, q8). Streams always default to the engine's
+# n_streams unless overridden per entry. ``wan`` turns the int8 wire codec on
+# by default: a cross-region link is bandwidth-bound, so the 4x byte cut
+# dominates the quantization cost.
+_LINK_PRESETS: Dict[str, Tuple[int, int, bool]] = {
+    "local": (2000, 0, False),
+    "dcn": (5000, 0, False),
+    "wan": (15000, 0, True),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkPolicy:
+    """Transport budget for one peer link, by class.
+
+    ``connect_ms`` clamps each individual dial attempt (both the python
+    mesh's and the native engine's); ``io_ms`` bounds one stripe leg's
+    transfer before it is declared stalled and failed over (0 = the
+    collective deadline, i.e. a stall aborts); ``streams`` overrides the
+    stripe count for this link (0 = engine default); ``q8`` elevates the
+    wire codec to int8 blockwise when TORCHFT_PG_WIRE doesn't pin one.
+    """
+
+    cls: str = "dcn"
+    connect_ms: int = 5000
+    io_ms: int = 0
+    streams: int = 0
+    q8: bool = False
+
+
+def parse_links(
+    spec: Optional[str] = None,
+) -> Tuple[LinkPolicy, Dict[int, LinkPolicy]]:
+    """Parses TORCHFT_LINKS: ``<peer>=<class>[,k=v]...[;...]``.
+
+    ``<peer>`` is a rank or ``*`` (the default for unlisted peers); class is
+    ``local``/``dcn``/``wan``; override keys are ``connect_ms``, ``io_ms``,
+    ``streams``, ``q8``. Returns ``(default, {rank: policy})``. The spec
+    MUST be identical on every rank: stripe counts are negotiated nowhere —
+    each side derives them from its own policy table, and the native mesh
+    acceptor rejects a dialer whose count disagrees with its own.
+    """
+    if spec is None:
+        spec = knobs.get_str("TORCHFT_LINKS")
+    default = LinkPolicy()
+    per_peer: Dict[int, LinkPolicy] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        peer_s, sep, rhs = entry.partition("=")
+        if not sep:
+            raise ValueError(f"bad TORCHFT_LINKS entry (no '='): {entry!r}")
+        parts = [p.strip() for p in rhs.split(",")]
+        cls = parts[0].lower()
+        if cls not in _LINK_PRESETS:
+            raise ValueError(
+                f"bad TORCHFT_LINKS class {cls!r} in {entry!r} "
+                f"(want local/dcn/wan)"
+            )
+        connect_ms, io_ms, q8 = _LINK_PRESETS[cls]
+        streams = 0
+        for kv in parts[1:]:
+            k, s2, v = kv.partition("=")
+            k, v = k.strip(), v.strip()
+            if not s2 or not v:
+                raise ValueError(
+                    f"bad TORCHFT_LINKS override {kv!r} in {entry!r}"
+                )
+            if k == "connect_ms":
+                connect_ms = int(v)
+            elif k == "io_ms":
+                io_ms = int(v)
+            elif k == "streams":
+                streams = int(v)
+            elif k == "q8":
+                q8 = v.lower() in ("1", "true", "yes", "on")
+            else:
+                raise ValueError(
+                    f"unknown TORCHFT_LINKS key {k!r} in {entry!r}"
+                )
+        pol = LinkPolicy(
+            cls=cls, connect_ms=connect_ms, io_ms=io_ms, streams=streams, q8=q8
+        )
+        peer_s = peer_s.strip()
+        if peer_s == "*":
+            default = pol
+        else:
+            per_peer[int(peer_s)] = pol
+    return default, per_peer
 
 
 class ProcessGroup:
@@ -150,7 +245,12 @@ class _CollectiveAborted(RuntimeError):
 class _PeerConn:
     """One TCP connection to a peer rank with a tag-routing reader thread."""
 
-    def __init__(self, sock: socket.socket, peer: int) -> None:
+    def __init__(
+        self,
+        sock: socket.socket,
+        peer: int,
+        policy: Optional[LinkPolicy] = None,
+    ) -> None:
         # The connect/accept path may leave a short socket timeout armed; the
         # reader must block indefinitely on an IDLE connection (gaps between
         # collectives are unbounded, e.g. DiLoCo inner steps). Stall/death
@@ -158,6 +258,7 @@ class _PeerConn:
         sock.settimeout(None)
         self.sock = sock
         self.peer = peer
+        self.policy = policy if policy is not None else LinkPolicy()
         self.send_lock = threading.Lock()
         self._queues: Dict[str, queue_mod.Queue] = {}
         self._queues_lock = threading.Lock()
@@ -375,6 +476,9 @@ class ProcessGroupSocket(ProcessGroup):
         self._timeout = timeout
         self._rank = -1
         self._world = 0
+        # Per-peer link policies (TORCHFT_LINKS). Parsed at construction so a
+        # malformed spec fails the PG build, not the first reconfigure.
+        self._link_default, self._link_peers = parse_links()
         self._peers: Dict[int, _PeerConn] = {}
         self._executor: Optional[ThreadPoolExecutor] = None
         self._errored: Optional[Exception] = None
@@ -382,6 +486,11 @@ class ProcessGroupSocket(ProcessGroup):
         self._seq_lock = threading.Lock()
         self._configure_lock = threading.Lock()
         self._trace_id = ""
+
+    def link_policy(self, peer: int) -> LinkPolicy:
+        """The effective policy for ``peer`` (its TORCHFT_LINKS entry, else
+        the ``*`` default, else plain dcn)."""
+        return self._link_peers.get(peer, self._link_default)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -411,6 +520,13 @@ class ProcessGroupSocket(ProcessGroup):
                     )
                 return
 
+            # Register every peer's link class with the chaos plane so
+            # ``link:<class>``-scoped rules resolve during the mesh build
+            # itself (chaos peers are rank strings on the data plane).
+            for peer in range(world_size):
+                if peer != rank:
+                    _chaos.set_link_class(str(peer), self.link_policy(peer).cls)
+
             addr, _, prefix = store_addr.partition("/")
             store = StoreClient(addr, prefix=prefix, timeout=self._timeout)
 
@@ -431,16 +547,25 @@ class ProcessGroupSocket(ProcessGroup):
                 # higher ranks (avoids duplicate cross connections).
                 for peer in range(rank):
                     peer_addr = store.get_str(f"addr_{peer}", timeout=self._timeout)
+                    pol = self.link_policy(peer)
                     with _chaos.scope("data", peer=str(peer), match="configure"):
-                        sock = _net.connect(peer_addr, self._timeout)
+                        sock = _net.connect(
+                            peer_addr,
+                            self._timeout,
+                            attempt_timeout=pol.connect_ms / 1000.0,
+                        )
                     _net.send_json(sock, {"rank": rank})
-                    peers[peer] = _PeerConn(sock, peer)
+                    peers[peer] = _PeerConn(sock, peer, policy=pol)
                 listener.settimeout(self._timeout)
                 for _ in range(world_size - rank - 1):
                     sock, _ = listener.accept()
                     _net.set_keepalive(sock)
                     hello = _net.recv_json(sock, timeout=self._timeout)
-                    peers[hello["rank"]] = _PeerConn(sock, hello["rank"])
+                    peers[hello["rank"]] = _PeerConn(
+                        sock,
+                        hello["rank"],
+                        policy=self.link_policy(hello["rank"]),
+                    )
             except (OSError, TimeoutError) as e:
                 for c in peers.values():
                     c.close()
@@ -854,6 +979,18 @@ class ProcessGroupNative(ProcessGroupSocket):
         self._wire = (
             wire if wire is not None else knobs.get_str("TORCHFT_PG_WIRE")
         ).lower()
+        # A q8-class link (e.g. a ``wan`` preset) elevates the wire codec
+        # unless the caller or TORCHFT_PG_WIRE pinned one explicitly: the
+        # 4x byte cut is the point of declaring a link bandwidth-bound.
+        if (
+            wire is None
+            and knobs.get_raw("TORCHFT_PG_WIRE", None) is None
+            and (
+                self._link_default.q8
+                or any(p.q8 for p in self._link_peers.values())
+            )
+        ):
+            self._wire = "int8"
         # Engine flight-record ring size (records). 0 disables recording
         # (the always-on per-peer byte/busy counters remain); the default
         # keeps the last 256 collectives, enough to cover a full commit
@@ -864,6 +1001,7 @@ class ProcessGroupNative(ProcessGroupSocket):
             else knobs.get_raw("TORCHFT_NATIVE_FR_RING")
         )
         self._fr_last_seq = 0
+        self._failover_last_seq = 0
         self._chaos_last_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
@@ -879,6 +1017,28 @@ class ProcessGroupNative(ProcessGroupSocket):
             engine = self._native.NativeEngine(
                 self._n_streams, self._pipeline_bytes, self._fr_capacity
             )
+            # Push link policies BEFORE the mesh comes up (the engine
+            # freezes them at connect), and mirror each peer's class into
+            # both chaos planes so link:<class>-scoped rules agree.
+            d = self._link_default
+            engine.set_link(
+                -1, d.cls, d.connect_ms, d.io_ms, d.streams, d.q8
+            )
+            for r, pol in sorted(self._link_peers.items()):
+                if 0 <= r < world_size and r != rank:
+                    engine.set_link(
+                        r,
+                        pol.cls,
+                        pol.connect_ms,
+                        pol.io_ms,
+                        pol.streams,
+                        pol.q8,
+                    )
+            for r in range(world_size):
+                if r != rank:
+                    self._native.chaos_set_link(
+                        str(r), self.link_policy(r).cls
+                    )
             try:
                 port = engine.listen("0.0.0.0")
                 addr, _, prefix = store_addr.partition("/")
@@ -921,6 +1081,7 @@ class ProcessGroupNative(ProcessGroupSocket):
         with self._configure_lock:
             self._engine = engine
             self._fr_last_seq = 0  # fresh engine, fresh record sequence
+            self._failover_last_seq = 0
         if self._trace_id:
             engine.set_trace(self._trace_id)
         for conn in self._peers.values():
@@ -1055,6 +1216,29 @@ class ProcessGroupNative(ProcessGroupSocket):
                 lanes=r.get("lanes", []),
                 lanes_dropped=int(r.get("lanes_dropped", 0)),
                 cause=r.get("cause", ""),
+            )
+        # Stripe failovers ride the same snapshot as a separate ring (the
+        # engine keeps the last 256); the cursor is PG-side because
+        # peer_gib_s() also snapshots and must not consume entries.
+        for f in snap.get("failovers", []):
+            seq = int(f.get("seq", 0))
+            if seq <= self._failover_last_seq:
+                continue
+            self._failover_last_seq = seq
+            tag = f.get("tag", "")
+            trace, sep, ctag = tag.partition("|")
+            if not sep:
+                trace, ctag = "", tag
+            log.emit(
+                "stripe_failover",
+                trace=trace or None,
+                peer=int(f.get("peer", -1)),
+                stripe=int(f.get("stripe", -1)),
+                to_stripe=int(f.get("to_stripe", -1)),
+                dir=f.get("dir", ""),
+                nbytes=int(f.get("bytes", 0)),
+                t_ns=int(f.get("t_ns", 0)),
+                tag=ctag,
             )
         log.emit(
             "native_counters",

@@ -439,6 +439,7 @@ EVENT_KINDS: Dict[str, str] = {
     "server_stop": "lighthouse/manager server process stopped",
     # -- chaos plane (chaos.py, process_group.py) ----------------------
     "chaos_inject": "seeded fault injected (kind/plane/site/visit)",
+    "stripe_failover": "striped link leg died; range re-assigned or rejoined",
     # -- fleet observability tools (tools/obs_export.py) ---------------
     "lighthouse_status": "periodic lighthouse status scrape snapshot",
     "anomaly": "exporter-detected anomaly (straggler, hb gap, error)",
