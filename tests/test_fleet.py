@@ -24,8 +24,11 @@ from torchft_tpu.telemetry import DigestWindow, StepDigest
 
 @pytest.fixture
 def lighthouse():
+    # fleet_snap_ms=0 disables snapshot caching so every fleet() read
+    # reflects the writes just made (read-after-write determinism).
     server = LighthouseServer(
-        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
     )
     yield server
     server.shutdown()
@@ -299,7 +302,8 @@ def _drive(addr: str, seq) -> list:
 
 def test_anomaly_rules_fire_in_order():
     server = LighthouseServer(
-        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
     )
     try:
         anomalies = _drive(server.address(), _SEQ)
@@ -320,7 +324,8 @@ def test_anomaly_detector_is_deterministic():
     runs = []
     for _ in range(2):
         server = LighthouseServer(
-            min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+            min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+            fleet_snap_ms=0,
         )
         try:
             runs.append(_drive(server.address(), _SEQ))
@@ -330,11 +335,159 @@ def test_anomaly_detector_is_deterministic():
     assert runs[0], "sequence produced no anomalies at all"
 
 
+# ---------------------------------------------------------------------------
+# Fleet scale: incremental aggregates, snapshot staleness, ring overflow
+# ---------------------------------------------------------------------------
+
+
+def _upper_median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2] if s else None
+
+
+def _recompute_agg(fleet: dict) -> dict:
+    """Recomputes the fleet aggregates from the replica rows of the SAME
+    payload — the ground truth the lighthouse's incremental trackers
+    (MedianTracker / multiset) must match exactly."""
+    rows = fleet["replicas"]
+    digests = [r["digest"] for r in rows.values() if r["digest"]]
+    rates = [d["rate"] for d in digests if d.get("rate")]
+    steps = [int(d.get("step", 0)) for d in digests]
+    gps = [float(d.get("gp") or 0.0) for d in digests]
+    cfs = [int(d.get("cf") or 0) for d in digests]
+    return {
+        "n": len(rows),
+        "n_digest": len(digests),
+        "stragglers": sum(1 for r in rows.values() if r["straggler"]),
+        "median_rate": _upper_median(rates),
+        "median_step": _upper_median(steps),
+        "median_goodput": _upper_median(gps),
+        "max_commit_failures": max(cfs) if cfs else 0,
+    }
+
+
+def test_fleet_incremental_agg_matches_recompute_under_churn():
+    """Property test at N=1024: after randomized join/digest/leave churn,
+    the O(1)-maintained aggregates in /fleet.json equal a full recompute
+    from the rows in the same payload. Values are multiples of 1/8 so the
+    comparison is exact, not approximate."""
+    import random
+
+    rng = random.Random(0xF1EE7)
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
+    )
+    try:
+        client = LighthouseClient(server.address())
+
+        def rand_dg() -> dict:
+            return {
+                "v": 1,
+                "step": rng.randrange(0, 1000),
+                "rate": rng.randrange(0, 8) * 0.25,  # 0 => not rate-tracked
+                "gp": rng.randrange(0, 9) / 8.0,
+                "cf": rng.choice((0, 0, 0, 1, 2, 3)),
+            }
+
+        n = 1024
+        alive = []
+        joined = 0
+
+        def join() -> None:
+            nonlocal joined
+            rid = f"r{joined:04d}"
+            joined += 1
+            client.heartbeat(rid, digest=rand_dg(), hb_interval_ms=60000)
+            alive.append(rid)
+
+        for _ in range(n):
+            join()
+        # Leaves are permanent (the leave tombstone blocks resurrection by
+        # in-flight heartbeats), so churn joins always use fresh ids.
+        for _ in range(1000):
+            op = rng.random()
+            if alive and op < 0.20:
+                client.leave(alive.pop(rng.randrange(len(alive))))
+            elif op < 0.40:
+                join()
+            else:
+                rid = alive[rng.randrange(len(alive))]
+                client.heartbeat(rid, digest=rand_dg(),
+                                 hb_interval_ms=60000)
+        fleet = client.fleet(timeout=30.0)
+        client.close()
+    finally:
+        server.shutdown()
+    assert set(fleet["replicas"]) == set(alive)
+    expect = _recompute_agg(fleet)
+    agg = fleet["agg"]
+    for key, want in expect.items():
+        assert agg[key] == want, (key, agg[key], want)
+    assert agg["anomalies_dropped"] >= 0
+    assert fleet["gen"] > 0  # every mutation bumped the content version
+    assert fleet["snap_ms"] == 0
+
+
+def test_fleet_snapshot_staleness_bound(tmp_path):
+    """With fleet_snap_ms=600 two reads inside the window serve the SAME
+    cached payload (gen and build time identical, later writes invisible);
+    a read after the window sees the new rows and an advanced gen."""
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=600,
+    )
+    try:
+        c = LighthouseClient(server.address())
+        c.heartbeat("s0", digest=_dg(1, 1.0), hb_interval_ms=60000)
+        f1 = c.fleet()
+        assert f1["snap_ms"] == 600
+        assert "s0" in f1["replicas"]
+        c.heartbeat("s1", digest=_dg(2, 2.0), hb_interval_ms=60000)
+        f2 = c.fleet()
+        assert (f2["gen"], f2["ts_ms"]) == (f1["gen"], f1["ts_ms"])
+        assert "s1" not in f2["replicas"]
+        time.sleep(0.8)
+        f3 = c.fleet()
+        assert f3["gen"] > f1["gen"]
+        assert "s1" in f3["replicas"]
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_anomaly_ring_overflow_is_counted(lighthouse):
+    """Overflowing the 64-record anomaly ring surfaces a drop counter in
+    /fleet.json, status.json and /metrics instead of silently losing
+    history. A single replica toggling commit_stall produces one rise
+    edge per cycle without tripping the fleet-relative rules."""
+    c = LighthouseClient(lighthouse.address())
+    for i in range(70):
+        c.heartbeat("of", digest=_dg(i + 1, 1.0, cf=3), hb_interval_ms=60000)
+        c.heartbeat("of", digest=_dg(i + 1, 1.0, cf=0), hb_interval_ms=60000)
+    fleet = c.fleet()
+    assert fleet["anomaly_seq"] == 70
+    assert len(fleet["anomalies"]) == 64
+    assert fleet["agg"]["anomalies_dropped"] == 6
+    # The ring kept the NEWEST records.
+    assert fleet["anomalies"][-1]["seq"] == 70
+    assert fleet["anomalies"][0]["seq"] == 7
+    status = c.status()
+    assert status["fleet"]["anomalies_dropped"] == 6
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/metrics", timeout=5
+    ) as resp:
+        metrics = resp.read().decode()
+    assert "torchft_lighthouse_anomalies_dropped 6" in metrics
+    c.close()
+
+
 def test_hb_jitter_flags_closed_gap():
     """A heartbeat gap blowing the declared-cadence budget flags
     hb_jitter at arrival (budget = max(8 x cadence, 1 s))."""
     server = LighthouseServer(
-        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
     )
     try:
         client = LighthouseClient(server.address())

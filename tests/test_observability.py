@@ -529,3 +529,121 @@ def test_obs_top_renders_empty_fleet():
     assert obs_top.check_frame(
         {"replicas": {}, "agg": {}, "anomalies": []}, frame
     ) == []
+
+
+# ---------------------------------------------------------------------------
+# Fleet scale: --top truncation, cardinality caps, overflow journaling
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_fleet(n=1024):
+    """An O(1000)-replica fleet table: mostly healthy rows plus a handful
+    of flagged/straggling/lagging replicas a --top view must surface."""
+    replicas = {}
+    for i in range(n):
+        replicas[f"w{i:04d}"] = {
+            "straggler": False, "flags": [],
+            "digest": {"step": 500, "rate": 1.0 + (i % 5) * 0.01,
+                       "gp": 0.99, "cf": 0},
+            "last_hb_age_ms": 40, "hb_interval_ms": 100,
+            "digest_age_ms": 45,
+        }
+    # Severity ladder, worst first: two flags, one flag + straggler,
+    # straggler only, then unflagged-but-lagging, then slow-but-level.
+    replicas["w0007"].update(
+        straggler=True, flags=["hb_jitter", "commit_stall"])
+    replicas["w0003"].update(straggler=True, flags=["slow_rate"])
+    replicas["w0011"].update(straggler=True)
+    replicas["w0042"]["digest"] = {"step": 100, "rate": 1.0, "gp": 0.9,
+                                  "cf": 0}
+    replicas["w0099"]["digest"] = {"step": 500, "rate": 0.2, "gp": 0.9,
+                                   "cf": 0}
+    stragglers = sum(1 for r in replicas.values()
+                     if r["straggler"] or r["flags"])
+    return {
+        "ts_ms": 1000, "gen": 7, "snap_ms": 100, "anomaly_seq": 5,
+        "agg": {"n": n, "n_digest": n, "stragglers": stragglers,
+                "median_rate": 1.0, "median_step": 500,
+                "median_goodput": 0.99, "max_commit_failures": 0,
+                "anomalies_dropped": 0},
+        "replicas": replicas,
+        "anomalies": [],
+    }
+
+
+def test_obs_top_top_n_worst_first_at_synthetic_1024():
+    import obs_top
+
+    fleet = _synthetic_fleet(1024)
+    order = obs_top.sort_worst_first(fleet["replicas"], fleet["agg"])
+    # Flag count dominates, then step lag, then slowest rate.
+    assert order[0] == "w0007"
+    assert order[1] == "w0003"
+    assert order[2] == "w0011"
+    assert order[3] == "w0042"
+    assert order[4] == "w0099"
+
+    frame = obs_top.render(fleet, color=False, top=16)
+    assert obs_top.check_frame(fleet, frame, top=16) == []
+    lines = frame.splitlines()
+    # Header advertises the truncation; footer counts the healthy rest.
+    assert "showing=16/1024" in lines[0]
+    assert "(+1008 more replicas below the --top cut)" in frame
+    # The worst offenders render with their tags; healthy bulk is cut.
+    assert any(ln.startswith("w0007") and "STRAGGLER" in ln
+               and "commit_stall" in ln for ln in lines)
+    assert not any(ln.startswith("w0500") for ln in lines)
+    # Frame height stays terminal-sized no matter the fleet.
+    assert len(lines) < 30
+
+    # A frame whose truncation footer lies fails the check.
+    bad = frame.replace("(+1008 more", "(+999 more")
+    assert obs_top.check_frame(fleet, bad, top=16)
+    # Untruncated render still validates and shows everyone.
+    full = obs_top.render(fleet, color=False)
+    assert obs_top.check_frame(fleet, full) == []
+    assert any(ln.startswith("w0500") for ln in full.splitlines())
+
+
+def test_obs_export_caps_replica_label_cardinality():
+    fleet = _synthetic_fleet(200)
+    text = obs_export.render_fleet_prometheus(fleet, max_replicas=64)
+    # Aggregates always present.
+    assert "torchft_exporter_fleet_replicas 200" in text
+    assert "torchft_exporter_fleet_anomalies_dropped 0" in text
+    # Per-replica series survive only for rows a pager would fire on.
+    assert 'torchft_exporter_replica_straggler{replica="w0007"} 1' in text
+    assert ('torchft_exporter_replica_anomaly{replica="w0007",'
+            'kind="commit_stall"} 1') in text
+    assert 'replica="w0150"' not in text
+    shown = sum(1 for r in fleet["replicas"].values()
+                if r["straggler"] or r["flags"])
+    assert (f"torchft_exporter_replicas_suppressed {200 - shown}"
+            in text)
+    # Under the cap nothing is suppressed.
+    text = obs_export.render_fleet_prometheus(fleet, max_replicas=200)
+    assert "torchft_exporter_replicas_suppressed 0" in text
+    assert 'replica="w0150"' in text
+
+
+def test_obs_export_journals_overflow_rise_edge(tmp_path):
+    from torchft_tpu.telemetry import EventLog
+
+    path = str(tmp_path / "ovf.jsonl")
+    log = EventLog(path, replica_id="exporter")
+    fleet = _synthetic_fleet()
+    fleet["agg"]["anomalies_dropped"] = 5
+    mark = obs_export.journal_overflow(log, fleet, 0)
+    assert mark == 5
+    # Same counter value: no new event (rise edge only).
+    assert obs_export.journal_overflow(log, fleet, mark) == 5
+    fleet["agg"]["anomalies_dropped"] = 9
+    assert obs_export.journal_overflow(log, fleet, mark) == 9
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["event"] for l in lines] == ["anomaly_overflow"] * 2
+    assert [l["attrs"]["dropped_total"] for l in lines] == [5, 9]
+    assert [l["attrs"]["new_drops"] for l in lines] == [5, 4]
+    # No fleet / no journal: both are safe no-ops.
+    assert obs_export.journal_overflow(None, fleet, 9) == 9
+    assert obs_export.journal_overflow(None, None, 3) == 3

@@ -1,0 +1,540 @@
+#!/usr/bin/env python
+"""fleet_load: synthetic-fleet load harness for the lighthouse health plane.
+
+Spawns a real C++ lighthouse, then drives it with N lightweight synthetic
+replicas — no trainers, no JAX — each a nonblocking framed-JSON connection
+sending heartbeats that carry a realistic :class:`~torchft_tpu.telemetry.
+StepDigest` wire payload. A single-threaded ``selectors`` event loop
+multiplexes all N connections (the box has one core; threads would only
+benchmark the scheduler), while the lighthouse runs its usual
+thread-per-connection model on the other side.
+
+Per fleet size N the harness measures, and writes to ``BENCH_FLEET.json``:
+
+* heartbeat+digest round-trip p50/p95 (the per-step hot path),
+* quorum formation: all N replicas join one quorum (``min_replicas=N``)
+  and each records first-send -> response latency,
+* ``/fleet.json``, ``/metrics`` and ``/status.json`` HTTP serve latency
+  *while the whole fleet keeps heartbeating*,
+* lighthouse CPU per phase (utime+stime from ``/proc/<pid>/stat``).
+
+At the largest N it also runs the before/after experiment the scaling
+rework is judged by: ``/fleet.json`` serve p95 under full heartbeat load
+with snapshot caching off (``fleet_snap_ms=0``, the old build-under-lock
+behaviour) vs on (100 ms). The run fails unless caching cuts p95 by >= 2x
+and the stated latency budgets hold.
+
+Usage::
+
+    python tools/fleet_load.py                  # N = 64, 256, 1024
+    python tools/fleet_load.py --quick          # N = 64 only (CI lane)
+    python tools/fleet_load.py --sizes 64 512   # custom ladder
+    python tools/fleet_load.py --out /tmp/b.json
+
+``--quick`` is what ``tools/suite_gate.sh fleetload`` runs: one small
+fleet, the same budget assertions, no before/after (caching wins are only
+interesting at O(1000) rows).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import selectors
+import socket
+import struct
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu import _net  # noqa: E402
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
+from torchft_tpu.telemetry import StepDigest  # noqa: E402
+
+# p95 budgets, asserted against the measured numbers. Generous multiples
+# of what the reworked lighthouse does on this class of box (single
+# shared core, N server threads): the budgets are tripwires for O(N)
+# regressions on the hot paths, not performance targets.
+BUDGETS_US = {
+    64: {"heartbeat_p95_us": 100_000, "fleet_json_p95_us": 200_000},
+    256: {"heartbeat_p95_us": 200_000, "fleet_json_p95_us": 300_000},
+    1024: {"heartbeat_p95_us": 400_000, "fleet_json_p95_us": 500_000},
+}
+MIN_SPEEDUP = 2.0  # cached vs uncached /fleet.json p95 at the largest N
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK")
+
+
+def _pct(vals: List[float], q: float) -> float:
+    """Nearest-rank percentile; 0 on empty."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process, in seconds."""
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().rsplit(") ", 1)[1].split()
+    # Fields after the comm field: index 11 = utime, 12 = stime.
+    return (int(parts[11]) + int(parts[12])) / _CLK_TCK
+
+
+def _mk_digest(step: int, rid_n: int) -> Dict[str, Any]:
+    """A realistic digest payload: full phase block + a few peer lanes."""
+    return StepDigest(
+        step=step,
+        rate=1.0 + (rid_n % 7) * 0.01,
+        goodput=0.97,
+        phases={k: [0.001 * (i + 1), 0.002 * (i + 1)]
+                for i, k in enumerate(("q", "h", "c", "a", "m"))},
+        peer_gib_s={f"p{j}": 2.0 + j for j in range(4)},
+        errored=False,
+        chaos_injections=0,
+        commit_failures=0,
+    ).to_wire()
+
+
+class Conn:
+    """One synthetic replica: a nonblocking framed-JSON connection with a
+    single request in flight at a time. The heartbeat frame is prebuilt
+    once (fixed step near the fleet median, per-replica rate) so queueing
+    one costs an append, not a JSON encode — the harness must not spend
+    the shared core it is trying to load the lighthouse with."""
+
+    __slots__ = ("sock", "rid", "rid_n", "out", "inbuf", "need", "t0",
+                 "rtts_us", "rounds", "step", "done", "hb_frame",
+                 "pending", "next_at")
+
+    def __init__(self, sock: socket.socket, rid_n: int) -> None:
+        self.sock = sock
+        self.rid_n = rid_n
+        self.rid = f"synth-{rid_n:05d}"
+        self.out = bytearray()
+        self.inbuf = bytearray()
+        self.need: Optional[int] = None  # payload bytes still expected
+        self.t0 = 0
+        self.rtts_us: List[float] = []
+        self.rounds = 0
+        self.step = 100 + rid_n % 2  # within the step_lag tolerance
+        self.done = False
+        self.pending = False
+        self.next_at = 0.0
+        payload = json.dumps({
+            "type": "heartbeat", "replica_id": self.rid,
+            "timeout_ms": 5000, "hb_interval_ms": 1000,
+            "digest": _mk_digest(self.step, rid_n),
+        }, separators=(",", ":")).encode()
+        self.hb_frame = struct.pack(">I", len(payload)) + payload
+
+    def queue(self, obj: Dict[str, Any]) -> None:
+        payload = json.dumps(obj, separators=(",", ":")).encode()
+        self.out += struct.pack(">I", len(payload)) + payload
+        self.t0 = time.perf_counter_ns()
+
+    def queue_heartbeat(self) -> None:
+        self.out += self.hb_frame
+        self.t0 = time.perf_counter_ns()
+
+    def on_readable(self) -> int:
+        """Drains the socket; returns how many complete frames arrived."""
+        frames = 0
+        while True:
+            try:
+                chunk = self.sock.recv(65536)
+            except BlockingIOError:
+                break
+            if not chunk:
+                raise ConnectionError(f"{self.rid}: closed by lighthouse")
+            self.inbuf += chunk
+            while True:
+                if self.need is None:
+                    if len(self.inbuf) < 4:
+                        break
+                    self.need = struct.unpack(">I", self.inbuf[:4])[0]
+                    del self.inbuf[:4]
+                if len(self.inbuf) < self.need:
+                    break
+                del self.inbuf[:self.need]  # response content not needed
+                self.need = None
+                frames += 1
+            if len(chunk) < 65536:
+                break
+        return frames
+
+    def on_writable(self) -> None:
+        while self.out:
+            try:
+                n = self.sock.send(self.out)
+            except BlockingIOError:
+                return
+            del self.out[:n]
+
+
+def connect_fleet(addr: str, n: int, batch: int = 64) -> List[Conn]:
+    """N nonblocking connections, batched under the listener's backlog
+    (128) so a 1024-strong fleet doesn't SYN-flood its own lighthouse."""
+    host, port = _net.parse_addr(addr)
+    conns: List[Conn] = []
+    for lo in range(0, n, batch):
+        pending: Dict[int, Conn] = {}
+        sel = selectors.DefaultSelector()
+        for i in range(lo, min(lo + batch, n)):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setblocking(False)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                s.connect((host, port))
+            except BlockingIOError:
+                pass
+            c = Conn(s, i)
+            pending[s.fileno()] = c
+            sel.register(s, selectors.EVENT_WRITE, c)
+        deadline = time.monotonic() + 30
+        while pending and time.monotonic() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                c = key.data
+                err = c.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+                if err:
+                    raise ConnectionError(
+                        f"{c.rid}: connect failed: {os.strerror(err)}")
+                sel.unregister(c.sock)
+                pending.pop(c.sock.fileno(), None)
+                conns.append(c)
+        sel.close()
+        if pending:
+            raise TimeoutError(
+                f"{len(pending)} connects unfinished in batch at {lo}")
+    return conns
+
+
+def _pump(sel: selectors.BaseSelector, conns: List[Conn],
+          on_frame, deadline: float) -> None:
+    """Shared event-loop core: flush writes, deliver frames to
+    ``on_frame(conn)`` until every conn reports done or the deadline."""
+    while time.monotonic() < deadline:
+        if all(c.done for c in conns):
+            return
+        for key, mask in sel.select(timeout=0.5):
+            c = key.data
+            if mask & selectors.EVENT_WRITE:
+                c.on_writable()
+                if not c.out:
+                    sel.modify(c.sock, selectors.EVENT_READ, c)
+            if mask & selectors.EVENT_READ:
+                for _ in range(c.on_readable()):
+                    on_frame(c)
+                if c.out:
+                    sel.modify(
+                        c.sock,
+                        selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+    undone = sum(1 for c in conns if not c.done)
+    raise TimeoutError(f"phase timed out with {undone} conns unfinished")
+
+
+def heartbeat_phase(conns: List[Conn], rounds: int,
+                    timeout_s: float = 300.0) -> Dict[str, Any]:
+    """Every replica sends ``rounds`` digest-carrying heartbeats, one in
+    flight per connection; per-request RTTs are pooled fleet-wide."""
+    sel = selectors.DefaultSelector()
+    for c in conns:
+        c.rtts_us, c.rounds, c.done = [], 0, False
+        c.queue_heartbeat()
+        sel.register(c.sock, selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+
+    def on_frame(c: Conn) -> None:
+        c.rtts_us.append((time.perf_counter_ns() - c.t0) / 1e3)
+        c.rounds += 1
+        if c.rounds >= rounds:
+            c.done = True
+        else:
+            c.queue_heartbeat()
+
+    _pump(sel, conns, on_frame, time.monotonic() + timeout_s)
+    sel.close()
+    rtts = [v for c in conns for v in c.rtts_us]
+    return {"n": len(rtts), "p50_us": round(_pct(rtts, 0.50)),
+            "p95_us": round(_pct(rtts, 0.95))}
+
+
+def quorum_phase(conns: List[Conn], timeout_s: float = 300.0) -> Dict[str, Any]:
+    """All N replicas request one quorum (the lighthouse was started with
+    ``min_replicas=N``); latency is first-send -> own response."""
+    sel = selectors.DefaultSelector()
+    for c in conns:
+        c.rtts_us, c.done = [], False
+        c.queue({
+            "type": "quorum", "timeout_ms": int(timeout_s * 1000),
+            "requester": {
+                "replica_id": c.rid, "address": f"addr-{c.rid}",
+                "store_address": "", "step": c.step, "world_size": 1,
+                "shrink_only": False, "commit_failures": 0, "data": {},
+            },
+        })
+        sel.register(c.sock, selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+
+    def on_frame(c: Conn) -> None:
+        c.rtts_us.append((time.perf_counter_ns() - c.t0) / 1e3)
+        c.done = True
+
+    t0 = time.monotonic()
+    _pump(sel, conns, on_frame, t0 + timeout_s + 30)
+    sel.close()
+    lat = [v for c in conns for v in c.rtts_us]
+    return {"n": len(lat), "p50_us": round(_pct(lat, 0.50)),
+            "p95_us": round(_pct(lat, 0.95)),
+            "formation_ms": round((time.monotonic() - t0) * 1e3)}
+
+
+def http_phase(conns: List[Conn], addr: str, probes: int,
+               concurrency: int = 4,
+               paths=("/fleet.json", "/metrics", "/status.json"),
+               timeout_s: float = 600.0) -> Dict[str, Dict[str, Any]]:
+    """Serve-latency probes WHILE the whole fleet keeps heartbeating.
+
+    The churn is paced to ~1000 heartbeats/s total (each replica on an
+    even stagger): enough write pressure that every probe races live
+    table mutations, but below the point where the one shared core
+    measures its own run queue instead of the serve path.
+
+    Each endpoint is probed by ``concurrency`` pollers at once — the
+    realistic consumer pattern (obs_top + obs_export + operators all
+    polling the same lighthouse), and exactly the load the snapshot
+    cache exists for: one rebuild per staleness window amortized across
+    every reader, where the uncached path pays a full O(N) rebuild per
+    request. Latency is request-flushed -> EOF (``Connection: close``)."""
+    host, port = _net.parse_addr(addr)
+    n = len(conns)
+    hb_interval = max(0.05, n / 1000.0)
+    sel = selectors.DefaultSelector()
+    t_start = time.monotonic()
+    for i, c in enumerate(conns):
+        c.pending = False
+        c.next_at = t_start + i * hb_interval / n
+        sel.register(c.sock, selectors.EVENT_READ, c)
+
+    def start_probe(path: str) -> Dict[str, Any]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            s.connect((host, port))
+        except BlockingIOError:
+            pass
+        probe = {
+            "sock": s, "path": path, "t0": 0, "nread": 0,
+            "out": bytearray(f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                             f"Connection: close\r\n\r\n".encode()),
+        }
+        sel.register(s, selectors.EVENT_READ | selectors.EVENT_WRITE, probe)
+        return probe
+
+    results: Dict[str, List[float]] = {}
+    deadline = time.monotonic() + timeout_s
+    for path in paths:
+        lats: List[float] = []
+        results[path] = lats
+        todo = probes
+        active: List[Dict[str, Any]] = []
+        while (todo or active) and time.monotonic() < deadline:
+            while todo and len(active) < concurrency:
+                active.append(start_probe(path))
+                todo -= 1
+            now = time.monotonic()
+            for c in conns:
+                if not c.pending and now >= c.next_at:
+                    c.queue_heartbeat()
+                    c.pending = True
+                    sel.modify(
+                        c.sock,
+                        selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+            for key, mask in sel.select(timeout=0.02):
+                if isinstance(key.data, dict):
+                    probe = key.data
+                    s = probe["sock"]
+                    if mask & selectors.EVENT_WRITE and probe["out"]:
+                        try:
+                            sent = s.send(probe["out"])
+                            del probe["out"][:sent]
+                        except BlockingIOError:
+                            pass
+                        if not probe["out"]:
+                            probe["t0"] = time.perf_counter_ns()
+                            sel.modify(s, selectors.EVENT_READ, probe)
+                    if mask & selectors.EVENT_READ:
+                        try:
+                            chunk = s.recv(65536)
+                        except BlockingIOError:
+                            continue
+                        if chunk:
+                            probe["nread"] += len(chunk)
+                            continue
+                        # EOF: response complete.
+                        if probe["nread"] == 0:
+                            raise ConnectionError(
+                                f"empty HTTP response for {probe['path']}")
+                        lats.append(
+                            (time.perf_counter_ns() - probe["t0"]) / 1e3)
+                        sel.unregister(s)
+                        s.close()
+                        active.remove(probe)
+                    continue
+                c = key.data
+                if mask & selectors.EVENT_WRITE:
+                    c.on_writable()
+                    if not c.out:
+                        sel.modify(c.sock, selectors.EVENT_READ, c)
+                if mask & selectors.EVENT_READ:
+                    for _ in range(c.on_readable()):
+                        c.pending = False
+                        c.next_at = time.monotonic() + hb_interval
+        if todo or active:
+            raise TimeoutError(
+                f"http phase: {todo} {path} probes unfinished")
+    sel.close()
+    return {
+        p.strip("/").replace(".", "_"): {
+            "n": len(v), "p50_us": round(_pct(v, 0.50)),
+            "p95_us": round(_pct(v, 0.95)),
+        }
+        for p, v in results.items()
+    }
+
+
+def close_fleet(conns: List[Conn]) -> None:
+    for c in conns:
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+
+
+def run_fleet(n: int, rounds: int, probes: int,
+              fleet_snap_ms: int = 100,
+              concurrency: int = 4) -> Dict[str, Any]:
+    """One full ladder rung: spawn a lighthouse sized for N, run the
+    heartbeat / quorum / http phases, sample lighthouse CPU per phase."""
+    server = LighthouseServer(
+        min_replicas=n, join_timeout_ms=120_000, quorum_tick_ms=50,
+        heartbeat_timeout_ms=120_000, fleet_snap_ms=fleet_snap_ms,
+    )
+    pid = server._server._proc.pid
+    out: Dict[str, Any] = {"n": n, "fleet_snap_ms": fleet_snap_ms}
+    try:
+        conns = connect_fleet(server.address(), n)
+        try:
+            cpu: Dict[str, Any] = {}
+            for name, fn in (
+                ("heartbeat", lambda: heartbeat_phase(conns, rounds)),
+                ("quorum", lambda: quorum_phase(conns)),
+                ("http", lambda: http_phase(
+                    conns, server.address(), probes, concurrency)),
+            ):
+                c0, w0 = _proc_cpu_s(pid), time.monotonic()
+                out[name] = fn()
+                cpu[name] = {
+                    "cpu_s": round(_proc_cpu_s(pid) - c0, 3),
+                    "wall_s": round(time.monotonic() - w0, 3),
+                }
+            out["lighthouse_cpu"] = cpu
+        finally:
+            close_fleet(conns)
+    finally:
+        server.shutdown()
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--sizes", type=int, nargs="+", default=None,
+                   help="fleet ladder (default 64 256 1024)")
+    p.add_argument("--rounds", type=int, default=10,
+                   help="heartbeats per replica per fleet (default 10)")
+    p.add_argument("--probes", type=int, default=40,
+                   help="HTTP probes per endpoint per fleet (default 40)")
+    p.add_argument("--http-concurrency", type=int, default=4,
+                   help="concurrent pollers per endpoint (default 4)")
+    p.add_argument("--quick", action="store_true",
+                   help="CI lane: N=64 only, no before/after experiment")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_FLEET.json"))
+    args = p.parse_args(argv)
+    sizes = args.sizes or ([64] if args.quick else [64, 256, 1024])
+
+    report: Dict[str, Any] = {
+        "schema": 1, "quick": bool(args.quick),
+        "rounds": args.rounds, "probes": args.probes,
+        "http_concurrency": args.http_concurrency,
+        "budgets": {str(n): BUDGETS_US.get(n) for n in sizes},
+        "fleets": {},
+    }
+    failures: List[str] = []
+
+    for n in sizes:
+        print(f"[fleet_load] N={n}: spawning lighthouse + "
+              f"{n} synthetic replicas", flush=True)
+        res = run_fleet(n, args.rounds, args.probes,
+                        concurrency=args.http_concurrency)
+        report["fleets"][str(n)] = res
+        print(f"[fleet_load] N={n}: hb p95={res['heartbeat']['p95_us']}us "
+              f"quorum formation={res['quorum']['formation_ms']}ms "
+              f"fleet.json p95={res['http']['fleet_json']['p95_us']}us",
+              flush=True)
+        budget = BUDGETS_US.get(n)
+        if budget:
+            if res["heartbeat"]["p95_us"] > budget["heartbeat_p95_us"]:
+                failures.append(
+                    f"N={n}: heartbeat p95 {res['heartbeat']['p95_us']}us "
+                    f"> budget {budget['heartbeat_p95_us']}us")
+            if (res["http"]["fleet_json"]["p95_us"]
+                    > budget["fleet_json_p95_us"]):
+                failures.append(
+                    f"N={n}: /fleet.json p95 "
+                    f"{res['http']['fleet_json']['p95_us']}us > budget "
+                    f"{budget['fleet_json_p95_us']}us")
+
+    if not args.quick:
+        # Before/after at the largest N: the same probe mix with the
+        # snapshot cache disabled, i.e. the pre-rework serve path that
+        # rebuilt the full JSON for every request.
+        n = max(sizes)
+        print(f"[fleet_load] N={n}: before/after (fleet_snap_ms=0)",
+              flush=True)
+        before = run_fleet(n, args.rounds, args.probes, fleet_snap_ms=0,
+                           concurrency=args.http_concurrency)
+        after = report["fleets"][str(n)]
+        b95 = before["http"]["fleet_json"]["p95_us"]
+        a95 = after["http"]["fleet_json"]["p95_us"]
+        speedup = b95 / a95 if a95 else float("inf")
+        report["before_after"] = {
+            "n": n,
+            "fleet_json_p95_us_uncached": b95,
+            "fleet_json_p95_us_cached": a95,
+            "speedup": round(speedup, 2),
+            "min_speedup": MIN_SPEEDUP,
+        }
+        print(f"[fleet_load] /fleet.json p95 at N={n}: uncached={b95}us "
+              f"cached={a95}us speedup={speedup:.2f}x", flush=True)
+        if speedup < MIN_SPEEDUP:
+            failures.append(
+                f"N={n}: cached /fleet.json speedup {speedup:.2f}x "
+                f"< required {MIN_SPEEDUP}x")
+
+    report["pass"] = not failures
+    report["failures"] = failures
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[fleet_load] wrote {args.out}", flush=True)
+    for msg in failures:
+        print(f"[fleet_load] BUDGET FAIL: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

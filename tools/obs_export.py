@@ -119,10 +119,20 @@ def scrape_fleet(client: LighthouseClient,
         return None
 
 
-def render_fleet_prometheus(fleet: Dict[str, Any]) -> str:
+def render_fleet_prometheus(fleet: Dict[str, Any],
+                            max_replicas: Optional[int] = None) -> str:
     """Prometheus gauges from the lighthouse's live fleet table: per-replica
     straggler/step-rate/goodput plus fleet-wide aggregates and the anomaly
-    counter monitoring should alert on."""
+    counter monitoring should alert on.
+
+    Label-cardinality bound: above ``max_replicas`` fleet rows (default
+    ``TORCHFT_EXPORT_MAX_REPLICAS``, shared with the lighthouse's own
+    /metrics), per-replica series are emitted only for anomalous/straggler
+    replicas — a 1024-replica fleet scrapes as aggregates plus the rows a
+    pager rule would actually fire on, with a suppressed-count gauge naming
+    what was collapsed."""
+    if max_replicas is None:
+        max_replicas = knobs.get_int("TORCHFT_EXPORT_MAX_REPLICAS")
     lines = []
 
     def header(name: str, help_: str) -> None:
@@ -133,7 +143,15 @@ def render_fleet_prometheus(fleet: Dict[str, Any]) -> str:
         return str(s).replace("\\", "\\\\").replace('"', '\\"')
 
     agg = fleet.get("agg") or {}
-    replicas = fleet.get("replicas") or {}
+    all_replicas = fleet.get("replicas") or {}
+    capped = len(all_replicas) > max_replicas
+    if capped:
+        replicas = {
+            rid: r for rid, r in all_replicas.items()
+            if r.get("straggler") or r.get("flags")
+        }
+    else:
+        replicas = all_replicas
     header("torchft_exporter_fleet_replicas",
            "Replicas in the lighthouse fleet table.")
     lines.append(f"torchft_exporter_fleet_replicas {int(agg.get('n', 0))}")
@@ -145,6 +163,16 @@ def render_fleet_prometheus(fleet: Dict[str, Any]) -> str:
            "Anomalies detected since lighthouse boot (rise edges).")
     lines.append("torchft_exporter_fleet_anomalies_total "
                  f"{int(fleet.get('anomaly_seq', 0))}")
+    header("torchft_exporter_fleet_anomalies_dropped",
+           "Anomaly records evicted from the lighthouse ring "
+           "(feed incomplete when > 0).")
+    lines.append("torchft_exporter_fleet_anomalies_dropped "
+                 f"{int(agg.get('anomalies_dropped', 0))}")
+    header("torchft_exporter_replicas_suppressed",
+           "Healthy replicas collapsed into aggregates by the "
+           "TORCHFT_EXPORT_MAX_REPLICAS cardinality bound.")
+    lines.append("torchft_exporter_replicas_suppressed "
+                 f"{len(all_replicas) - len(replicas)}")
     if agg.get("median_rate") is not None:
         header("torchft_exporter_fleet_median_step_rate",
                "Median committed-steps-per-second across digest replicas.")
@@ -220,6 +248,29 @@ def journal_anomalies(journal: Optional[EventLog],
                 detail=rec.get("detail"),
             )
     return cursor
+
+
+def journal_overflow(journal: Optional[EventLog],
+                     fleet: Optional[Dict[str, Any]],
+                     last_dropped: int) -> int:
+    """Journal a single ``anomaly_overflow`` event on the rise edge of the
+    lighthouse's anomaly-ring drop counter; returns the new high-water mark.
+    One event per observed rise (not per dropped record): the counter's
+    delta rides the event, so the journal stays bounded even when the ring
+    churns thousands of drops between scrapes."""
+    if fleet is None:
+        return last_dropped
+    agg = fleet.get("agg") or {}
+    dropped = int(agg.get("anomalies_dropped", 0))
+    if dropped > last_dropped:
+        if journal is not None:
+            journal.emit(
+                "anomaly_overflow",
+                dropped_total=dropped,
+                new_drops=dropped - last_dropped,
+            )
+        return dropped
+    return last_dropped
 
 
 def latest_native_counters(
@@ -414,6 +465,7 @@ def main(argv: Optional[list] = None) -> int:
             fleet = scrape_fleet(client)
             if fleet is not None:
                 journal_anomalies(journal, fleet, 0)
+                journal_overflow(journal, fleet, 0)
                 sys.stdout.write(render_fleet_prometheus(fleet))
         if args.journal:
             sys.stdout.write(
@@ -438,6 +490,7 @@ def main(argv: Optional[list] = None) -> int:
 
     scrapes = 0
     anomaly_cursor = 0
+    overflow_mark = 0
     try:
         while True:
             try:
@@ -448,6 +501,9 @@ def main(argv: Optional[list] = None) -> int:
                     journal.emit("lighthouse_status", **sample)
                 anomaly_cursor = journal_anomalies(
                     journal, fleet, anomaly_cursor
+                )
+                overflow_mark = journal_overflow(
+                    journal, fleet, overflow_mark
                 )
                 scrapes += 1
                 if args.max_scrapes and scrapes >= args.max_scrapes:

@@ -18,6 +18,11 @@ Usage::
 ``--check`` validates the rendered frame against the fetched JSON (every
 replica rendered, stragglers marked, aggregate line consistent) and exits
 non-zero on a mismatch — the CI fleet lane uses it as a render smoke.
+``--top N`` keeps the dashboard usable on O(1000)-replica fleets: rows
+sort worst-first (anomaly flags, then step lag behind the fleet median,
+then slowest rate) and only the N worst render, with a footer counting
+the healthy rows left out. ``--top 0`` (default) renders every replica
+sorted by id, exactly as before.
 
 Env: ``TORCHFT_LIGHTHOUSE`` is the default for ``--lighthouse``.
 """
@@ -78,11 +83,40 @@ def _bw_summary(digest: Dict[str, Any]) -> str:
     return f"{min(vals):.2f}"
 
 
-def render(fleet: Dict[str, Any], color: bool = False) -> str:
-    """One full frame of the dashboard as a string (no clear escape)."""
+def sort_worst_first(replicas: Dict[str, Any],
+                     agg: Dict[str, Any]) -> List[str]:
+    """Replica ids ordered worst-first: most anomaly flags (a straggler
+    counts as one), then largest step lag behind the fleet median, then
+    slowest rate; id breaks ties so the order is deterministic."""
+    med_step = agg.get("median_step")
+
+    def key(rid: str):
+        r = replicas[rid] or {}
+        flags = r.get("flags") or []
+        severity = len(flags) + (1 if r.get("straggler") else 0)
+        dg = r.get("digest") or {}
+        step = dg.get("step")
+        lag = 0.0
+        if med_step is not None and step is not None:
+            lag = float(med_step) - float(step)
+        rate = dg.get("rate")
+        rate = float(rate) if rate is not None else float("inf")
+        return (-severity, -lag, rate, str(rid))
+
+    return sorted(replicas, key=key)
+
+
+def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
+    """One full frame of the dashboard as a string (no clear escape).
+    ``top > 0``: worst-first order, truncated to ``top`` rows."""
     replicas = fleet.get("replicas") or {}
     agg = fleet.get("agg") or {}
     anomalies = fleet.get("anomalies") or []
+    if top > 0:
+        order = sort_worst_first(replicas, agg)[:top]
+    else:
+        order = sorted(replicas)
+    hidden = len(replicas) - len(order)
 
     def paint(s: str, code: str) -> str:
         return f"{code}{s}{ANSI_RESET}" if color else s
@@ -94,13 +128,16 @@ def render(fleet: Dict[str, Any], color: bool = False) -> str:
         f"stragglers={int(agg.get('stragglers', 0))} "
         f"median_rate={_fmt(agg.get('median_rate'), '{:.3f}')}/s "
         f"median_step={_fmt(agg.get('median_step'), '{:.0f}')} "
-        f"anomalies={int(fleet.get('anomaly_seq', 0))}",
+        f"anomalies={int(fleet.get('anomaly_seq', 0))}"
+        + (f" dropped={int(agg.get('anomalies_dropped', 0))}"
+           if agg.get("anomalies_dropped") else "")
+        + (f" showing={len(order)}/{len(replicas)}" if hidden > 0 else ""),
         ANSI_BOLD))
     header = (f"{'REPLICA':<20} {'STEP':>7} {'RATE/s':>7} {'GOOD%':>6} "
               f"{'Q95ms':>7} {'H95ms':>7} {'C95ms':>7} {'A95ms':>7} "
               f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7}  FLAGS")
     lines.append(paint(header, ANSI_BOLD))
-    for rid in sorted(replicas):
+    for rid in order:
         r = replicas[rid]
         dg = r.get("digest") or {}
         flags = sorted(r.get("flags") or [])
@@ -130,6 +167,8 @@ def render(fleet: Dict[str, Any], color: bool = False) -> str:
         lines.append(row)
     if not replicas:
         lines.append("  (no replicas heartbeating yet)")
+    if hidden > 0:
+        lines.append(f"  (+{hidden} more replicas below the --top cut)")
     if anomalies:
         lines.append("")
         lines.append(paint("recent anomalies:", ANSI_BOLD))
@@ -142,31 +181,42 @@ def render(fleet: Dict[str, Any], color: bool = False) -> str:
     return "\n".join(lines) + "\n"
 
 
-def check_frame(fleet: Dict[str, Any], frame: str) -> List[str]:
+def check_frame(fleet: Dict[str, Any], frame: str,
+                top: int = 0) -> List[str]:
     """Cross-checks a rendered frame against the JSON it came from.
-    Returns a list of problems (empty = pass)."""
+    Returns a list of problems (empty = pass). With ``top > 0`` only the
+    worst-first prefix must render (each with its tags), the truncation
+    footer must count the rest, and the worst offenders — every flagged
+    replica that fits in ``top`` rows — must not be cut."""
     problems: List[str] = []
     replicas = fleet.get("replicas") or {}
-    for rid in replicas:
+    agg = fleet.get("agg") or {}
+    if top > 0:
+        expected = sort_worst_first(replicas, agg)[:top]
+        hidden = len(replicas) - len(expected)
+        if hidden > 0 and f"(+{hidden} more replicas" not in frame:
+            problems.append(
+                f"{hidden} replicas were cut but no truncation footer")
+    else:
+        expected = list(replicas)
+    frame_lines = frame.splitlines()
+    for rid in expected:
         shown = str(rid)[:20]
-        if not any(ln.startswith(shown) for ln in frame.splitlines()):
+        if not any(ln.startswith(shown) for ln in frame_lines):
             problems.append(f"replica {rid!r} missing from rendered frame")
             continue
         if replicas[rid].get("straggler"):
-            row = next(ln for ln in frame.splitlines()
-                       if ln.startswith(shown))
+            row = next(ln for ln in frame_lines if ln.startswith(shown))
             if "STRAGGLER" not in row:
                 problems.append(
                     f"replica {rid!r} is a straggler but its row has no "
                     f"STRAGGLER tag")
         for kind in replicas[rid].get("flags") or []:
-            row = next(ln for ln in frame.splitlines()
-                       if ln.startswith(shown))
+            row = next(ln for ln in frame_lines if ln.startswith(shown))
             if kind not in row:
                 problems.append(
                     f"replica {rid!r} flag {kind!r} not rendered")
-    agg = fleet.get("agg") or {}
-    head = frame.splitlines()[0] if frame else ""
+    head = frame_lines[0] if frame_lines else ""
     if f"replicas={int(agg.get('n', 0))}" not in head:
         problems.append("aggregate replica count missing from header")
     if f"stragglers={int(agg.get('stragglers', 0))}" not in head:
@@ -188,16 +238,19 @@ def main(argv: Optional[list] = None) -> int:
                         "and exit non-zero on mismatch")
     p.add_argument("--max-frames", type=int, default=0,
                    help="exit after N frames (0 = run until interrupted)")
+    p.add_argument("--top", type=int, default=0,
+                   help="show only the N worst replicas (flags, then step "
+                        "lag, then rate); 0 = all, sorted by id")
     args = p.parse_args(argv)
     if not args.lighthouse:
         p.error("--lighthouse / $TORCHFT_LIGHTHOUSE is required")
 
     if args.once:
         fleet = fetch_fleet(args.lighthouse)
-        frame = render(fleet, color=False)
+        frame = render(fleet, color=False, top=args.top)
         sys.stdout.write(frame)
         if args.check:
-            problems = check_frame(fleet, frame)
+            problems = check_frame(fleet, frame, top=args.top)
             for prob in problems:
                 print(f"CHECK FAIL: {prob}", file=sys.stderr)
             return 1 if problems else 0
@@ -209,7 +262,7 @@ def main(argv: Optional[list] = None) -> int:
         while True:
             try:
                 fleet = fetch_fleet(args.lighthouse)
-                frame = render(fleet, color=color)
+                frame = render(fleet, color=color, top=args.top)
             except Exception as e:  # noqa: BLE001 - keep polling
                 frame = f"fleet poll failed: {e}\n"
             sys.stdout.write((ANSI_HOME_CLEAR if color else "") + frame)

@@ -31,6 +31,11 @@
 #                                       # it straggler WHILE running, obs_top
 #                                       # --once --check must render, digest
 #                                       # heartbeat overhead A/B must be <1%
+#        bash tools/suite_gate.sh fleetload # synthetic-fleet load harness,
+#                                       # quick mode: N=64 heartbeat/quorum/
+#                                       # HTTP latency vs stated budgets ->
+#                                       # BENCH_FLEET.json (full O(1000)
+#                                       # ladder: run fleet_load.py directly)
 #        bash tools/suite_gate.sh lint  # contract linter: dual-language
 #                                       # invariants (golden constants, enums,
 #                                       # ABI, RPC surface, event kinds, env
@@ -70,6 +75,12 @@ fi
 if [ "${1:-}" = "fleet" ]; then
   echo "== fleet smoke: live straggler detection + obs_top + digest A/B =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/obs_fleet_smoke.py
+fi
+
+if [ "${1:-}" = "fleetload" ]; then
+  echo "== fleetload: synthetic N=64 fleet vs latency budgets =="
+  exec timeout 600 env JAX_PLATFORMS=cpu python tools/fleet_load.py \
+    --quick --out BENCH_FLEET_quick.json
 fi
 
 if [ "${1:-}" = "lint" ]; then

@@ -15,12 +15,18 @@
 static const char* kUsage =
     "usage: lighthouse --min-replicas N [--bind-host H] [--port P]\n"
     "                  [--join-timeout-ms N] [--quorum-tick-ms N]\n"
-    "                  [--heartbeat-timeout-ms N]\n";
+    "                  [--heartbeat-timeout-ms N] [--fleet-snap-ms N]\n";
 
 int main(int argc, char** argv) {
   std::string bind_host = "0.0.0.0";
   int port = 29510;
   tft::LighthouseOpts opts;
+  // Served-snapshot staleness bound for /fleet.json (the flag wins over the
+  // env knob; 0 disables caching and rebuilds per request).
+  opts.fleet_snap_ms = 100;
+  const char* snap_env = std::getenv("TORCHFT_FLEET_SNAP_MS");
+  if (snap_env != nullptr && *snap_env != '\0')
+    opts.fleet_snap_ms = std::stoll(snap_env);
   bool have_min = false;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -44,6 +50,8 @@ int main(int argc, char** argv) {
       opts.quorum_tick_ms = std::stoll(next());
     } else if (a == "--heartbeat-timeout-ms") {
       opts.heartbeat_timeout_ms = std::stoll(next());
+    } else if (a == "--fleet-snap-ms") {
+      opts.fleet_snap_ms = std::stoll(next());
     } else if (a == "--parent-pid") {
       tft::watch_parent(std::stoll(next()));
     } else {

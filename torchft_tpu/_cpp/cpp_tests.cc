@@ -1159,6 +1159,232 @@ static void test_native_abort_unblocks() {
   CHECK(es[0]->last_error().find("aborted") != std::string::npos);
 }
 
+static void test_latency_hist() {
+  // Bucket boundaries mirror telemetry._HIST_BOUNDS: bucket i covers
+  // samples <= 2^i us, with bisect_left semantics (an exact power of two
+  // lands in its own bucket, not the next).
+  CHECK_EQ(LatencyHist::bucket_of(0), 0);
+  CHECK_EQ(LatencyHist::bucket_of(1), 0);
+  CHECK_EQ(LatencyHist::bucket_of(2), 1);
+  CHECK_EQ(LatencyHist::bucket_of(3), 2);
+  CHECK_EQ(LatencyHist::bucket_of(4), 2);
+  CHECK_EQ(LatencyHist::bucket_of(5), 3);
+  CHECK_EQ(LatencyHist::bucket_of(int64_t{1} << 27), 27);
+  CHECK_EQ(LatencyHist::bucket_of((int64_t{1} << 27) + 1),
+           LatencyHist::kFinite);  // overflow
+  LatencyHist h;
+  LatencyHist::Snap empty = h.snapshot();
+  CHECK_EQ(LatencyHist::percentile_us(empty, 0.5), int64_t{0});
+  // A single occupied bucket answers every quantile with its upper bound
+  // (telemetry pins the same edge cases).
+  h.observe_us(100);  // -> bucket 7 (2^7 = 128)
+  LatencyHist::Snap one = h.snapshot();
+  CHECK_EQ(one.count, int64_t{1});
+  CHECK_EQ(LatencyHist::percentile_us(one, 0.0), int64_t{128});
+  CHECK_EQ(LatencyHist::percentile_us(one, 0.5), int64_t{128});
+  CHECK_EQ(LatencyHist::percentile_us(one, 0.99), int64_t{128});
+  // 90 fast + 10 slow: p50 reports the fast bucket, p95+ the slow one.
+  LatencyHist h2;
+  for (int i = 0; i < 90; i++) h2.observe_us(3);    // bucket 2 (bound 4)
+  for (int i = 0; i < 10; i++) h2.observe_us(5000);  // bucket 13 (8192)
+  LatencyHist::Snap s2 = h2.snapshot();
+  CHECK_EQ(s2.count, int64_t{100});
+  CHECK_EQ(LatencyHist::percentile_us(s2, 0.50), int64_t{4});
+  CHECK_EQ(LatencyHist::percentile_us(s2, 0.95), int64_t{8192});
+  // Overflow samples report the last finite bound.
+  LatencyHist h3;
+  h3.observe_us(int64_t{1} << 30);
+  CHECK_EQ(LatencyHist::percentile_us(h3.snapshot(), 0.5),
+           int64_t{1} << (LatencyHist::kFinite - 1));
+}
+
+static void test_median_tracker() {
+  // The incremental median must equal the old full-sort upper median
+  // sorted[n/2] after every operation of a deterministic insert/erase churn.
+  MedianTracker t;
+  std::vector<double> live;
+  uint64_t rng = 0x243f6a8885a308d3ull;  // fixed seed: deterministic test
+  auto next = [&rng]() {
+    rng ^= rng << 13;
+    rng ^= rng >> 7;
+    rng ^= rng << 17;
+    return rng;
+  };
+  for (int op = 0; op < 2000; op++) {
+    bool do_erase = !live.empty() && (next() % 3 == 0);
+    if (do_erase) {
+      size_t idx = next() % live.size();
+      t.erase(live[idx]);
+      live.erase(live.begin() + idx);
+    } else {
+      // Small value space so duplicates are common (the hard case).
+      double v = static_cast<double>(next() % 37) * 0.25;
+      t.insert(v);
+      live.push_back(v);
+    }
+    CHECK_EQ(t.size(), live.size());
+    if (!live.empty()) {
+      std::vector<double> sorted = live;
+      std::sort(sorted.begin(), sorted.end());
+      CHECK_EQ(t.median(), sorted[sorted.size() / 2]);
+    }
+  }
+  // Erasing an absent value is a no-op, not a crash.
+  MedianTracker t2;
+  t2.insert(1.0);
+  t2.erase(99.0);
+  CHECK_EQ(t2.size(), size_t(1));
+  CHECK_EQ(t2.median(), 1.0);
+}
+
+static Json fleet_heartbeat(const std::string& addr, const std::string& id,
+                            int64_t step, double rate) {
+  Json req = Json::object();
+  req["type"] = Json::of("heartbeat");
+  req["replica_id"] = Json::of(id);
+  req["hb_interval_ms"] = Json::of(int64_t(100));
+  Json d = Json::object();
+  d["v"] = Json::of(int64_t(1));
+  d["step"] = Json::of(step);
+  d["rate"] = Json::of(rate);
+  d["gp"] = Json::of(0.9);
+  d["cf"] = Json::of(int64_t(0));
+  req["digest"] = d;
+  return lighthouse_call(addr, req, 3000);
+}
+
+static Json fleet_fetch(const std::string& addr) {
+  Json req = Json::object();
+  req["type"] = Json::of("fleet");
+  return lighthouse_call(addr, req, 3000);
+}
+
+static void test_fleet_snapshot_cache() {
+  // fleet_snap_ms > 0: a mutation inside the staleness window is NOT
+  // visible (cached snapshot, same gen + ts_ms); after the window expires
+  // the next fetch rebuilds and the generation advances.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 50;
+  opt.heartbeat_timeout_ms = 5000;
+  opt.fleet_snap_ms = 200;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  CHECK(fleet_heartbeat(addr, "r0", 5, 1.0).get("ok").as_bool());
+  Json f1 = fleet_fetch(addr).get("fleet");
+  CHECK(f1.get("replicas").has("r0"));
+  CHECK_EQ(f1.get("snap_ms").as_int(), int64_t{200});
+  int64_t gen1 = f1.get("gen").as_int(-1);
+  CHECK(gen1 >= 1);
+
+  CHECK(fleet_heartbeat(addr, "r1", 5, 1.0).get("ok").as_bool());
+  Json f2 = fleet_fetch(addr).get("fleet");
+  // Served from cache: identical generation and build stamp, r1 invisible.
+  CHECK_EQ(f2.get("gen").as_int(-1), gen1);
+  CHECK_EQ(f2.get("ts_ms").as_int(), f1.get("ts_ms").as_int());
+  CHECK(!f2.get("replicas").has("r1"));
+
+  sleep_ms(250);  // let the staleness bound lapse
+  Json f3 = fleet_fetch(addr).get("fleet");
+  CHECK(f3.get("replicas").has("r1"));
+  CHECK(f3.get("gen").as_int(-1) > gen1);
+  CHECK_EQ(f3.get("agg").get("n").as_int(), int64_t{2});
+  CHECK(f3.get("agg").has("anomalies_dropped"));
+
+  // Hot-path histograms ride status.json: the heartbeats above must have
+  // been observed, and every named path must export the full stat dict.
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json st = lighthouse_call(addr, sreq, 3000).get("status");
+  CHECK(st.has("hist"));
+  Json hb = st.get("hist").get("heartbeat");
+  CHECK(hb.get("count").as_int() >= 2);
+  CHECK(hb.get("p95_us").as_int() >= 1);
+  for (const char* path : {"heartbeat", "quorum_compute", "anomaly_eval",
+                           "http", "fleet_snapshot"}) {
+    Json hj = st.get("hist").get(path);
+    CHECK(hj.has("count"));
+    CHECK(hj.has("p50_us"));
+    CHECK(hj.has("p99_us"));
+  }
+  lh.stop();
+
+  // fleet_snap_ms == 0 (the embedder/test default): every fetch rebuilds,
+  // so a write is visible on the very next read.
+  LighthouseOpts opt0 = opt;
+  opt0.fleet_snap_ms = 0;
+  Lighthouse lh0("127.0.0.1", 0, opt0);
+  CHECK(lh0.start());
+  std::string addr0 = lh0.address();
+  CHECK(fleet_heartbeat(addr0, "a", 1, 1.0).get("ok").as_bool());
+  Json g1 = fleet_fetch(addr0).get("fleet");
+  CHECK(g1.get("replicas").has("a"));
+  CHECK(fleet_heartbeat(addr0, "b", 1, 1.0).get("ok").as_bool());
+  Json g2 = fleet_fetch(addr0).get("fleet");
+  CHECK(g2.get("replicas").has("b"));
+  CHECK(g2.get("gen").as_int(-1) > g1.get("gen").as_int(-1));
+  lh0.stop();
+}
+
+static void test_fleet_snapshot_concurrent() {
+  // Pollers racing heartbeats across TTL expiries: the single-flight
+  // rebuild must keep every served payload internally consistent —
+  // agg.n and the replicas object are copied in one critical section,
+  // so they must agree within any one payload even while the table
+  // grows underneath. TSan exercises the rebuild_mu_/snap_mu_/mu_
+  // ordering here; a 5 ms TTL forces many concurrent expiries.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 50;
+  opt.heartbeat_timeout_ms = 5000;
+  opt.fleet_snap_ms = 5;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+  CHECK(fleet_heartbeat(addr, "w0", 1, 1.0).get("ok").as_bool());
+
+  std::atomic<int> bad{0};
+  std::atomic<int> fetched{0};
+  std::vector<std::thread> ts;
+  for (int w = 0; w < 2; w++) {
+    ts.emplace_back([&, w] {
+      for (int i = 0; i < 25; i++) {
+        char id[16];
+        std::snprintf(id, sizeof(id), "w%d_%d", w, i);
+        fleet_heartbeat(addr, id, i, 1.0);
+      }
+    });
+  }
+  for (int p = 0; p < 4; p++) {
+    ts.emplace_back([&] {
+      for (int i = 0; i < 40; i++) {
+        Json f = fleet_fetch(addr).get("fleet");
+        if (!f.has("agg") || !f.has("replicas")) {
+          bad.fetch_add(1);
+          continue;
+        }
+        int64_t n = f.get("agg").get("n").as_int(-1);
+        int64_t rows = static_cast<int64_t>(f.get("replicas").obj.size());
+        if (n != rows) bad.fetch_add(1);
+        fetched.fetch_add(1);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK_EQ(bad.load(), 0);
+  CHECK_EQ(fetched.load(), 4 * 40);
+  // Everything the writers sent eventually lands: one more fetch after
+  // the TTL lapses sees the full table.
+  sleep_ms(10);
+  Json last = fleet_fetch(addr).get("fleet");
+  CHECK_EQ(last.get("agg").get("n").as_int(), int64_t{51});
+  lh.stop();
+}
+
 int main() {
   test_split_host_port();
   test_json();
@@ -1171,6 +1397,10 @@ int main() {
   test_compute_quorum_results();
   test_force_recover_on_init();
   test_commit_failures_propagate();
+  test_latency_hist();
+  test_median_tracker();
+  test_fleet_snapshot_cache();
+  test_fleet_snapshot_concurrent();
   test_lighthouse_e2e();
   test_lighthouse_leave();
   test_manager_leave();

@@ -5,7 +5,9 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <sstream>
@@ -16,9 +18,26 @@
 
 namespace tft {
 
+namespace {
+// Steady-clock microseconds for the hot-path histograms (wall clock can
+// step; a latency sample must not).
+int64_t now_us_steady() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
 Lighthouse::Lighthouse(const std::string& bind_host, int port,
                        LighthouseOpts opts)
-    : bind_host_(bind_host), port_(port), opts_(opts) {}
+    : bind_host_(bind_host), port_(port), opts_(opts) {
+  // Shared with tools/obs_export.py (same knob, same default): above this
+  // many replicas, per-replica /metrics series collapse to aggregates +
+  // anomalous rows only, so a 1024-replica scrape stays bounded.
+  const char* em = std::getenv("TORCHFT_EXPORT_MAX_REPLICAS");
+  if (em != nullptr && *em != '\0') export_max_replicas_ = std::atoll(em);
+  if (export_max_replicas_ < 0) export_max_replicas_ = 0;
+}
 
 Lighthouse::~Lighthouse() { stop(); }
 
@@ -81,7 +100,9 @@ void Lighthouse::tick() {
   // before its step completes or its heartbeat resumes.
   fleet_scan_locked(now_ms());
   std::string reason;
+  int64_t q_t0 = now_us_steady();
   auto members = quorum_compute(now_ms(), state_, opts_, &reason);
+  hist_quorum_.observe_us(now_us_steady() - q_t0);
   if (!members) {
     if (reason != last_reason_ && !state_.participants.empty()) {
       fprintf(stderr, "[lighthouse] no quorum: %s\n", reason.c_str());
@@ -187,30 +208,40 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   const std::string type = req.get("type").as_str();
   Json resp = Json::object();
   if (type == "heartbeat") {
-    std::lock_guard<std::mutex> lk(mu_);
-    const std::string replica_id = req.get("replica_id").as_str();
-    // A drained replica's manager may have one heartbeat in flight when its
-    // leave lands; the tombstone keeps it from resurrecting the entry (which
-    // would stall the survivors' next quorum until heartbeat expiry).
-    if (!state_.left.count(replica_id)) {
-      int64_t now = now_ms();
-      state_.heartbeats[replica_id] = now;
-      // Heartbeats carry the manager address so drain_all can reach a
-      // replica that heartbeats but never registered a quorum.
-      const std::string addr = req.get("address").as_str();
-      if (!addr.empty()) state_.heartbeat_addrs[replica_id] = addr;
-      // Live fleet plane: fold the optional digest + declared cadence into
-      // the fleet table and run the digest-driven anomaly rules. Old
-      // clients send neither field; the row simply stays digest-less.
-      fleet_note_heartbeat(replica_id, req, now);
+    // Timed from before the lock: the histogram must show contention (the
+    // wait behind a /fleet.json rebuild was exactly the bug), not just the
+    // work done once inside.
+    int64_t hb_t0 = now_us_steady();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      const std::string replica_id = req.get("replica_id").as_str();
+      // A drained replica's manager may have one heartbeat in flight when
+      // its leave lands; the tombstone keeps it from resurrecting the entry
+      // (which would stall the survivors' next quorum until heartbeat
+      // expiry).
+      if (!state_.left.count(replica_id)) {
+        int64_t now = now_ms();
+        state_.heartbeats[replica_id] = now;
+        // Heartbeats carry the manager address so drain_all can reach a
+        // replica that heartbeats but never registered a quorum.
+        const std::string addr = req.get("address").as_str();
+        if (!addr.empty()) state_.heartbeat_addrs[replica_id] = addr;
+        // Live fleet plane: fold the optional digest + declared cadence into
+        // the fleet table and run the digest-driven anomaly rules. Old
+        // clients send neither field; the row simply stays digest-less.
+        fleet_note_heartbeat(replica_id, req, now);
+      }
     }
     resp["ok"] = Json::of(true);
+    hist_heartbeat_.observe_us(now_us_steady() - hb_t0);
     return resp;
   }
   if (type == "fleet") {
-    std::lock_guard<std::mutex> lk(mu_);
+    // Served from the generation-tagged cached snapshot — the framed twin
+    // of GET /fleet.json no longer rebuilds O(N) JSON under mu_.
+    auto snap = fleet_snapshot(now_ms());
     resp["ok"] = Json::of(true);
-    resp["fleet"] = fleet_json_locked(now_ms());
+    resp["fleet"] = snap->json;
     return resp;
   }
   if (type == "leave") {
@@ -228,7 +259,7 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
       state_.left.insert(replica_id);
       // A drained replica must not linger in the fleet table looking like
       // a straggler whose heartbeats stopped.
-      fleet_.erase(replica_id);
+      fleet_erase(replica_id);
     }
     fprintf(stderr, "[lighthouse] replica %s left gracefully\n",
             replica_id.c_str());
@@ -439,7 +470,35 @@ Json Lighthouse::status_json() {
   // Live-plane summary rides along so a status poller sees fleet health
   // without a second RPC; the full table stays on /fleet.json.
   s["fleet"] = fleet_summary_locked(now);
+  // Hot-path latency histograms (p50/p95/p99 in microseconds, upper-bound
+  // estimates from the log buckets — same semantics as telemetry
+  // span_percentiles on the Python side).
+  s["hist"] = hist_json();
   return s;
+}
+
+Json Lighthouse::hist_json() const {
+  struct Named {
+    const char* name;
+    const LatencyHist* h;
+  };
+  const Named hists[] = {
+      {"heartbeat", &hist_heartbeat_},   {"quorum_compute", &hist_quorum_},
+      {"anomaly_eval", &hist_anomaly_},  {"http", &hist_http_},
+      {"fleet_snapshot", &hist_snapshot_},
+  };
+  Json out = Json::object();
+  for (const auto& nh : hists) {
+    LatencyHist::Snap s = nh.h->snapshot();
+    Json h = Json::object();
+    h["count"] = Json::of(s.count);
+    h["sum_us"] = Json::of(s.sum_us);
+    h["p50_us"] = Json::of(LatencyHist::percentile_us(s, 0.50));
+    h["p95_us"] = Json::of(LatencyHist::percentile_us(s, 0.95));
+    h["p99_us"] = Json::of(LatencyHist::percentile_us(s, 0.99));
+    out[nh.name] = h;
+  }
+  return out;
 }
 
 // ---------------------------------------------------------------------------
@@ -455,13 +514,8 @@ constexpr int64_t kFleetStepLag = 2;         // step < median-lag flags
 constexpr int64_t kFleetJitterMult = 8;      // budget = mult * cadence
 constexpr int64_t kFleetJitterFloorMs = 1000;
 constexpr int64_t kFleetEwmaWarmup = 5;      // gaps before EWMA budget counts
-
-// Upper median: with two replicas this is the HEALTHY one's value, which is
-// the right baseline for "relative slowdown vs the fleet".
-double fleet_median(std::vector<double> v) {
-  std::sort(v.begin(), v.end());
-  return v[v.size() / 2];
-}
+// (The old full-sort fleet_median lived here; the MedianTracker members in
+// lighthouse.hpp maintain the identical upper median incrementally.)
 }  // namespace
 
 int64_t Lighthouse::fleet_jitter_budget_ms(const FleetEntry& e) const {
@@ -478,7 +532,9 @@ void Lighthouse::fleet_set_flag(const std::string& replica_id, FleetEntry& e,
                                 const std::string& kind, int64_t now,
                                 Json detail) {
   e.straggler_until_ms = now + kFleetStickyMs;
+  fleet_gen_ += 1;  // sticky-window extension alone changes the table view
   if (e.flags.count(kind)) return;  // only the RISE edge is an anomaly
+  if (e.flags.empty()) flagged_ += 1;
   e.flags.insert(kind);
   anomaly_seq_ += 1;
   Json a = Json::object();
@@ -488,10 +544,56 @@ void Lighthouse::fleet_set_flag(const std::string& replica_id, FleetEntry& e,
   a["kind"] = Json::of(kind);
   a["detail"] = detail;
   anomalies_.push_back(a);
-  while (anomalies_.size() > kFleetAnomalyRing) anomalies_.pop_front();
+  while (anomalies_.size() > kFleetAnomalyRing) {
+    // At fleet scale the ring overflows routinely; a silent pop would make
+    // the anomaly feed look complete when it is not. The drop count rides
+    // /fleet.json + /metrics, and obs_export journals the rise edge.
+    anomalies_.pop_front();
+    anomalies_dropped_ += 1;
+  }
   fprintf(stderr, "[lighthouse] anomaly #%lld: %s on %s %s\n",
           static_cast<long long>(anomaly_seq_), kind.c_str(),
           replica_id.c_str(), detail.dump().c_str());
+}
+
+void Lighthouse::fleet_clear_flag(FleetEntry& e, const std::string& kind) {
+  if (e.flags.erase(kind) == 0) return;
+  if (e.flags.empty()) flagged_ -= 1;
+  fleet_gen_ += 1;
+}
+
+// Retire / fold one entry's digest contributions. Together these keep the
+// running aggregates exactly equal to a full-table recompute: every digest
+// row contributes its step and goodput, its rate only when > 0 (matching
+// the old scan's filter), and its commit-failure streak to the max-tracker.
+void Lighthouse::fleet_agg_remove(const FleetEntry& e) {
+  if (!e.has_digest) return;
+  double r = e.digest.get("rate").as_double(0.0);
+  if (r > 0.0) agg_rates_.erase(r);
+  agg_steps_.erase(static_cast<double>(e.digest.get("step").as_int(0)));
+  agg_gps_.erase(e.digest.get("gp").as_double(0.0));
+  auto it = agg_cfs_.find(e.digest.get("cf").as_int(0));
+  if (it != agg_cfs_.end()) agg_cfs_.erase(it);
+  n_digest_ -= 1;
+}
+
+void Lighthouse::fleet_agg_insert(const FleetEntry& e) {
+  if (!e.has_digest) return;
+  double r = e.digest.get("rate").as_double(0.0);
+  if (r > 0.0) agg_rates_.insert(r);
+  agg_steps_.insert(static_cast<double>(e.digest.get("step").as_int(0)));
+  agg_gps_.insert(e.digest.get("gp").as_double(0.0));
+  agg_cfs_.insert(e.digest.get("cf").as_int(0));
+  n_digest_ += 1;
+}
+
+void Lighthouse::fleet_erase(const std::string& replica_id) {
+  auto it = fleet_.find(replica_id);
+  if (it == fleet_.end()) return;
+  fleet_agg_remove(it->second);
+  if (!it->second.flags.empty()) flagged_ -= 1;
+  fleet_.erase(it);
+  fleet_gen_ += 1;
 }
 
 void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
@@ -516,6 +618,7 @@ void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
   }
   e.last_hb_ms = now;
   e.hb_count += 1;
+  fleet_gen_ += 1;
   int64_t declared = req.get("hb_interval_ms").as_int(0);
   if (declared > 0) e.hb_interval_ms = declared;
   if (!req.has("digest") || !req.get("digest").is_object()) return;
@@ -523,9 +626,15 @@ void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
   // Digest-driven rules run at ARRIVAL, against the fleet table as of this
   // heartbeat: given the same global digest sequence the flag/anomaly
   // sequence is identical, so a chaos replay reproduces its alerts.
+  // Bounded-cost contract: everything below is O(log N) — the medians the
+  // rules compare against come from the running trackers, never from a
+  // full-table rescan (tests/test_fleet.py pins tracker == recompute).
+  int64_t an_t0 = now_us_steady();
+  fleet_agg_remove(e);  // retire the previous digest's contributions
   e.digest = req.get("digest");
   e.has_digest = true;
   e.digest_ms = now;
+  fleet_agg_insert(e);
 
   int64_t cf = e.digest.get("cf").as_int(0);
   if (cf >= kFleetCommitStall) {
@@ -533,41 +642,34 @@ void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
     d["cf"] = Json::of(cf);
     fleet_set_flag(replica_id, e, "commit_stall", now, d);
   } else {
-    e.flags.erase("commit_stall");
+    fleet_clear_flag(e, "commit_stall");
   }
 
-  std::vector<double> rates, steps;
-  for (const auto& kv : fleet_) {
-    if (!kv.second.has_digest) continue;
-    double r = kv.second.digest.get("rate").as_double(0.0);
-    if (r > 0.0) rates.push_back(r);
-    steps.push_back(
-        static_cast<double>(kv.second.digest.get("step").as_int(0)));
-  }
   double own_rate = e.digest.get("rate").as_double(0.0);
-  if (rates.size() >= 2) {
-    double med = fleet_median(rates);
+  if (agg_rates_.size() >= 2) {
+    double med = agg_rates_.median();
     if (own_rate < kFleetSlowRateFrac * med) {
       Json d = Json::object();
       d["rate"] = Json::of(own_rate);
       d["median_rate"] = Json::of(med);
       fleet_set_flag(replica_id, e, "slow_rate", now, d);
     } else {
-      e.flags.erase("slow_rate");
+      fleet_clear_flag(e, "slow_rate");
     }
   }
   int64_t own_step = e.digest.get("step").as_int(0);
-  if (steps.size() >= 2) {
-    int64_t med = static_cast<int64_t>(fleet_median(steps));
+  if (agg_steps_.size() >= 2) {
+    int64_t med = static_cast<int64_t>(agg_steps_.median());
     if (own_step < med - kFleetStepLag) {
       Json d = Json::object();
       d["step"] = Json::of(own_step);
       d["median_step"] = Json::of(med);
       fleet_set_flag(replica_id, e, "step_lag", now, d);
     } else {
-      e.flags.erase("step_lag");
+      fleet_clear_flag(e, "step_lag");
     }
   }
+  hist_anomaly_.observe_us(now_us_steady() - an_t0);
 }
 
 void Lighthouse::fleet_scan_locked(int64_t now) {
@@ -588,19 +690,87 @@ void Lighthouse::fleet_scan_locked(int64_t now) {
       e.last_jitter_ms = now;
     } else if (e.flags.count("hb_jitter") &&
                now - e.last_jitter_ms > kFleetStickyMs) {
-      e.flags.erase("hb_jitter");
+      fleet_clear_flag(e, "hb_jitter");
     }
   }
 }
 
-Json Lighthouse::fleet_json_locked(int64_t now) {
+// Aggregate dict straight from the running trackers — O(1) medians/max plus
+// one allocation-free pass for the time-dependent straggler count. This is
+// the "agg" the property tests compare against a full recompute from the
+// row dicts in the same payload.
+Json Lighthouse::fleet_agg_locked(int64_t now) {
+  int64_t n_straggler = 0;
+  for (const auto& kv : fleet_)
+    if (!kv.second.flags.empty() || now < kv.second.straggler_until_ms)
+      n_straggler += 1;
+  Json agg = Json::object();
+  agg["n"] = Json::of(static_cast<int64_t>(fleet_.size()));
+  agg["n_digest"] = Json::of(n_digest_);
+  agg["stragglers"] = Json::of(n_straggler);
+  agg["median_rate"] = agg_rates_.size() == 0
+                           ? Json::null()
+                           : Json::of(agg_rates_.median());
+  agg["median_step"] =
+      agg_steps_.size() == 0
+          ? Json::null()
+          : Json::of(static_cast<int64_t>(agg_steps_.median()));
+  agg["median_goodput"] =
+      agg_gps_.size() == 0 ? Json::null() : Json::of(agg_gps_.median());
+  agg["max_commit_failures"] =
+      Json::of(agg_cfs_.empty() ? int64_t{0} : *agg_cfs_.rbegin());
+  agg["anomalies_dropped"] = Json::of(anomalies_dropped_);
+  return agg;
+}
+
+std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
+    int64_t now) {
+  // Bounded staleness: any cached payload younger than fleet_snap_ms is
+  // served as-is (fleet_snap_ms == 0 disables caching — the "before" mode
+  // the fleet_load harness benchmarks against).
+  if (opts_.fleet_snap_ms > 0) {
+    std::lock_guard<std::mutex> lk(snap_mu_);
+    if (snap_ && now >= snap_->built_ms &&
+        now - snap_->built_ms <= opts_.fleet_snap_ms)
+      return snap_;
+  }
+  // Single-flight rebuild: concurrent readers that all see a stale (or
+  // absent) snapshot would otherwise each pay the O(N) rebuild at once —
+  // a thundering herd that turns the cache off exactly when load peaks.
+  // One caller rebuilds; the rest block here, then re-check and serve the
+  // winner's result.
+  std::lock_guard<std::mutex> rebuild_lk(rebuild_mu_);
+  if (opts_.fleet_snap_ms > 0) {
+    std::lock_guard<std::mutex> lk(snap_mu_);
+    if (snap_ && now >= snap_->built_ms &&
+        now - snap_->built_ms <= opts_.fleet_snap_ms)
+      return snap_;
+  }
+  int64_t t0 = now_us_steady();
+  // Copy raw state under the hot lock; build + dump the JSON off it. The
+  // copy is the cheap part (row structs + small digest dicts); the O(N)
+  // string formatting that used to stall heartbeats happens unlocked.
+  std::vector<std::pair<std::string, FleetEntry>> rows;
+  std::deque<Json> anomalies;
+  Json agg;
+  int64_t gen, aseq;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    rows.assign(fleet_.begin(), fleet_.end());
+    anomalies = anomalies_;
+    agg = fleet_agg_locked(now);
+    gen = fleet_gen_;
+    aseq = anomaly_seq_;
+  }
+  auto snap = std::make_shared<FleetSnapshot>();
+  snap->gen = gen;
+  snap->built_ms = now;
   Json f = Json::object();
   f["ts_ms"] = Json::of(now);
+  f["gen"] = Json::of(gen);
+  f["snap_ms"] = Json::of(opts_.fleet_snap_ms);
   Json reps = Json::object();
-  std::vector<double> rates, steps, gps;
-  int64_t max_cf = 0;
-  int64_t n_digest = 0, n_straggler = 0;
-  for (const auto& kv : fleet_) {
+  for (const auto& kv : rows) {
     const FleetEntry& e = kv.second;
     Json r = Json::object();
     r["last_hb_age_ms"] = Json::of(now - e.last_hb_ms);
@@ -615,46 +785,28 @@ Json Lighthouse::fleet_json_locked(int64_t now) {
     if (now - e.last_hb_ms > opts_.heartbeat_timeout_ms)
       fl.push(Json::of("stale"));  // view-only: presence, not an anomaly
     r["flags"] = fl;
-    bool straggler = !e.flags.empty() || now < e.straggler_until_ms;
-    r["straggler"] = Json::of(straggler);
-    if (straggler) n_straggler += 1;
-    if (e.has_digest) {
-      n_digest += 1;
-      double rt = e.digest.get("rate").as_double(0.0);
-      if (rt > 0.0) rates.push_back(rt);
-      steps.push_back(
-          static_cast<double>(e.digest.get("step").as_int(0)));
-      gps.push_back(e.digest.get("gp").as_double(0.0));
-      int64_t cf = e.digest.get("cf").as_int(0);
-      if (cf > max_cf) max_cf = cf;
-    }
+    r["straggler"] =
+        Json::of(!e.flags.empty() || now < e.straggler_until_ms);
     reps[kv.first] = r;
   }
   f["replicas"] = reps;
-  Json agg = Json::object();
-  agg["n"] = Json::of(static_cast<int64_t>(fleet_.size()));
-  agg["n_digest"] = Json::of(n_digest);
-  agg["stragglers"] = Json::of(n_straggler);
-  agg["median_rate"] =
-      rates.empty() ? Json::null() : Json::of(fleet_median(rates));
-  agg["median_step"] =
-      steps.empty() ? Json::null()
-                    : Json::of(static_cast<int64_t>(fleet_median(steps)));
-  agg["median_goodput"] =
-      gps.empty() ? Json::null() : Json::of(fleet_median(gps));
-  agg["max_commit_failures"] = Json::of(max_cf);
   f["agg"] = agg;
   Json an = Json::array();
-  for (const auto& a : anomalies_) an.push(a);
+  for (const auto& a : anomalies) an.push(a);
   f["anomalies"] = an;
-  f["anomaly_seq"] = Json::of(anomaly_seq_);
-  return f;
+  f["anomaly_seq"] = Json::of(aseq);
+  snap->json = f;
+  snap->body = f.dump();
+  hist_snapshot_.observe_us(now_us_steady() - t0);
+  std::lock_guard<std::mutex> lk(snap_mu_);
+  snap_ = snap;
+  return snap_;
 }
 
 Json Lighthouse::fleet_summary_locked(int64_t now) {
-  Json fj = fleet_json_locked(now);
-  Json s = fj.get("agg");
-  s["anomaly_seq"] = fj.get("anomaly_seq");
+  Json s = fleet_agg_locked(now);
+  s["anomaly_seq"] = Json::of(anomaly_seq_);
+  s["gen"] = Json::of(fleet_gen_);
   return s;
 }
 
@@ -713,48 +865,113 @@ static std::string prom_escape(const std::string& s) {
 std::string Lighthouse::render_metrics() {
   // Prometheus text exposition (the reference lighthouse has only an HTML
   // dashboard; a scrapeable endpoint is what production monitoring needs).
-  std::lock_guard<std::mutex> lk(mu_);
-  int64_t now = now_ms();
+  // Scalars and minimal per-replica tuples are copied under mu_; all string
+  // formatting happens off the hot lock, so a scrape never stalls the
+  // heartbeat path behind O(N) text building.
+  struct FleetRow {
+    std::string id;
+    bool straggler = false;
+    bool has_rate = false;
+    double rate = 0.0;
+  };
+  int64_t now, quorum_id, quorum_gen, joins, leaves, aseq, adropped, gen;
+  size_t n_participants, n_members;
+  std::vector<std::pair<std::string, int64_t>> hb_ages;
+  std::vector<std::pair<std::string, int64_t>> member_steps;
+  std::vector<FleetRow> rows;
+  int64_t n_straggler = 0;
+  bool have_median = false;
+  double median_rate = 0.0;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    now = now_ms();
+    quorum_id = state_.quorum_id;
+    quorum_gen = quorum_gen_;
+    joins = joins_total_;
+    leaves = leaves_total_;
+    aseq = anomaly_seq_;
+    adropped = anomalies_dropped_;
+    gen = fleet_gen_;
+    n_participants = state_.participants.size();
+    n_members =
+        state_.prev_quorum ? state_.prev_quorum->participants.size() : 0;
+    hb_ages.reserve(state_.heartbeats.size());
+    for (const auto& kv : state_.heartbeats)
+      hb_ages.emplace_back(kv.first, now - kv.second);
+    if (state_.prev_quorum)
+      for (const auto& mem : state_.prev_quorum->participants)
+        member_steps.emplace_back(mem.replica_id, mem.step);
+    rows.reserve(fleet_.size());
+    for (const auto& kv : fleet_) {
+      FleetRow r;
+      r.id = kv.first;
+      r.straggler =
+          !kv.second.flags.empty() || now < kv.second.straggler_until_ms;
+      if (r.straggler) n_straggler += 1;
+      if (kv.second.has_digest) {
+        r.rate = kv.second.digest.get("rate").as_double(0.0);
+        r.has_rate = true;
+      }
+      rows.push_back(std::move(r));
+    }
+    if (agg_rates_.size() > 0) {
+      have_median = true;
+      median_rate = agg_rates_.median();
+    }
+  }
+  // Label-cardinality bound (TORCHFT_EXPORT_MAX_REPLICAS, shared with
+  // obs_export): above the cap, per-replica series are emitted only for
+  // anomalous/straggler replicas; healthy rows collapse into the aggregate
+  // gauges plus a suppressed-count so the scrape stays O(cap), not O(N).
+  const size_t cap = static_cast<size_t>(export_max_replicas_);
+  const bool capped = rows.size() > cap;
+  int64_t suppressed = 0;
   std::ostringstream m;
   m << "# HELP torchft_lighthouse_quorum_id Current quorum id.\n"
     << "# TYPE torchft_lighthouse_quorum_id gauge\n"
-    << "torchft_lighthouse_quorum_id " << state_.quorum_id << "\n";
+    << "torchft_lighthouse_quorum_id " << quorum_id << "\n";
   m << "# HELP torchft_lighthouse_quorum_generation Quorum broadcasts since "
        "boot.\n"
     << "# TYPE torchft_lighthouse_quorum_generation counter\n"
-    << "torchft_lighthouse_quorum_generation " << quorum_gen_ << "\n";
+    << "torchft_lighthouse_quorum_generation " << quorum_gen << "\n";
   m << "# HELP torchft_lighthouse_joins_total Members added across quorum "
        "transitions.\n"
     << "# TYPE torchft_lighthouse_joins_total counter\n"
-    << "torchft_lighthouse_joins_total " << joins_total_ << "\n";
+    << "torchft_lighthouse_joins_total " << joins << "\n";
   m << "# HELP torchft_lighthouse_leaves_total Members gone across quorum "
        "transitions.\n"
     << "# TYPE torchft_lighthouse_leaves_total counter\n"
-    << "torchft_lighthouse_leaves_total " << leaves_total_ << "\n";
+    << "torchft_lighthouse_leaves_total " << leaves << "\n";
   m << "# HELP torchft_lighthouse_participants Replicas currently waiting in "
        "the next quorum.\n"
     << "# TYPE torchft_lighthouse_participants gauge\n"
-    << "torchft_lighthouse_participants " << state_.participants.size()
-    << "\n";
+    << "torchft_lighthouse_participants " << n_participants << "\n";
   m << "# HELP torchft_lighthouse_quorum_members Members of the last "
        "delivered quorum.\n"
     << "# TYPE torchft_lighthouse_quorum_members gauge\n"
-    << "torchft_lighthouse_quorum_members "
-    << (state_.prev_quorum ? state_.prev_quorum->participants.size() : 0)
-    << "\n";
-  m << "# HELP torchft_lighthouse_heartbeat_age_ms Milliseconds since each "
-       "replica's last heartbeat.\n"
-    << "# TYPE torchft_lighthouse_heartbeat_age_ms gauge\n";
-  for (const auto& kv : state_.heartbeats)
-    m << "torchft_lighthouse_heartbeat_age_ms{replica=\""
-      << prom_escape(kv.first) << "\"} " << (now - kv.second) << "\n";
-  if (state_.prev_quorum) {
+    << "torchft_lighthouse_quorum_members " << n_members << "\n";
+  int64_t max_hb_age = 0;
+  for (const auto& kv : hb_ages)
+    if (kv.second > max_hb_age) max_hb_age = kv.second;
+  m << "# HELP torchft_lighthouse_heartbeat_age_max_ms Oldest replica "
+       "heartbeat age.\n"
+    << "# TYPE torchft_lighthouse_heartbeat_age_max_ms gauge\n"
+    << "torchft_lighthouse_heartbeat_age_max_ms " << max_hb_age << "\n";
+  if (!capped) {
+    m << "# HELP torchft_lighthouse_heartbeat_age_ms Milliseconds since "
+         "each replica's last heartbeat.\n"
+      << "# TYPE torchft_lighthouse_heartbeat_age_ms gauge\n";
+    for (const auto& kv : hb_ages)
+      m << "torchft_lighthouse_heartbeat_age_ms{replica=\""
+        << prom_escape(kv.first) << "\"} " << kv.second << "\n";
+  }
+  if (!member_steps.empty() && !capped) {
     m << "# HELP torchft_lighthouse_member_step Training step each quorum "
          "member reported.\n"
       << "# TYPE torchft_lighthouse_member_step gauge\n";
-    for (const auto& mem : state_.prev_quorum->participants)
+    for (const auto& kv : member_steps)
       m << "torchft_lighthouse_member_step{replica=\""
-        << prom_escape(mem.replica_id) << "\"} " << mem.step << "\n";
+        << prom_escape(kv.first) << "\"} " << kv.second << "\n";
   }
   // Live-plane alert gauges: straggler flags + the anomaly counter are
   // what a pager rule fires on; per-replica step rate + the fleet median
@@ -762,25 +979,42 @@ std::string Lighthouse::render_metrics() {
   m << "# HELP torchft_lighthouse_anomalies_total Anomaly rise-edges "
        "detected since boot.\n"
     << "# TYPE torchft_lighthouse_anomalies_total counter\n"
-    << "torchft_lighthouse_anomalies_total " << anomaly_seq_ << "\n";
-  if (!fleet_.empty()) {
-    m << "# HELP torchft_lighthouse_straggler Replica currently flagged "
-         "as a straggler (1) or healthy (0).\n"
-      << "# TYPE torchft_lighthouse_straggler gauge\n";
-    for (const auto& kv : fleet_) {
-      bool straggler =
-          !kv.second.flags.empty() || now < kv.second.straggler_until_ms;
-      m << "torchft_lighthouse_straggler{replica=\""
-        << prom_escape(kv.first) << "\"} " << (straggler ? 1 : 0) << "\n";
+    << "torchft_lighthouse_anomalies_total " << aseq << "\n";
+  m << "# HELP torchft_lighthouse_anomalies_dropped Anomaly records evicted "
+       "from the bounded ring (feed incomplete when > 0).\n"
+    << "# TYPE torchft_lighthouse_anomalies_dropped counter\n"
+    << "torchft_lighthouse_anomalies_dropped " << adropped << "\n";
+  m << "# HELP torchft_lighthouse_fleet_gen Fleet-table content generation "
+       "(bumped on every mutation; tags /fleet.json snapshots).\n"
+    << "# TYPE torchft_lighthouse_fleet_gen counter\n"
+    << "torchft_lighthouse_fleet_gen " << gen << "\n";
+  m << "# HELP torchft_lighthouse_fleet_replicas Replicas in the fleet "
+       "table.\n"
+    << "# TYPE torchft_lighthouse_fleet_replicas gauge\n"
+    << "torchft_lighthouse_fleet_replicas " << rows.size() << "\n";
+  m << "# HELP torchft_lighthouse_fleet_stragglers Replicas currently "
+       "flagged or inside the sticky straggler window.\n"
+    << "# TYPE torchft_lighthouse_fleet_stragglers gauge\n"
+    << "torchft_lighthouse_fleet_stragglers " << n_straggler << "\n";
+  if (!rows.empty()) {
+    std::ostringstream strag, per_replica;
+    for (const auto& r : rows) {
+      if (capped && !r.straggler) {
+        suppressed += 1;
+        continue;
+      }
+      strag << "torchft_lighthouse_straggler{replica=\""
+            << prom_escape(r.id) << "\"} " << (r.straggler ? 1 : 0) << "\n";
+      if (r.has_rate)
+        per_replica << "torchft_lighthouse_replica_step_rate{replica=\""
+                    << prom_escape(r.id) << "\"} " << r.rate << "\n";
     }
-    std::vector<double> rates;
-    std::ostringstream per_replica;
-    for (const auto& kv : fleet_) {
-      if (!kv.second.has_digest) continue;
-      double r = kv.second.digest.get("rate").as_double(0.0);
-      per_replica << "torchft_lighthouse_replica_step_rate{replica=\""
-                  << prom_escape(kv.first) << "\"} " << r << "\n";
-      if (r > 0.0) rates.push_back(r);
+    std::string st = strag.str();
+    if (!st.empty()) {
+      m << "# HELP torchft_lighthouse_straggler Replica currently flagged "
+           "as a straggler (1) or healthy (0).\n"
+        << "# TYPE torchft_lighthouse_straggler gauge\n"
+        << st;
     }
     std::string per = per_replica.str();
     if (!per.empty()) {
@@ -789,18 +1023,51 @@ std::string Lighthouse::render_metrics() {
         << "# TYPE torchft_lighthouse_replica_step_rate gauge\n"
         << per;
     }
-    if (!rates.empty()) {
+    if (have_median) {
       m << "# HELP torchft_lighthouse_fleet_median_step_rate Fleet median "
            "of reported step rates.\n"
         << "# TYPE torchft_lighthouse_fleet_median_step_rate gauge\n"
-        << "torchft_lighthouse_fleet_median_step_rate "
-        << fleet_median(rates) << "\n";
+        << "torchft_lighthouse_fleet_median_step_rate " << median_rate
+        << "\n";
     }
+  }
+  m << "# HELP torchft_lighthouse_replicas_suppressed Healthy replicas "
+       "whose per-replica series were collapsed into aggregates "
+       "(TORCHFT_EXPORT_MAX_REPLICAS).\n"
+    << "# TYPE torchft_lighthouse_replicas_suppressed gauge\n"
+    << "torchft_lighthouse_replicas_suppressed " << suppressed << "\n";
+  // Hot-path latency histograms: upper-bound percentile gauges per path
+  // (log buckets, telemetry._hist_percentile semantics).
+  struct Named {
+    const char* name;
+    const LatencyHist* h;
+  };
+  const Named hists[] = {
+      {"heartbeat", &hist_heartbeat_},   {"quorum_compute", &hist_quorum_},
+      {"anomaly_eval", &hist_anomaly_},  {"http", &hist_http_},
+      {"fleet_snapshot", &hist_snapshot_},
+  };
+  m << "# HELP torchft_lighthouse_hotpath_p50_us Hot-path latency p50 "
+       "(upper-bound log-bucket estimate, microseconds).\n"
+    << "# TYPE torchft_lighthouse_hotpath_p50_us gauge\n"
+    << "# HELP torchft_lighthouse_hotpath_p95_us Hot-path latency p95.\n"
+    << "# TYPE torchft_lighthouse_hotpath_p95_us gauge\n"
+    << "# HELP torchft_lighthouse_hotpath_count Hot-path samples observed.\n"
+    << "# TYPE torchft_lighthouse_hotpath_count counter\n";
+  for (const auto& nh : hists) {
+    LatencyHist::Snap s = nh.h->snapshot();
+    m << "torchft_lighthouse_hotpath_p50_us{path=\"" << nh.name << "\"} "
+      << LatencyHist::percentile_us(s, 0.50) << "\n"
+      << "torchft_lighthouse_hotpath_p95_us{path=\"" << nh.name << "\"} "
+      << LatencyHist::percentile_us(s, 0.95) << "\n"
+      << "torchft_lighthouse_hotpath_count{path=\"" << nh.name << "\"} "
+      << s.count << "\n";
   }
   return m.str();
 }
 
 void Lighthouse::handle_http(int fd) {
+  int64_t t0 = now_us_steady();
   std::string req = read_http_request(fd, 10000);
   std::string path = "/";
   std::string method;
@@ -826,6 +1093,7 @@ void Lighthouse::handle_http(int fd) {
         << "\r\nConnection: close\r\n\r\n";
     std::string out405 = hdr.str() + body405;
     write_all(fd, out405.data(), out405.size(), 10000);
+    hist_http_.observe_us(now_us_steady() - t0);
     return;
   }
   std::string body;
@@ -837,8 +1105,9 @@ void Lighthouse::handle_http(int fd) {
     body = status_json().dump();
     ctype = "application/json";
   } else if (path == "/fleet.json") {
-    std::lock_guard<std::mutex> lk(mu_);
-    body = fleet_json_locked(now_ms()).dump();
+    // Pre-dumped cached snapshot: serving is a string copy, not an O(N)
+    // JSON build under mu_ (the contention the fleet_load harness measures).
+    body = fleet_snapshot(now_ms())->body;
     ctype = "application/json";
   } else if (path == "/metrics") {
     body = render_metrics();
@@ -874,6 +1143,7 @@ void Lighthouse::handle_http(int fd) {
       << "\r\nConnection: close\r\n\r\n";
   std::string out = hdr.str() + body;
   write_all(fd, out.data(), out.size(), 10000);
+  hist_http_.observe_us(now_us_steady() - t0);
 }
 
 }  // namespace tft

@@ -32,6 +32,7 @@
 #include <condition_variable>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <set>
 #include <string>
@@ -42,6 +43,122 @@
 #include "quorum.hpp"
 
 namespace tft {
+
+// Lock-free log-bucket latency histogram: the C++ twin of
+// telemetry._HIST_BOUNDS / _hist_percentile. Bucket i (i in 0..27) holds
+// samples with latency <= 2^i microseconds (1 us doubling up to ~134 s);
+// bucket 28 is overflow. Percentiles report the UPPER bound of the bucket
+// containing the quantile, so they over-estimate within one power of two —
+// identical semantics to the Python side, which keeps dashboards comparable
+// across both planes.
+class LatencyHist {
+ public:
+  static constexpr int kFinite = 28;
+  static constexpr int kBuckets = kFinite + 1;
+
+  struct Snap {
+    int64_t count = 0;
+    int64_t sum_us = 0;
+    int64_t buckets[kBuckets] = {0};
+  };
+
+  // First bucket whose upper bound (2^i us) covers the sample; matches
+  // bisect.bisect_left(_HIST_BOUNDS, dt) on the Python side.
+  static int bucket_of(int64_t us) {
+    if (us <= 1) return 0;
+    for (int i = 1; i < kFinite; i++)
+      if ((int64_t{1} << i) >= us) return i;
+    return kFinite;  // overflow
+  }
+
+  void observe_us(int64_t us) {
+    if (us < 0) us = 0;
+    buckets_[bucket_of(us)].fetch_add(1, std::memory_order_relaxed);
+    count_.fetch_add(1, std::memory_order_relaxed);
+    sum_us_.fetch_add(us, std::memory_order_relaxed);
+  }
+
+  Snap snapshot() const {
+    Snap s;
+    s.count = count_.load(std::memory_order_relaxed);
+    s.sum_us = sum_us_.load(std::memory_order_relaxed);
+    for (int i = 0; i < kBuckets; i++)
+      s.buckets[i] = buckets_[i].load(std::memory_order_relaxed);
+    return s;
+  }
+
+  // Upper-bound quantile from bucket counts (telemetry._hist_percentile):
+  // 0 with no samples; an empty bucket prefix never satisfies the target;
+  // the overflow bucket reports the last finite bound.
+  static int64_t percentile_us(const Snap& s, double q) {
+    int64_t total = 0;
+    for (int i = 0; i < kBuckets; i++) total += s.buckets[i];
+    if (total == 0) return 0;
+    double target = q * static_cast<double>(total);
+    int64_t cum = 0;
+    for (int i = 0; i < kBuckets; i++) {
+      if (s.buckets[i] == 0) continue;
+      cum += s.buckets[i];
+      if (static_cast<double>(cum) >= target)
+        return int64_t{1} << (i < kFinite ? i : kFinite - 1);
+    }
+    return int64_t{1} << (kFinite - 1);
+  }
+
+ private:
+  std::atomic<int64_t> buckets_[kBuckets] = {};
+  std::atomic<int64_t> count_{0};
+  std::atomic<int64_t> sum_us_{0};
+};
+
+// Exact running median over a multiset of doubles with O(log N)
+// insert/erase, replacing the per-heartbeat full-table sort. Maintains the
+// same "upper median" the old fleet_median(sort) returned: lo_ holds the
+// smaller floor(n/2) values, hi_ the larger ceil(n/2), so
+// median() == sorted[n/2] bit-for-bit (the property tests in
+// tests/test_fleet.py pin this equality against a full recompute).
+class MedianTracker {
+ public:
+  void insert(double v) {
+    if (hi_.empty() || v >= *hi_.begin())
+      hi_.insert(v);
+    else
+      lo_.insert(v);
+    rebalance();
+  }
+
+  // No-op if v is not present (defensive: an aggregate drift bug should
+  // surface as a wrong median in the property test, not a crash).
+  void erase(double v) {
+    auto it = hi_.find(v);
+    if (it != hi_.end()) {
+      hi_.erase(it);
+    } else {
+      auto lo = lo_.find(v);
+      if (lo == lo_.end()) return;
+      lo_.erase(lo);
+    }
+    rebalance();
+  }
+
+  size_t size() const { return lo_.size() + hi_.size(); }
+  double median() const { return hi_.empty() ? 0.0 : *hi_.begin(); }
+
+ private:
+  void rebalance() {
+    while (hi_.size() > lo_.size() + 1) {
+      lo_.insert(*hi_.begin());
+      hi_.erase(hi_.begin());
+    }
+    while (lo_.size() > hi_.size()) {
+      auto it = std::prev(lo_.end());
+      hi_.insert(*it);
+      lo_.erase(it);
+    }
+  }
+
+  std::multiset<double> lo_, hi_;
+};
 
 class Lighthouse {
  public:
@@ -89,13 +206,57 @@ class Lighthouse {
   void fleet_scan_locked(int64_t now);  // time-based rules (gaps, staleness)
   void fleet_set_flag(const std::string& replica_id, FleetEntry& e,
                       const std::string& kind, int64_t now, Json detail);
+  void fleet_clear_flag(FleetEntry& e, const std::string& kind);
+  void fleet_erase(const std::string& replica_id);
+  void fleet_agg_remove(const FleetEntry& e);  // retire e.digest from aggs
+  void fleet_agg_insert(const FleetEntry& e);  // fold e.digest into aggs
   int64_t fleet_jitter_budget_ms(const FleetEntry& e) const;
-  Json fleet_json_locked(int64_t now);
   Json fleet_summary_locked(int64_t now);  // the slice merged into status.json
+  Json fleet_agg_locked(int64_t now);      // O(1)-ish agg dict from trackers
+  Json hist_json() const;                  // hot-path histograms for status
+
+  // Generation-tagged cached fleet snapshot. The full /fleet.json payload is
+  // only O(N)-rebuilt when the cached copy is older than fleet_snap_ms; the
+  // rebuild copies raw rows under mu_ (cheap) and does the JSON build + dump
+  // OFF the hot lock, so heartbeats never wait behind serialization.
+  struct FleetSnapshot {
+    int64_t gen = -1;       // fleet_gen_ at build
+    int64_t built_ms = 0;   // wall time at build (== payload ts_ms)
+    Json json;              // the /fleet.json object
+    std::string body;       // pre-dumped body served verbatim over HTTP
+  };
+  std::shared_ptr<const FleetSnapshot> fleet_snapshot(int64_t now);
 
   std::map<std::string, FleetEntry> fleet_;
   std::deque<Json> anomalies_;  // rise-edge anomaly ring (capped)
   int64_t anomaly_seq_ = 0;     // total anomalies ever (ring drops old ones)
+  int64_t anomalies_dropped_ = 0;  // rise-edges evicted from the ring
+  int64_t fleet_gen_ = 0;  // bumped on every fleet-table mutation (mu_)
+  int64_t flagged_ = 0;    // entries with a non-empty flag set (mu_)
+  int64_t n_digest_ = 0;   // entries with a digest (mu_)
+  // Incremental O(log N) aggregate state, updated at digest arrival/leave —
+  // replaces the full-table rescans that made /fleet.json and the anomaly
+  // rules O(N) per heartbeat (all guarded by mu_).
+  MedianTracker agg_rates_;       // digest rates > 0
+  MedianTracker agg_steps_;       // digest steps (as double, like the sort)
+  MedianTracker agg_gps_;         // digest goodputs
+  std::multiset<int64_t> agg_cfs_;  // digest commit-failure streaks
+
+  std::mutex snap_mu_;  // guards snap_ only; never held together with mu_
+  // Serializes snapshot rebuilds (single-flight); ordered strictly outside
+  // snap_mu_ and mu_, never acquired while either is held.
+  std::mutex rebuild_mu_;
+  std::shared_ptr<const FleetSnapshot> snap_;
+
+  // Hot-path latency histograms (lock-free, exported on /metrics and
+  // status.json["hist"]).
+  LatencyHist hist_heartbeat_;   // heartbeat RPC branch incl. mu_ wait
+  LatencyHist hist_quorum_;      // quorum_compute inside tick
+  LatencyHist hist_anomaly_;     // digest fold + anomaly rules per heartbeat
+  LatencyHist hist_http_;        // whole HTTP request service
+  LatencyHist hist_snapshot_;    // fleet snapshot rebuild (copy+build+dump)
+
+  int64_t export_max_replicas_ = 64;  // TORCHFT_EXPORT_MAX_REPLICAS
 
   std::string bind_host_;
   int port_;

@@ -50,6 +50,12 @@ struct LighthouseOpts {
   int64_t join_timeout_ms = 60000;
   int64_t quorum_tick_ms = 100;
   int64_t heartbeat_timeout_ms = 5000;
+  // /fleet.json staleness bound: a cached snapshot younger than this is
+  // served without touching the fleet table; 0 rebuilds on every request
+  // (the pre-caching behavior). The bin default comes from
+  // TORCHFT_FLEET_SNAP_MS / --fleet-snap-ms; direct embedders (tests)
+  // default to uncached for read-after-write determinism.
+  int64_t fleet_snap_ms = 0;
 };
 
 // Mutable lighthouse state operated on by the tick loop.

@@ -497,26 +497,31 @@ class LighthouseServer:
         join_timeout_ms: int = 60000,
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
+        fleet_snap_ms: Optional[int] = None,
     ) -> None:
         host, port = _split_bind(bind)
-        self._server = _ServerProcess(
-            [
-                str(_BIN_DIR / "lighthouse"),
-                "--bind-host",
-                host,
-                "--port",
-                str(port),
-                "--min-replicas",
-                str(min_replicas),
-                "--join-timeout-ms",
-                str(join_timeout_ms),
-                "--quorum-tick-ms",
-                str(quorum_tick_ms),
-                "--heartbeat-timeout-ms",
-                str(heartbeat_timeout_ms),
-            ],
-            "lighthouse",
-        )
+        argv = [
+            str(_BIN_DIR / "lighthouse"),
+            "--bind-host",
+            host,
+            "--port",
+            str(port),
+            "--min-replicas",
+            str(min_replicas),
+            "--join-timeout-ms",
+            str(join_timeout_ms),
+            "--quorum-tick-ms",
+            str(quorum_tick_ms),
+            "--heartbeat-timeout-ms",
+            str(heartbeat_timeout_ms),
+        ]
+        if fleet_snap_ms is not None:
+            # /fleet.json staleness bound. None defers to the binary's
+            # default (100 ms, or TORCHFT_FLEET_SNAP_MS); 0 rebuilds the
+            # payload on every request (read-after-write determinism, the
+            # "before" mode the fleet_load harness benchmarks against).
+            argv += ["--fleet-snap-ms", str(fleet_snap_ms)]
+        self._server = _ServerProcess(argv, "lighthouse")
 
     def address(self) -> str:
         return f"{advertise_host()}:{self._server.port}"

@@ -294,12 +294,26 @@ _ALL = [
         "128",
         "Per-shard microbatch chunk size used when computing loss without materializing full logits.",
     ),
+    _k(
+        "TORCHFT_EXPORT_MAX_REPLICAS",
+        "int",
+        "64",
+        "Per-replica series cardinality cap shared by the lighthouse /metrics endpoint and tools/obs_export.py: above this many fleet replicas, only aggregates plus anomalous/straggler replicas get per-replica series.",
+        scope="both",
+    ),
     # -- C++-only ----------------------------------------------------------
     _k(
         "TORCHFT_LH_DEBUG",
         "bool",
         None,
         "Set (any value): the C++ lighthouse logs per-RPC debug lines to stderr.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_FLEET_SNAP_MS",
+        "int",
+        "100",
+        "/fleet.json staleness bound for the lighthouse binary's cached snapshot (ms); 0 rebuilds the payload on every request. The --fleet-snap-ms flag wins over the env.",
         scope="cpp",
     ),
     # -- repo-root entry script (documented here, read outside the pkg) ---

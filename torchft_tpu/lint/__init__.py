@@ -34,6 +34,10 @@ wallclock-free-chaos    no wall-clock/random calls inside the chaos
                         decision path (replay determinism)
 artifact-hygiene        no build artifacts tracked in git; lint scans
                         sources only
+fleet-keys              ``/fleet.json`` payload keys written by
+                        ``fleet_snapshot``/``fleet_agg_locked`` match
+                        the golden top/row/agg sets; ``obs_top``/
+                        ``obs_export`` never read an unwritten key
 ======================  ==============================================
 
 Run ``python tools/tft_lint.py --check`` (the ``suite_gate.sh lint``

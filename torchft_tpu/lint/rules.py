@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import subprocess
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -763,6 +764,114 @@ def rule_artifact_hygiene(root: str) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# fleet-keys: the /fleet.json payload contract.
+#
+# The lighthouse builds the fleet snapshot in C++ (fleet_snapshot /
+# fleet_agg_locked); obs_top.py and obs_export.py consume it in Python.
+# The golden sets below ARE the contract: the C++ builder must write
+# exactly these keys, and every key the Python consumers read at the
+# fleet/row/agg level must be one the builder writes.
+
+FLEET_TOP_KEYS = {
+    "ts_ms", "gen", "snap_ms", "replicas", "agg", "anomalies",
+    "anomaly_seq",
+}
+FLEET_ROW_KEYS = {
+    "last_hb_age_ms", "hb_interval_ms", "digest", "digest_age_ms",
+    "flags", "straggler",
+}
+FLEET_AGG_KEYS = {
+    "n", "n_digest", "stragglers", "median_rate", "median_step",
+    "median_goodput", "max_commit_failures", "anomalies_dropped",
+}
+
+# Consumer read sites: variable name -> which key level it addresses.
+# obs_top/obs_export bind `fleet` to the parsed payload, `agg` to
+# fleet["agg"], and iterate rows as `r` or index `replicas[rid]`.
+_FLEET_READ_PATTERNS: List[Tuple[str, str]] = [
+    (r"\bfleet\.get\(\s*(['\"])([^'\"]+)\1", "top"),
+    (r"\bagg\.get\(\s*(['\"])([^'\"]+)\1", "agg"),
+    (r"\br\.get\(\s*(['\"])([^'\"]+)\1", "row"),
+    (r"\breplicas\[rid\]\.get\(\s*(['\"])([^'\"]+)\1", "row"),
+]
+_FLEET_CONSUMERS = ("tools/obs_top.py", "tools/obs_export.py")
+
+
+def rule_fleet_keys(root: str) -> List[Finding]:
+    R = "fleet-keys"
+    out: List[Finding] = []
+    cc_path = _p(root, LIGHTHOUSE_CC)
+    if not os.path.exists(cc_path):
+        return out  # fixture tree without the C++ plane
+    text = ex.strip_cc_comments(open(cc_path).read())
+
+    def assigned(body: str, var: str) -> Set[str]:
+        return set(re.findall(rf'\b{var}\["([^"]+)"\]\s*=', body))
+
+    snap = ex.cc_function_body(text, "fleet_snapshot")
+    agg_fn = ex.cc_function_body(text, "fleet_agg_locked")
+    if not snap or not agg_fn:
+        return [
+            Finding(
+                R,
+                "could not extract fleet_snapshot/fleet_agg_locked "
+                "bodies from lighthouse.cc",
+                LIGHTHOUSE_CC,
+            )
+        ]
+    produced = {
+        "top": assigned(snap, "f"),
+        "row": assigned(snap, "r"),
+        "agg": assigned(agg_fn, "agg"),
+    }
+    golden = {
+        "top": FLEET_TOP_KEYS,
+        "row": FLEET_ROW_KEYS,
+        "agg": FLEET_AGG_KEYS,
+    }
+    for level in ("top", "row", "agg"):
+        for k in sorted(produced[level] - golden[level]):
+            out.append(
+                Finding(
+                    R,
+                    f"lighthouse writes undeclared fleet.json {level} "
+                    f"key {k!r} (add it to the golden set and teach "
+                    f"the consumers)",
+                    LIGHTHOUSE_CC,
+                )
+            )
+        for k in sorted(golden[level] - produced[level]):
+            out.append(
+                Finding(
+                    R,
+                    f"declared fleet.json {level} key {k!r} is no "
+                    f"longer written by fleet_snapshot/fleet_agg_locked",
+                    LIGHTHOUSE_CC,
+                )
+            )
+
+    # Consumers may read a subset, but never a key the builder does
+    # not produce (a typo'd .get() silently reads None forever).
+    for rel in _FLEET_CONSUMERS:
+        path = _p(root, rel)
+        if not os.path.exists(path):
+            continue
+        src = open(path).read()
+        for pat, level in _FLEET_READ_PATTERNS:
+            for _q, key in re.findall(pat, src):
+                if key not in golden[level]:
+                    out.append(
+                        Finding(
+                            R,
+                            f"reads fleet.json {level} key {key!r} "
+                            f"that the lighthouse never writes",
+                            rel,
+                        )
+                    )
+    return out
+
+
+# ----------------------------------------------------------------------
 
 RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("golden-constants", rule_golden_constants),
@@ -775,6 +884,7 @@ RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("env-knob-registry", rule_env_knobs),
     ("wallclock-free-chaos", rule_wallclock_free),
     ("artifact-hygiene", rule_artifact_hygiene),
+    ("fleet-keys", rule_fleet_keys),
 ]
 
 

@@ -443,6 +443,7 @@ EVENT_KINDS: Dict[str, str] = {
     # -- fleet observability tools (tools/obs_export.py) ---------------
     "lighthouse_status": "periodic lighthouse status scrape snapshot",
     "anomaly": "exporter-detected anomaly (straggler, hb gap, error)",
+    "anomaly_overflow": "lighthouse anomaly ring dropped records (rise edge)",
 }
 
 
