@@ -128,6 +128,31 @@ class DurableRegime:
         self._ckpt.close()
 
 
+def perf_note_compiled(name: str, jitted_fn, *args, **kwargs):
+    """Records the jitted train step's compile-time FLOPs/bytes (XLA cost
+    analysis) for MFU/roofline accounting when ``TORCHFT_PERF`` is set.
+
+    Call once right after warmup with the SAME example arguments the
+    step runs on (a different shape would cost a second trace). A no-op
+    returning None unless the knob is set; never raises — perf
+    accounting must not be able to fail a training run. The recorded
+    cost feeds ``perf_step_suffix`` and a ``perf_model`` journal event
+    (tools/perf_report.py folds it into the MFU section)."""
+    from torchft_tpu import perf
+
+    return perf.record_jit_cost(name, jitted_fn, *args, **kwargs)
+
+
+def perf_step_suffix(name: str, dt_s: float) -> str:
+    """Progress-line suffix like `` perf[0.42 TF/s mfu=1.2%]`` for a
+    measured step time, or "" when TORCHFT_PERF is off / no cost was
+    recorded for ``name``. Safe to call every step (dict lookup)."""
+    from torchft_tpu import perf
+
+    m = perf.step_metrics(name, dt_s)
+    return perf.format_step_metrics(m) if m else ""
+
+
 def group_data_seed(replica_group: str) -> int:
     """Deterministic data-shard seed for a replica group id: stable
     ACROSS process incarnations (``hash()`` is per-process randomized,

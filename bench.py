@@ -95,31 +95,6 @@ def _timed_window(step, state, batch, n_warmup: int, n_steps: int):
     return (time.perf_counter() - t0) / n_steps, state
 
 
-_PEAK_BF16_TFLOPS = [
-    ("v6", 918.0),  # Trillium
-    ("v5p", 459.0),
-    ("v5", 197.0),  # v5e / v5 lite
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
-def _peak_tflops(device_kind: str):
-    kind = device_kind.lower()
-    for key, tf in _PEAK_BF16_TFLOPS:
-        if key in kind:
-            return tf
-    return None
-
-
-def _flops_per_step(n_params: int, cfg, B: int, S: int) -> float:
-    """Standard 6ND estimate + causal attention term (fwd+bwd)."""
-    dense = 6.0 * n_params * B * S
-    attn = 6.0 * cfg.num_layers * B * S * S * cfg.num_heads * cfg.head_dim
-    return dense + attn
-
-
 # ---------------------------------------------------------------------------
 # Peer replica (second OS process, CPU platform)
 # ---------------------------------------------------------------------------
@@ -532,6 +507,12 @@ def _bench() -> dict:
     # tokens/sec + MFU are finalized AFTER the FT phase: the interleaved
     # quiet-slot raw windows inside _bench_ft contribute a drift-resistant
     # second sample (min of this loop and their median).
+    # FLOP estimates and device peaks live in the shared MFU accounting
+    # module (one FLOP-counting implementation; tools/mfu_sweep.py and the
+    # TORCHFT_PERF trainer path use the same functions).
+    from torchft_tpu.perf import flops_per_step as _flops_per_step
+    from torchft_tpu.perf import peak_tflops as _peak_tflops
+
     flops = _flops_per_step(n_params, cfg, B, S)
     peak = _peak_tflops(device_kind)
 
@@ -822,7 +803,37 @@ def _bench() -> dict:
             }
         )
     _partial_update(dict(result, partial=False))
+    _record_ledger(result)
     return result
+
+
+def _record_ledger(result: dict) -> None:
+    """Append this round's headline metrics to the benchmark ledger
+    (tools/perf_ledger.py) so tools/perf_gate.py gates their trajectory.
+    Same metric names/extraction as the legacy-artifact importer, so
+    live runs extend the backfilled history. TPU rounds get the
+    ``tpu.`` prefix — on-chip numbers never share a trajectory (or a
+    gate baseline) with the CPU-proxy runs. BENCH_TINY smoke rounds are
+    skipped outright — a seconds-long smoke regime is not a point on any
+    trajectory. Never fails the bench."""
+    if os.environ.get("BENCH_TINY"):
+        return
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import perf_ledger
+
+        on_tpu = "TPU" in str(result.get("device_kind", ""))
+        rows = perf_ledger._bench_round_records(
+            "live", {"parsed": result},
+            prefix="tpu." if on_tpu else "",
+            family="tpu" if on_tpu else "ddp",
+        )
+        for metric, value, unit, direction, family, _src, extra in rows:
+            perf_ledger.record(metric, value, unit, direction, family,
+                               "bench.py (live)", extra=extra)
+    except Exception as e:  # noqa: BLE001 - the measurement already ran
+        print(f"bench: ledger append skipped: {e}", file=sys.stderr)
 
 
 def _bench_heal() -> "dict | None":

@@ -448,6 +448,12 @@ def main() -> int:
 
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
+    try:
+        import perf_ledger
+
+        perf_ledger.record_report("pg", report, "tools/bench_pg.py (live)")
+    except Exception as e:  # noqa: BLE001 - the measurement already ran
+        print(f"bench_pg: ledger append skipped: {e}", file=sys.stderr)
     print(
         f"== native/socket at {largest} MiB: {speedup:.2f}x  "
         f"(report: {args.out}) =="

@@ -530,6 +530,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
         f.write("\n")
+    try:
+        import perf_ledger
+
+        perf_ledger.record_report(
+            "fleet", report, "tools/fleet_load.py (live)"
+        )
+    except Exception as e:  # noqa: BLE001 - the measurement already ran
+        print(f"[fleet_load] ledger append skipped: {e}", file=sys.stderr)
     print(f"[fleet_load] wrote {args.out}", flush=True)
     for msg in failures:
         print(f"[fleet_load] BUDGET FAIL: {msg}", file=sys.stderr)

@@ -40,28 +40,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _cost(compiled) -> dict:
-    """flops/bytes from XLA cost analysis + temp bytes from memory
-    analysis, tolerant of backends that return lists or partial keys."""
-    out: dict = {}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        out["flops"] = float(ca.get("flops", 0.0))
-        out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
-    except Exception as e:  # noqa: BLE001 - record, don't die
-        out["cost_error"] = str(e)[:120]
-    try:
-        ma = compiled.memory_analysis()
-        out["temp_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0))
-        out["argument_bytes"] = int(
-            getattr(ma, "argument_size_in_bytes", 0)
-        )
-        out["output_bytes"] = int(getattr(ma, "output_size_in_bytes", 0))
-    except Exception as e:  # noqa: BLE001
-        out["memory_error"] = str(e)[:120]
-    return out
+# flops/bytes/temp-memory extraction lives in the shared MFU accounting
+# module so this ranker, bench.py, and the TORCHFT_PERF trainer path all
+# read XLA cost analysis the same tolerant way.
+from torchft_tpu.perf import compiled_cost as _cost  # noqa: E402
 
 
 def run_candidate(loss_chunk: int, remat: bool, B: int, S: int) -> dict:

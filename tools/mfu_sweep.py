@@ -93,12 +93,12 @@ def _run_config_inner(train_mod, block_q, block_k, remat, B, S, steps,
         for p in jax.tree_util.tree_leaves(state.params)
     )
     kind = jax.devices()[0].device_kind
-    # Same estimates as the headline bench, or sweep-MFU and bench-MFU
-    # stop being comparable.
-    from bench import _flops_per_step, _peak_tflops
+    # Same estimates as the headline bench (which also pulls these from
+    # torchft_tpu.perf), or sweep-MFU and bench-MFU stop being comparable.
+    from torchft_tpu.perf import flops_per_step, peak_tflops
 
-    flops = _flops_per_step(n_params, cfg, B, S)
-    peak = _peak_tflops(kind)
+    flops = flops_per_step(n_params, cfg, B, S)
+    peak = peak_tflops(kind)
     mfu = (flops / dt / 1e12) / peak if peak else None
     del state, batch  # free HBM before the next config
     return {

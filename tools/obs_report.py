@@ -29,6 +29,13 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:  # overlap math lives with the journal writer; report-only fallback
+    from torchft_tpu import telemetry as _telemetry
+except Exception:  # noqa: BLE001 - report still renders without it
+    _telemetry = None
+
 PHASES = ("quorum_s", "heal_s", "compute_s", "allreduce_s", "commit_s")
 
 
@@ -277,11 +284,54 @@ def goodput_rollup(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     return total
 
 
+def overlap_rollup(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Run-level exposed-comm / overlap accounting from the critical-path
+    interval math in ``telemetry`` (the same functions
+    tools/perf_report.py uses, so the goodput line and the perf report
+    can never disagree). ``exposed_comm_frac`` is blocked-on-comm time
+    over step wall; ``overlap_frac`` is in-flight comm hidden under
+    compute over total in-flight comm."""
+    if _telemetry is None:
+        return {}
+    grouped: Dict[Tuple[int, str], List[Dict[str, Any]]] = {}
+    for ev in events:
+        step = _event_step(ev)
+        if step is None:
+            continue
+        grouped.setdefault((step, _replica_key(ev)), []).append(ev)
+    tot = {"total_s": 0.0, "comm_inflight_s": 0.0, "comm_exposed_s": 0.0,
+           "comm_hidden_s": 0.0}
+    rows = 0
+    for evs in grouped.values():
+        attr = _telemetry.comm_attribution(
+            _telemetry.step_phase_windows(evs)
+        )
+        if not attr.get("total_s"):
+            continue
+        rows += 1
+        for k in tot:
+            tot[k] += float(attr.get(k) or 0.0)
+    if not rows:
+        return {}
+    return {
+        "rows": rows,
+        "exposed_comm_frac": round(
+            tot["comm_exposed_s"] / tot["total_s"], 4
+        ) if tot["total_s"] > 0 else None,
+        "overlap_frac": round(
+            tot["comm_hidden_s"] / tot["comm_inflight_s"], 4
+        ) if tot["comm_inflight_s"] > 0 else None,
+        "comm_exposed_s": round(tot["comm_exposed_s"], 4),
+        "comm_hidden_s": round(tot["comm_hidden_s"], 4),
+    }
+
+
 def render_text(
     timeline: Dict[int, Dict[str, Dict[str, Any]]],
     stalls: List[Dict[str, Any]],
     goodput: Dict[str, Any],
     native: Optional[Dict[str, Dict[str, Any]]] = None,
+    overlap: Optional[Dict[str, Any]] = None,
 ) -> str:
     out = []
     out.append(
@@ -346,6 +396,15 @@ def render_text(
             f"heal_s={goodput['heal_s']:.3f} "
             f"goodput_frac={goodput['goodput_frac']}"
         )
+    if overlap:
+        out.append(
+            "comm attribution: "
+            f"exposed_comm_frac={overlap['exposed_comm_frac']} "
+            f"overlap_frac={overlap['overlap_frac']} "
+            f"(exposed {overlap['comm_exposed_s']}s, hidden "
+            f"{overlap['comm_hidden_s']}s over {overlap['rows']} "
+            f"step-rows; see tools/perf_report.py for the breakdown)"
+        )
     return "\n".join(out)
 
 
@@ -369,6 +428,7 @@ def main(argv: Optional[list] = None) -> int:
     stalls = detect_stalls(timeline, args.stall_pct, args.stall_min_s)
     goodput = goodput_rollup(events)
     native = native_stall_attribution(events)
+    overlap = overlap_rollup(events)
 
     if args.json:
         report = {
@@ -385,12 +445,13 @@ def main(argv: Optional[list] = None) -> int:
             "stalls": stalls,
             "goodput": goodput,
             "native_stall_attribution": native,
+            "comm_attribution": overlap,
             "num_events": len(events),
         }
         json.dump(report, sys.stdout, indent=1, default=str)
         print()
     else:
-        print(render_text(timeline, stalls, goodput, native))
+        print(render_text(timeline, stalls, goodput, native, overlap))
     return 0
 
 

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Regression gate over the benchmark ledger.
+
+Compares the head of ``BENCH_LEDGER.jsonl`` (latest sample per metric)
+against the pinned baselines in ``PERF_BASELINES.json`` with per-metric
+noise-aware thresholds, and fails the suite on regression::
+
+    python tools/perf_gate.py --check     # exit 1 on any regression
+    python tools/perf_gate.py --pin       # re-pin baselines from head
+
+Threshold policy: a metric regresses when it moves against its
+``direction`` by more than ``rel_tol`` relative to the baseline.
+``rel_tol`` is pinned per metric at --pin time as
+``max(DEFAULT_REL_TOL, NOISE_K * observed relative spread)`` over that
+metric's ledger history — a metric whose history wobbles 30% (shared
+1-core CI box) gets a wide gate; a tight metric gets a tight one. The
+spread is the max-min range over the median, capped at MAX_REL_TOL so a
+wild history can never pin an unfailable gate. Improvements never fail;
+a metric missing from the ledger head fails (the trajectory went dark);
+a NEW metric absent from the baselines is reported but passes (pin it
+when intentional).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_ledger  # noqa: E402
+
+BASELINES_DEFAULT = os.path.join(REPO, "PERF_BASELINES.json")
+DEFAULT_REL_TOL = 0.15  # floor: 1-core shared CI box, everything wobbles
+NOISE_K = 1.5           # widen by 1.5x the observed relative spread
+MAX_REL_TOL = 0.60      # a wild history must not pin an unfailable gate
+HISTORY_WINDOW = 8      # recent samples considered for the noise spread
+
+
+def _median(vals: List[float]) -> float:
+    vs = sorted(vals)
+    n = len(vs)
+    return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1] + vs[n // 2])
+
+
+def noise_rel_tol(history: List[Dict[str, Any]]) -> float:
+    """Noise-aware tolerance from a metric's recent ledger history."""
+    vals = [float(r["value"]) for r in history[-HISTORY_WINDOW:]]
+    if len(vals) < 2:
+        return DEFAULT_REL_TOL
+    med = abs(_median(vals))
+    if med <= 0:
+        return DEFAULT_REL_TOL
+    spread = (max(vals) - min(vals)) / med
+    return min(MAX_REL_TOL, max(DEFAULT_REL_TOL, NOISE_K * spread))
+
+
+def load_baselines(path: Optional[str] = None) -> Dict[str, Any]:
+    p = path or BASELINES_DEFAULT
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def pin(
+    ledger_path: Optional[str] = None,
+    baselines_path: Optional[str] = None,
+    metrics: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """Write baselines from the current ledger head (all metrics, or the
+    given subset), with per-metric noise-aware rel_tol."""
+    records = perf_ledger.load(ledger_path)
+    heads = perf_ledger.head(records)
+    doc: Dict[str, Any] = {
+        "schema": 1,
+        "pinned_git_rev": perf_ledger.git_rev(),
+        "policy": {
+            "default_rel_tol": DEFAULT_REL_TOL,
+            "noise_k": NOISE_K,
+            "max_rel_tol": MAX_REL_TOL,
+            "history_window": HISTORY_WINDOW,
+        },
+        "metrics": {},
+    }
+    prev = load_baselines(baselines_path).get("metrics", {})
+    keep = set(metrics) if metrics else None
+    for metric, rec in sorted(heads.items()):
+        if keep is not None and metric not in keep:
+            if metric in prev:
+                doc["metrics"][metric] = prev[metric]
+            continue
+        doc["metrics"][metric] = {
+            "value": rec["value"],
+            "unit": rec["unit"],
+            "direction": rec["direction"],
+            "rel_tol": round(
+                noise_rel_tol(perf_ledger.history(records, metric)), 4
+            ),
+            "samples": len(perf_ledger.history(records, metric)),
+        }
+    with open(baselines_path or BASELINES_DEFAULT, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def compare(
+    heads: Dict[str, Dict[str, Any]], baselines: Dict[str, Any]
+) -> Dict[str, List[Dict[str, Any]]]:
+    """{regressions, improvements, ok, missing, unpinned} rows."""
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "regressions": [], "improvements": [], "ok": [], "missing": [],
+        "unpinned": [],
+    }
+    base_metrics = baselines.get("metrics", {})
+    for metric, base in sorted(base_metrics.items()):
+        rec = heads.get(metric)
+        if rec is None:
+            out["missing"].append({"metric": metric, "baseline": base})
+            continue
+        cur, ref = float(rec["value"]), float(base["value"])
+        tol = float(base.get("rel_tol", DEFAULT_REL_TOL))
+        direction = base.get("direction", rec.get("direction", "higher"))
+        scale = abs(ref) if ref else 1.0
+        delta = (cur - ref) / scale
+        row = {
+            "metric": metric, "value": cur, "baseline": ref,
+            "delta_frac": round(delta, 4), "rel_tol": tol,
+            "direction": direction, "unit": base.get("unit", ""),
+        }
+        worse = -delta if direction == "higher" else delta
+        if worse > tol:
+            out["regressions"].append(row)
+        elif worse < -tol:
+            out["improvements"].append(row)
+        else:
+            out["ok"].append(row)
+    for metric in sorted(set(heads) - set(base_metrics)):
+        out["unpinned"].append({"metric": metric,
+                                "value": heads[metric]["value"]})
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default BENCH_LEDGER.jsonl)")
+    p.add_argument("--baselines", default=None,
+                   help=f"baselines path (default {BASELINES_DEFAULT})")
+    p.add_argument("--check", action="store_true",
+                   help="compare head-of-ledger vs baselines; exit 1 on "
+                   "regression")
+    p.add_argument("--pin", action="store_true",
+                   help="write baselines from the current ledger head")
+    p.add_argument("--metrics", nargs="*", default=None,
+                   help="with --pin: only re-pin these metrics")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.pin:
+        doc = pin(args.ledger, args.baselines, args.metrics)
+        print(
+            f"pinned {len(doc['metrics'])} baselines at "
+            f"{doc['pinned_git_rev']} -> "
+            f"{args.baselines or BASELINES_DEFAULT}"
+        )
+        if not args.check:
+            return 0
+
+    baselines = load_baselines(args.baselines)
+    if not baselines.get("metrics"):
+        print("no baselines pinned (run --pin first)", file=sys.stderr)
+        return 1
+    heads = perf_ledger.head(perf_ledger.load(args.ledger))
+    result = compare(heads, baselines)
+
+    if args.json:
+        json.dump(result, sys.stdout, indent=1)
+        print()
+    else:
+        for row in result["regressions"]:
+            print(
+                f"REGRESSION {row['metric']}: {row['value']:g} vs baseline "
+                f"{row['baseline']:g} {row['unit']} "
+                f"({row['delta_frac']:+.1%}, tol ±{row['rel_tol']:.0%}, "
+                f"{row['direction']} is better)"
+            )
+        for row in result["missing"]:
+            print(f"MISSING {row['metric']}: pinned but absent from the "
+                  f"ledger head")
+        for row in result["improvements"]:
+            print(f"improved {row['metric']}: {row['value']:g} "
+                  f"({row['delta_frac']:+.1%})")
+        for row in result["unpinned"]:
+            print(f"unpinned {row['metric']}: {row['value']:g} "
+                  f"(new metric; --pin to gate it)")
+        print(
+            f"perf gate: {len(result['ok'])} ok, "
+            f"{len(result['improvements'])} improved, "
+            f"{len(result['regressions'])} regressed, "
+            f"{len(result['missing'])} missing, "
+            f"{len(result['unpinned'])} unpinned"
+        )
+    failed = bool(result["regressions"] or result["missing"])
+    return 1 if (args.check and failed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,11 @@
 #        bash tools/suite_gate.sh san   # sanitizer lane: cpp_tests + the
 #                                       # 2-replica allreduce/abort drill
 #                                       # under TSan, ASan(+LSan) and UBSan
+#        bash tools/suite_gate.sh perf  # perf attribution: 2-replica DDP
+#                                       # drill under TORCHFT_PERF -> journal
+#                                       # -> perf_report critical-path/overlap
+#                                       # check, then perf_gate --check vs the
+#                                       # pinned BENCH_LEDGER baselines
 #        bash tools/suite_gate.sh wan   # degraded-network drill: 2-region
 #                                       # DiLoCo over a throttled wan link
 #                                       # with mid-collective stripe tears
@@ -100,6 +105,13 @@ fi
 if [ "${1:-}" = "san" ]; then
   echo "== san: cpp_tests + san_drill under TSan / ASan / UBSan =="
   exec timeout 3600 make -C torchft_tpu/_cpp san
+fi
+
+if [ "${1:-}" = "perf" ]; then
+  echo "== perf smoke: journaled 2-replica DDP drill -> perf_report =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/perf_smoke.py || exit 1
+  echo "== perf gate: ledger head vs pinned baselines =="
+  exec timeout 120 python tools/perf_gate.py --check
 fi
 
 if [ "${1:-}" = "pg" ]; then

@@ -40,7 +40,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from . import knobs
 
@@ -444,6 +444,9 @@ EVENT_KINDS: Dict[str, str] = {
     "lighthouse_status": "periodic lighthouse status scrape snapshot",
     "anomaly": "exporter-detected anomaly (straggler, hb gap, error)",
     "anomaly_overflow": "lighthouse anomaly ring dropped records (rise edge)",
+    # -- perf attribution (perf.py, tools/perf_report.py) --------------
+    "perf_model": "compile-time FLOPs/bytes of a jitted train step",
+    "perf_step": "per-(step,replica) critical-path/overlap attribution",
 }
 
 
@@ -1055,3 +1058,256 @@ class FlightRecorder:
 
 
 flight_recorder = FlightRecorder()
+
+
+# ----------------------------------------------------------------------
+# Perf attribution: interval-overlap math over journal span windows
+# ----------------------------------------------------------------------
+# Consumed by tools/perf_report.py and tools/obs_report.py. Every journal
+# event that closes a span carries its completion wall-clock ``ts`` plus
+# ``attrs.elapsed_s``, so the span's window is [ts - elapsed_s, ts];
+# ``allreduce_issue`` additionally timestamps the moment the collective
+# went in flight. That is enough to compute exposed-vs-hidden comm as
+# interval set algebra instead of phase-duration sums (which double-count
+# whenever windows overlap — e.g. DDP bucket allreduces, or a quorum
+# overlapping the forward pass).
+
+Interval = Tuple[float, float]
+
+
+def merge_intervals(intervals: List[Interval]) -> List[Interval]:
+    """Sorted union of half-open intervals; empty/inverted inputs drop."""
+    ivs = sorted((a, b) for a, b in intervals if b > a)
+    out: List[Interval] = []
+    for a, b in ivs:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def union_s(intervals: List[Interval]) -> float:
+    return sum(b - a for a, b in merge_intervals(intervals))
+
+
+def intersect_intervals(
+    xs: List[Interval], ys: List[Interval]
+) -> List[Interval]:
+    """union(xs) ∩ union(ys) as a merged interval list."""
+    xs, ys = merge_intervals(xs), merge_intervals(ys)
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(xs) and j < len(ys):
+        a = max(xs[i][0], ys[j][0])
+        b = min(xs[i][1], ys[j][1])
+        if b > a:
+            out.append((a, b))
+        if xs[i][1] <= ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def subtract_intervals(
+    xs: List[Interval], ys: List[Interval]
+) -> List[Interval]:
+    """union(xs) minus union(ys)."""
+    xs, ys = merge_intervals(xs), merge_intervals(ys)
+    out: List[Interval] = []
+    j = 0
+    for a, b in xs:
+        cur = a
+        while j < len(ys) and ys[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(ys) and ys[k][0] < b:
+            if ys[k][0] > cur:
+                out.append((cur, ys[k][0]))
+            cur = max(cur, ys[k][1])
+            k += 1
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+# The blocking phases of one managed step, in pipeline order. "compute"
+# is everything inside the step window not covered by a blocking phase.
+PERF_PHASES = ("quorum", "heal", "compute", "allreduce", "commit")
+_PHASE_LETTER = {
+    "quorum": "q", "heal": "h", "compute": "c", "allreduce": "a",
+    "commit": "m",
+}
+
+
+def step_phase_windows(
+    events: List[Dict[str, Any]],
+) -> Dict[str, List[Interval]]:
+    """Span windows for ONE (step, replica)'s journal events.
+
+    Returns interval lists keyed ``quorum``/``heal``/``commit`` (blocking
+    control-plane waits), ``comm_inflight`` (allreduce issue→complete),
+    ``comm_exposed`` (the tail of each in-flight window the trainer spent
+    blocked in ``wait()``; ``allreduce_complete.elapsed_s`` is exactly
+    that wait), and ``step`` (the full step window). Events may arrive in
+    any order; pairing is FIFO by timestamp."""
+    win: Dict[str, List[Interval]] = {
+        "quorum": [], "heal": [], "commit": [],
+        "comm_inflight": [], "comm_exposed": [], "step": [],
+    }
+    evs = sorted(events, key=lambda e: float(e.get("ts", 0.0)))
+    t_lo: Optional[float] = None
+    t_hi: Optional[float] = None
+    issues: List[float] = []
+    for ev in evs:
+        name = ev.get("event")
+        attrs = ev.get("attrs") or {}
+        ts = float(ev.get("ts", 0.0))
+        el = float(attrs.get("elapsed_s") or 0.0)
+        bound = False
+        if name == "quorum_start":
+            bound = True
+        elif name == "quorum_ready":
+            win["quorum"].append((ts - el, ts))
+            ts = ts - el  # the wait began before the journal line landed
+            bound = True
+        elif name == "heal_done":
+            win["heal"].append((ts - el, ts))
+            bound = True
+        elif name == "allreduce_issue":
+            issues.append(ts)
+            bound = True
+        elif name == "allreduce_complete":
+            t0 = issues.pop(0) if issues else ts - el
+            win["comm_inflight"].append((min(t0, ts - el), ts))
+            win["comm_exposed"].append((ts - el, ts))
+            bound = True
+        elif name == "commit_gate":
+            win["commit"].append((ts - el, ts))
+            bound = True
+        # Only phase events bound the step window: a shutdown `goodput`
+        # or a drained `native_counters` landing seconds later must not
+        # stretch the final step's "compute" to the process exit.
+        if bound:
+            t_lo = ts if t_lo is None else min(t_lo, ts)
+            t_hi_ev = float(ev.get("ts", 0.0))
+            t_hi = t_hi_ev if t_hi is None else max(t_hi, t_hi_ev)
+    if t_lo is not None and t_hi is not None and t_hi > t_lo:
+        win["step"] = [(t_lo, t_hi)]
+    return win
+
+
+def comm_attribution(win: Dict[str, List[Interval]]) -> Dict[str, Any]:
+    """Interval-overlap attribution for one (step, replica).
+
+    ``exposed_s``: comm time the trainer was blocked on (union of wait
+    windows). ``hidden_s``: in-flight comm covered by compute (in-flight
+    minus exposed minus other blocking waits). ``overlap_frac``: hidden /
+    in-flight — the fraction of comm the step actually hid.
+    ``compute_s`` is the step-window complement of every blocking wait,
+    so quorum+heal+allreduce+commit+compute tile the step exactly (the
+    ``--check`` invariant in tools/perf_report.py)."""
+    step = win.get("step") or []
+    blocking = {
+        "quorum": win["quorum"],
+        "heal": win["heal"],
+        "allreduce": win["comm_exposed"],
+        "commit": win["commit"],
+    }
+    # Clip everything to the step window and de-overlap the blocking
+    # phases in pipeline-priority order so they tile, never double-count.
+    phases: Dict[str, List[Interval]] = {}
+    covered: List[Interval] = []
+    for name in ("quorum", "heal", "allreduce", "commit"):
+        clipped = intersect_intervals(blocking[name], step)
+        own = subtract_intervals(clipped, covered)
+        phases[name] = own
+        covered = merge_intervals(covered + own)
+    compute = subtract_intervals(step, covered)
+    inflight = intersect_intervals(win["comm_inflight"], step)
+    exposed_s = union_s(phases["allreduce"])
+    inflight_s = union_s(inflight)
+    hidden_s = union_s(intersect_intervals(inflight, compute))
+    total_s = union_s(step)
+    out: Dict[str, Any] = {
+        "total_s": total_s,
+        "quorum_s": union_s(phases["quorum"]),
+        "heal_s": union_s(phases["heal"]),
+        "allreduce_s": exposed_s,
+        "commit_s": union_s(phases["commit"]),
+        "compute_s": union_s(compute),
+        "comm_inflight_s": inflight_s,
+        "comm_exposed_s": exposed_s,
+        "comm_hidden_s": hidden_s,
+        "overlap_frac": (hidden_s / inflight_s) if inflight_s > 0 else None,
+        "exposed_frac": (exposed_s / total_s) if total_s > 0 else None,
+    }
+    return out
+
+
+def perf_fingerprint(attr: Dict[str, Any]) -> str:
+    """Deterministic step fingerprint: phases by share of the step wall,
+    largest first, as ``<letter><pct>`` joined by ``>`` (e.g. ``a98>c2``
+    = 98% exposed allreduce, 2% compute). Zero-share phases drop."""
+    total = float(attr.get("total_s") or 0.0)
+    if total <= 0:
+        return "-"
+    parts = []
+    for phase in PERF_PHASES:
+        pct = int(round(100.0 * float(attr.get(f"{phase}_s") or 0.0) / total))
+        if pct > 0:
+            parts.append((pct, _PHASE_LETTER[phase]))
+    parts.sort(key=lambda p: (-p[0], p[1]))
+    return ">".join(f"{letter}{pct}" for pct, letter in parts) or "-"
+
+
+def dominant_exposed(attr: Dict[str, Any]) -> Tuple[str, float]:
+    """(phase, seconds) of the largest *blocking* interval — the thing a
+    speed PR should attack first. Compute is excluded: a compute-bound
+    step has no exposed stall (callers report it separately)."""
+    best = max(
+        ("quorum", "heal", "allreduce", "commit"),
+        key=lambda p: float(attr.get(f"{p}_s") or 0.0),
+    )
+    return best, float(attr.get(f"{best}_s") or 0.0)
+
+
+def lane_exposed_attribution(
+    events: List[Dict[str, Any]],
+) -> Dict[Tuple[Any, Any, Any], Dict[str, float]]:
+    """Per-(peer, stripe, dir) *sole-runner* time across the
+    ``native_collective`` lane windows: for each record, the nanoseconds
+    where only that lane was still in flight — the tail the collective's
+    completion was actually waiting on. Interval subtraction per record,
+    aggregated across records (engine-clock ns never mixes with wall ts).
+    """
+    agg: Dict[Tuple[Any, Any, Any], Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("event") != "native_collective":
+            continue
+        lanes = (ev.get("attrs") or {}).get("lanes") or []
+        wins: List[Tuple[Tuple[Any, Any, Any], Interval, int]] = []
+        for ln in lanes:
+            try:
+                t0, t1 = int(ln.get("t0_ns") or 0), int(ln.get("t1_ns") or 0)
+                if t1 <= t0:
+                    continue
+                key = (ln.get("peer"), ln.get("stripe"), ln.get("dir"))
+                wins.append((key, (float(t0), float(t1)),
+                             int(ln.get("bytes") or 0)))
+            except (TypeError, ValueError, AttributeError):
+                continue
+        for i, (key, iv, nbytes) in enumerate(wins):
+            others = [w[1] for j, w in enumerate(wins) if j != i]
+            sole_ns = union_s(subtract_intervals([iv], others))
+            a = agg.setdefault(
+                key, {"sole_s": 0.0, "busy_s": 0.0, "bytes": 0.0,
+                      "count": 0.0},
+            )
+            a["sole_s"] += sole_ns / 1e9
+            a["busy_s"] += (iv[1] - iv[0]) / 1e9
+            a["bytes"] += nbytes
+            a["count"] += 1
+    return agg

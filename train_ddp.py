@@ -31,6 +31,8 @@ from _train_common import (
     drain_signal,
     group_data_seed,
     maybe_pin_cpu,
+    perf_note_compiled,
+    perf_step_suffix,
 )
 
 maybe_pin_cpu()  # before any backend initializes or package import
@@ -193,6 +195,10 @@ def main() -> int:
     # first compile can take tens of seconds).
     wx, wy = synthetic_batch(jax.random.PRNGKey(1), args.batch_size, S_img, n_cls)
     jax.block_until_ready(loss_and_grads(params, batch_stats[0], wx, wy))
+    # TORCHFT_PERF: FLOPs/bytes from the compile we just paid for, so
+    # step prints carry MFU/roofline (torchft_tpu/perf.py). No-op when off.
+    perf_note_compiled("ddp_step", loss_and_grads, params, batch_stats[0],
+                       wx, wy)
 
 
     manager = Manager(
@@ -282,6 +288,7 @@ def main() -> int:
             drained = True
             break
         step = manager.current_step()
+        t_step0 = time.time()
         # Scheduled profiler window (TORCHFT_TRACE_DIR; reference:
         # train_ddp.py:169-174 torch.profiler schedule).
         telemetry.trace_window(step)
@@ -308,7 +315,8 @@ def main() -> int:
         print(
             f"[group {replica_group}] step={step} loss={float(loss):.4f} "
             f"participants={manager.num_participants()} committed={committed} "
-            f"t={time.time():.3f}",
+            f"t={time.time():.3f}"
+            f"{perf_step_suffix('ddp_step', time.time() - t_step0)}",
             flush=True,
         )
         if metrics is not None:

@@ -22,6 +22,7 @@ import argparse
 import logging
 import os
 import sys
+import time
 
 import jax
 
@@ -30,6 +31,8 @@ from _train_common import (
     drain_signal,
     group_data_seed,
     maybe_pin_cpu,
+    perf_note_compiled,
+    perf_step_suffix,
 )
 
 maybe_pin_cpu()  # before any backend initializes or package import
@@ -134,6 +137,13 @@ def main() -> int:
     # Warm the compile cache before joining the quorum.
     params, opt_state, _ = inner_step(params, opt_state, tokens0, tokens0)
     jax.block_until_ready(params)
+    # TORCHFT_PERF: FLOPs/bytes from the compile we just paid for, so
+    # boundary prints carry MFU/roofline (torchft_tpu/perf.py). No-op
+    # when off.
+    perf_note_compiled(
+        "diloco_inner_step", inner_step, params, opt_state, tokens0,
+        tokens0, tokens_per_step=args.batch_size * args.seq_len,
+    )
 
     # Mutable handle bridging DiLoCo's get/set to the functional params.
     state = {"params": params}
@@ -263,6 +273,7 @@ def main() -> int:
         return True
 
     for inner in inner_iter():
+        t_step0 = time.time()
         telemetry.trace_window(inner)
         kx = jax.random.fold_in(data_base, inner)
         x = jax.random.randint(
@@ -295,7 +306,8 @@ def main() -> int:
                 f"[group {replica_group}] inner={inner} outer_step="
                 f"{manager.current_step()} loss={float(loss):.4f} "
                 f"committed={committed} "
-                f"participants={manager.num_participants()}",
+                f"participants={manager.num_participants()}"
+                f"{perf_step_suffix('diloco_inner_step', time.time() - t_step0)}",
                 flush=True,
             )
             if metrics is not None:

@@ -26,6 +26,7 @@ import json
 import logging
 import os
 import sys
+import time
 
 
 def _maybe_pin_cpu() -> None:
@@ -170,6 +171,23 @@ def main() -> int:
         grad_step = make_grad_step(model, mesh, shardings)
     params, opt_state = state.params, state.opt_state
 
+    # TORCHFT_PERF: record the compiled step's FLOPs/bytes once (same
+    # shapes the loop runs) so step logs carry MFU/roofline. The guard
+    # keeps the off path free of the probe batch allocation.
+    from torchft_tpu import perf as _perf
+    if _perf.perf_enabled():
+        from _train_common import perf_note_compiled
+
+        k0 = jax.random.PRNGKey(0)
+        probe = {
+            "inputs": jax.random.randint(k0, (B, S), 0, cfg.vocab_size),
+            "targets": jax.random.randint(k0, (B, S), 0, cfg.vocab_size),
+            "mask": jnp.ones((B, S), jnp.int32),
+        }
+        perf_note_compiled("hsdp_grad_step", grad_step, params, probe,
+                           tokens_per_step=B * S)
+        del probe
+
     def apply_fn(params, opt_state, grads):
         import optax
 
@@ -298,6 +316,7 @@ def main() -> int:
                     ckpt.on_drain(manager.current_step(), durable_state_fn)
                 drained = True
                 break
+            t_step0 = time.time()
             telemetry.trace_window(step)
             manager.start_quorum()
             # Deterministic batch per step: every group that commits step k
@@ -326,8 +345,13 @@ def main() -> int:
             if committed:
                 losses.append(float(loss))
                 logging.info(
-                    "[group %s] step %d loss %.4f participants %d",
+                    "[group %s] step %d loss %.4f participants %d%s",
                     group, step, losses[-1], mm.replica_size(),
+                    _perf.format_step_metrics(
+                        _perf.step_metrics(
+                            "hsdp_grad_step", time.time() - t_step0
+                        )
+                    ),
                 )
                 if metrics is not None:
                     metrics.log(
