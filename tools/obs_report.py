@@ -42,13 +42,27 @@ PHASES = ("quorum_s", "heal_s", "compute_s", "allreduce_s", "commit_s")
 def load_events(paths: List[str]) -> List[Dict[str, Any]]:
     """Reads journal JSONL files (files or directories of ``*.jsonl``),
     returns all events sorted by timestamp. Malformed lines are skipped —
-    a journal truncated by a kill is exactly the interesting case."""
+    a journal truncated by a kill is exactly the interesting case.
+
+    Rotation-aware: ``EventLog`` renames a full journal to ``<path>.1``
+    (``TORCHFT_JOURNAL_MAX_MB``), so for every journal file its ``.1``
+    segment is read first when present — an episode spanning the
+    rotation must not lose its pre-rotation events."""
     files: List[str] = []
+
+    def _add(f: str) -> None:
+        prev = f + ".1"
+        if not f.endswith(".1") and os.path.exists(prev) and prev not in files:
+            files.append(prev)
+        if f not in files:
+            files.append(f)
+
     for p in paths:
         if os.path.isdir(p):
-            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+            for f in sorted(glob.glob(os.path.join(p, "*.jsonl"))):
+                _add(f)
         else:
-            files.append(p)
+            _add(p)
     events: List[Dict[str, Any]] = []
     for f in files:
         try:

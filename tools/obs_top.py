@@ -73,6 +73,16 @@ def _phase_ms(digest: Dict[str, Any], key: str) -> Optional[float]:
     return float(pair[1]) * 1e3
 
 
+def _heal_s(digest: Dict[str, Any]) -> Optional[float]:
+    """Heal (recv_checkpoint) p95 seconds from the digest's phase spans;
+    None when the replica has no heal activity in its digest window."""
+    ph = digest.get("ph") or {}
+    pair = ph.get("h")
+    if not isinstance(pair, list) or len(pair) < 2 or pair[1] is None:
+        return None
+    return float(pair[1])
+
+
 def _bw_summary(digest: Dict[str, Any]) -> str:
     """Worst per-peer GiB/s (the lane that bounds the allreduce)."""
     bw = digest.get("bw") or {}
@@ -106,9 +116,12 @@ def sort_worst_first(replicas: Dict[str, Any],
     return sorted(replicas, key=key)
 
 
-def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
+def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
+           ttr_budget_s: float = 60.0) -> str:
     """One full frame of the dashboard as a string (no clear escape).
-    ``top > 0``: worst-first order, truncated to ``top`` rows."""
+    ``top > 0``: worst-first order, truncated to ``top`` rows.
+    ``ttr_budget_s``: replicas mid-heal render their heal p95 against this
+    budget ("4.2/60") and earn a ``TTR_BUDGET`` tag when over it."""
     replicas = fleet.get("replicas") or {}
     agg = fleet.get("agg") or {}
     anomalies = fleet.get("anomalies") or []
@@ -135,7 +148,7 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
         ANSI_BOLD))
     header = (f"{'REPLICA':<20} {'STEP':>7} {'RATE/s':>7} {'GOOD%':>6} "
               f"{'Q95ms':>7} {'H95ms':>7} {'C95ms':>7} {'A95ms':>7} "
-              f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7}  FLAGS")
+              f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7} {'HEAL':>9}  FLAGS")
     lines.append(paint(header, ANSI_BOLD))
     for rid in order:
         r = replicas[rid]
@@ -145,6 +158,12 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
         tag = " ".join(flags)
         if straggler:
             tag = ("STRAGGLER " + tag).strip()
+        heal_s = _heal_s(dg)
+        over_budget = heal_s is not None and heal_s > ttr_budget_s
+        if over_budget:
+            tag = (tag + " TTR_BUDGET").strip()
+        heal_cell = ("-" if heal_s is None
+                     else f"{heal_s:.1f}/{ttr_budget_s:.0f}")
         gp = dg.get("gp")
         row = (
             f"{str(rid)[:20]:<20} "
@@ -157,10 +176,11 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
             f"{_fmt(_phase_ms(dg, 'a'), '{:.1f}'):>7} "
             f"{_fmt(_phase_ms(dg, 'm'), '{:.1f}'):>7} "
             f"{_bw_summary(dg):>6} "
-            f"{_fmt(r.get('last_hb_age_ms'), '{:.0f}'):>7}  "
+            f"{_fmt(r.get('last_hb_age_ms'), '{:.0f}'):>7} "
+            f"{heal_cell:>9}  "
             f"{tag}"
         )
-        if straggler:
+        if straggler or over_budget:
             row = paint(row, ANSI_RED)
         elif flags:
             row = paint(row, ANSI_YELLOW)
@@ -182,12 +202,14 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0) -> str:
 
 
 def check_frame(fleet: Dict[str, Any], frame: str,
-                top: int = 0) -> List[str]:
+                top: int = 0, ttr_budget_s: float = 60.0) -> List[str]:
     """Cross-checks a rendered frame against the JSON it came from.
     Returns a list of problems (empty = pass). With ``top > 0`` only the
     worst-first prefix must render (each with its tags), the truncation
     footer must count the rest, and the worst offenders — every flagged
-    replica that fits in ``top`` rows — must not be cut."""
+    replica that fits in ``top`` rows — must not be cut. Replicas whose
+    digest heal p95 exceeds ``ttr_budget_s`` must carry a TTR_BUDGET tag
+    and render their heal cell."""
     problems: List[str] = []
     replicas = fleet.get("replicas") or {}
     agg = fleet.get("agg") or {}
@@ -216,6 +238,17 @@ def check_frame(fleet: Dict[str, Any], frame: str,
             if kind not in row:
                 problems.append(
                     f"replica {rid!r} flag {kind!r} not rendered")
+        heal_s = _heal_s(replicas[rid].get("digest") or {})
+        if heal_s is not None and heal_s > ttr_budget_s:
+            row = next(ln for ln in frame_lines if ln.startswith(shown))
+            if "TTR_BUDGET" not in row:
+                problems.append(
+                    f"replica {rid!r} heal p95 {heal_s:.1f}s exceeds the "
+                    f"{ttr_budget_s:.0f}s TTR budget but has no "
+                    f"TTR_BUDGET tag")
+            if f"{heal_s:.1f}/" not in row:
+                problems.append(
+                    f"replica {rid!r} heal cell not rendered")
     head = frame_lines[0] if frame_lines else ""
     if f"replicas={int(agg.get('n', 0))}" not in head:
         problems.append("aggregate replica count missing from header")
@@ -241,16 +274,22 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--top", type=int, default=0,
                    help="show only the N worst replicas (flags, then step "
                         "lag, then rate); 0 = all, sorted by id")
+    p.add_argument("--ttr-budget", type=float,
+                   default=knobs.get_float("TORCHFT_TTR_BUDGET_S"),
+                   help="flag replicas whose heal p95 exceeds this many "
+                        "seconds (default: $TORCHFT_TTR_BUDGET_S)")
     args = p.parse_args(argv)
     if not args.lighthouse:
         p.error("--lighthouse / $TORCHFT_LIGHTHOUSE is required")
 
     if args.once:
         fleet = fetch_fleet(args.lighthouse)
-        frame = render(fleet, color=False, top=args.top)
+        frame = render(fleet, color=False, top=args.top,
+                       ttr_budget_s=args.ttr_budget)
         sys.stdout.write(frame)
         if args.check:
-            problems = check_frame(fleet, frame, top=args.top)
+            problems = check_frame(fleet, frame, top=args.top,
+                                   ttr_budget_s=args.ttr_budget)
             for prob in problems:
                 print(f"CHECK FAIL: {prob}", file=sys.stderr)
             return 1 if problems else 0
@@ -262,7 +301,8 @@ def main(argv: Optional[list] = None) -> int:
         while True:
             try:
                 fleet = fetch_fleet(args.lighthouse)
-                frame = render(fleet, color=color, top=args.top)
+                frame = render(fleet, color=color, top=args.top,
+                               ttr_budget_s=args.ttr_budget)
             except Exception as e:  # noqa: BLE001 - keep polling
                 frame = f"fleet poll failed: {e}\n"
             sys.stdout.write((ANSI_HOME_CLEAR if color else "") + frame)

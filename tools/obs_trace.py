@@ -34,8 +34,14 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import obs_report  # noqa: E402
+
+try:  # episode overlay (telemetry.detect_episodes); trace renders without
+    from torchft_tpu import telemetry as _telemetry
+except Exception:  # noqa: BLE001 - spans/flows still export
+    _telemetry = None
 
 # Journal events whose `elapsed_s` attr spans a phase worth drawing.
 _SPAN_EVENTS = {
@@ -230,11 +236,79 @@ def build_trace(
                 fe["bp"] = "e"
             flow_events.append(fe)
 
+    spans.extend(_episode_overlay(tr, events, base_us, flow_events))
+
     return {
         "traceEvents": tr.events + spans + flow_events,
         "displayTimeUnit": "ms",
         "otherData": {"base_unix_s": base_s, "generator": "obs_trace.py"},
     }
+
+
+def _episode_overlay(
+    tr: _Tracks,
+    events: List[Dict[str, Any]],
+    base_us: float,
+    flow_events: List[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Recovery-episode overlay: per-replica ``recovery`` tracks carrying
+    the detected TTR phase windows (``telemetry.detect_episodes``), a
+    root-cause marker, and an episode-scoped flow arrow chain binding the
+    trigger on the root replica through the primary replica's phases to
+    the closing commit — the cross-replica causal path of each failure."""
+    if _telemetry is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    for ep in _telemetry.detect_episodes(events):
+        chain: List[Dict[str, Any]] = []
+        root = ep["root_cause"]
+        root_pid = tr.pid(str(root["replica"]))
+        marker = {
+            "ph": "i", "name": f"root_cause:{root['kind']}",
+            "cat": "episode", "s": "p",
+            "pid": root_pid,
+            "tid": tr.tid(str(root["replica"]), "recovery"),
+            "ts": float(root["ts"]) * 1e6 - base_us,
+            "args": {"episode": ep["id"], "trace": ep.get("trace")},
+        }
+        out.append(marker)
+        for rid, row in sorted(ep["replicas"].items()):
+            pid = tr.pid(str(rid))
+            tid = tr.tid(str(rid), "recovery")
+            for phase in _telemetry.RECOVERY_PHASES:
+                for a, b in row["phase_windows"][phase]:
+                    span = {
+                        "ph": "X", "name": phase, "cat": "episode",
+                        "pid": pid, "tid": tid,
+                        "ts": a * 1e6 - base_us,
+                        "dur": max((b - a) * 1e6, 1.0),
+                        "args": {
+                            "episode": ep["id"],
+                            "trace": ep.get("trace"),
+                            "ttr_s": row["ttr_s"],
+                            "primary": rid == ep["primary"],
+                        },
+                    }
+                    out.append(span)
+                    if rid == ep["primary"]:
+                        chain.append(span)
+        chain.sort(key=lambda s: s["ts"])
+        # Arrow chain: trigger marker -> primary's phases in time order.
+        nodes = [marker] + chain
+        if len(nodes) >= 2:
+            fid = _flow_id(f"episode:{ep['id']}")
+            for i, node in enumerate(nodes):
+                ph = "s" if i == 0 else ("f" if i == len(nodes) - 1 else "t")
+                fe = {
+                    "ph": ph, "name": f"episode {ep['id']}",
+                    "cat": "episode-flow", "id": fid,
+                    "pid": node["pid"], "tid": node["tid"],
+                    "ts": node["ts"] + node.get("dur", 0.0) / 2,
+                }
+                if ph == "f":
+                    fe["bp"] = "e"
+                flow_events.append(fe)
+    return out
 
 
 def validate_trace(trace: Any) -> List[str]:

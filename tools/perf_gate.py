@@ -19,6 +19,15 @@ wild history can never pin an unfailable gate. Improvements never fail;
 a metric missing from the ledger head fails (the trajectory went dark);
 a NEW metric absent from the baselines is reported but passes (pin it
 when intentional).
+
+Budget-gated metrics: a baseline entry may carry an absolute ``budget``
+(set via ``--pin --budget metric=value``, preserved across re-pins).
+Such a metric passes iff its head value stays on the right side of the
+budget in its direction — no relative comparison at all. This is for
+wall-clock metrics whose clean-run distribution is bimodal (recovery
+TTR swings 5s<->30s with how many commit-gate vote timeouts land inside
+the window): a relative gate either flakes or is unfailable, while the
+documented budget (e.g. TORCHFT_TTR_BUDGET_S) is the real contract.
 """
 
 from __future__ import annotations
@@ -73,9 +82,11 @@ def pin(
     ledger_path: Optional[str] = None,
     baselines_path: Optional[str] = None,
     metrics: Optional[List[str]] = None,
+    budgets: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
     """Write baselines from the current ledger head (all metrics, or the
-    given subset), with per-metric noise-aware rel_tol."""
+    given subset), with per-metric noise-aware rel_tol. ``budgets`` maps
+    metric -> absolute bound; existing budgets survive a re-pin."""
     records = perf_ledger.load(ledger_path)
     heads = perf_ledger.head(records)
     doc: Dict[str, Any] = {
@@ -96,7 +107,7 @@ def pin(
             if metric in prev:
                 doc["metrics"][metric] = prev[metric]
             continue
-        doc["metrics"][metric] = {
+        entry = {
             "value": rec["value"],
             "unit": rec["unit"],
             "direction": rec["direction"],
@@ -105,6 +116,11 @@ def pin(
             ),
             "samples": len(perf_ledger.history(records, metric)),
         }
+        if budgets and metric in budgets:
+            entry["budget"] = float(budgets[metric])
+        elif "budget" in prev.get(metric, {}):
+            entry["budget"] = prev[metric]["budget"]
+        doc["metrics"][metric] = entry
     with open(baselines_path or BASELINES_DEFAULT, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -135,6 +151,12 @@ def compare(
             "delta_frac": round(delta, 4), "rel_tol": tol,
             "direction": direction, "unit": base.get("unit", ""),
         }
+        if base.get("budget") is not None:
+            budget = float(base["budget"])
+            row["budget"] = budget
+            over = (cur - budget) if direction == "lower" else (budget - cur)
+            (out["regressions"] if over > 0 else out["ok"]).append(row)
+            continue
         worse = -delta if direction == "higher" else delta
         if worse > tol:
             out["regressions"].append(row)
@@ -161,11 +183,20 @@ def main(argv: Optional[list] = None) -> int:
                    help="write baselines from the current ledger head")
     p.add_argument("--metrics", nargs="*", default=None,
                    help="with --pin: only re-pin these metrics")
+    p.add_argument("--budget", nargs="*", default=None, metavar="M=V",
+                   help="with --pin: gate metric M against absolute bound V "
+                   "instead of the relative baseline (survives re-pins)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
     if args.pin:
-        doc = pin(args.ledger, args.baselines, args.metrics)
+        budgets = None
+        if args.budget:
+            budgets = {}
+            for kv in args.budget:
+                m, _, v = kv.partition("=")
+                budgets[m] = float(v)
+        doc = pin(args.ledger, args.baselines, args.metrics, budgets)
         print(
             f"pinned {len(doc['metrics'])} baselines at "
             f"{doc['pinned_git_rev']} -> "
@@ -186,6 +217,13 @@ def main(argv: Optional[list] = None) -> int:
         print()
     else:
         for row in result["regressions"]:
+            if "budget" in row:
+                print(
+                    f"REGRESSION {row['metric']}: {row['value']:g} "
+                    f"{row['unit']} breaks budget {row['budget']:g} "
+                    f"({row['direction']} is better)"
+                )
+                continue
             print(
                 f"REGRESSION {row['metric']}: {row['value']:g} vs baseline "
                 f"{row['baseline']:g} {row['unit']} "

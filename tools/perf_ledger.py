@@ -152,7 +152,8 @@ def record_report(
     """Append a live tool report's headline metrics, reusing the same
     extractors as the legacy-artifact importer so live runs extend the
     backfilled trajectories under identical metric names. ``kind`` is
-    one of bench|pg|fleet|wan. Returns the number of records appended;
+    one of bench|pg|fleet|wan|recovery. Returns the number of records
+    appended;
     never raises into the calling bench."""
     try:
         extract = _REPORT_EXTRACTORS[kind]
@@ -328,6 +329,33 @@ def _wan_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _recovery_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """BENCH_RECOVERY.json (tools/recovery_drill.py): TTR percentiles,
+    the per-phase p95 decomposition, and per-transport heal bandwidth —
+    the numbers the recovery gate pins."""
+    src = f"tools/recovery_drill.py ({os.path.basename(fn)})"
+    summ = doc.get("summary") or {}
+    out = []
+    n_ep = summ.get("num_episodes")
+    extra = {"episodes": n_ep} if n_ep is not None else None
+    if summ.get("ttr_p50_s") is not None:
+        out.append(("recovery.ttr_p50_s", float(summ["ttr_p50_s"]), "s",
+                    "lower", "recovery", src, extra))
+    if summ.get("ttr_p95_s") is not None:
+        out.append(("recovery.ttr_p95_s", float(summ["ttr_p95_s"]), "s",
+                    "lower", "recovery", src, extra))
+    for ph, row in (summ.get("phases") or {}).items():
+        if isinstance(row, dict) and row.get("p95_s") is not None:
+            out.append((f"recovery.phase_p95_s.{ph}", float(row["p95_s"]),
+                        "s", "lower", "recovery", src, None))
+    for transport, row in (summ.get("heal_gib_s") or {}).items():
+        if isinstance(row, dict) and row.get("p50") is not None:
+            out.append((f"recovery.heal_gib_s.{transport}",
+                        float(row["p50"]), "GiB/s", "higher", "recovery",
+                        src, {"n": row.get("n"), "bytes": row.get("bytes")}))
+    return out
+
+
 # Live benches reuse the same extractors via record_report(), so one
 # metric name has exactly one extraction path (import-time and run-time).
 _REPORT_EXTRACTORS = {
@@ -335,6 +363,7 @@ _REPORT_EXTRACTORS = {
     "pg": _pg_records,
     "fleet": _fleet_records,
     "wan": _wan_records,
+    "recovery": _recovery_records,
 }
 
 

@@ -27,6 +27,11 @@ re-deriving it. ``--check`` asserts the tiling invariant (phases sum to
 the step window exactly), fraction sanity, and optionally
 ``--expect-exposed-allreduce F --tol T`` against a known ground truth.
 
+Journals are loaded through ``obs_report.load_events``, which is
+rotation-aware: when a journal has been size-rotated
+(``TORCHFT_JOURNAL_MAX_MB``), the ``.1`` segment is read before the
+live file so long-run analysis sees the full event stream in order.
+
 Usage::
 
     python tools/perf_report.py /tmp/journal/          # dir of *.jsonl

@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Recovery forensics over event journals: failure -> recovery episodes.
+
+Where ``perf_report.py`` attributes one steady-state step, this stitches
+per-replica journals into cross-replica **failure episodes** — from the
+trigger (error latch, abort, process loss) to the first committed step
+afterwards — and decomposes each episode's time-to-recover (TTR) into
+``detect / quorum / transfer / rebuild / catchup`` phases that tile the
+episode window exactly (``telemetry.detect_episodes``):
+
+* per episode: the primary (healing) replica, per-replica phase rows,
+  heal attempts with the ``cause``/``phase`` that killed each failed
+  attempt, transfer accounting from the transports' ``heal_xfer``
+  events (bytes, GiB/s, wire vs serialization vs lock-wait, retries);
+* root cause: a relaunch pins process loss on the relaunched replica,
+  else the earliest correlated ``chaos_inject``, else the earliest
+  latch — plus cascade edges to every other replica that aborted
+  inside the window;
+* run level: TTR p50/p95 (total and per phase) and heal GiB/s per
+  transport — the numbers ``recovery_drill.py`` pins in
+  BENCH_RECOVERY.json.
+
+The journal loader is rotation-aware (``obs_report.load_events`` reads
+the ``.1`` segment first), so an episode spanning a
+``TORCHFT_JOURNAL_MAX_MB`` rotation keeps its pre-rotation events.
+
+``--emit PATH`` re-journals each episode as a ``recovery_episode``
+event. ``--check`` asserts the tiling invariant (the five phases sum to
+each row's window exactly), non-negative phases, and optionally
+``--min-episodes N``.
+
+Usage::
+
+    python tools/recovery_report.py /tmp/journal/      # dir of *.jsonl
+    python tools/recovery_report.py a.jsonl b.jsonl --json
+    python tools/recovery_report.py --from-bench BENCH_RECOVERY.json --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import obs_report  # noqa: E402
+from torchft_tpu import telemetry  # noqa: E402
+
+# Phase tiling must cover each episode row's window exactly
+# (construction guarantees it; drift beyond float noise means the
+# interval math broke).
+TILE_EPS_S = 1e-6
+
+
+def _percentile(vals: List[float], pct: float) -> Optional[float]:
+    if not vals:
+        return None
+    vs = sorted(vals)
+    k = (len(vs) - 1) * (pct / 100.0)
+    lo, hi = int(k), min(int(k) + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (k - lo)
+
+
+def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Full report dict from a merged event list."""
+    episodes = telemetry.detect_episodes(events)
+    closed = [e for e in episodes if not e["open"]]
+    ttrs = [e["ttr_s"] for e in closed]
+    phases: Dict[str, Dict[str, Any]] = {}
+    for ph in telemetry.RECOVERY_PHASES:
+        vals = [
+            e["replicas"][e["primary"]]["phases"][ph] for e in closed
+        ]
+        phases[ph] = {
+            "p50_s": _percentile(vals, 50),
+            "p95_s": _percentile(vals, 95),
+            "max_s": max(vals) if vals else None,
+        }
+    # Heal bandwidth per transport, over every receiver-side transfer.
+    gib: Dict[str, List[float]] = {}
+    bytes_by_transport: Dict[str, int] = {}
+    for e in episodes:
+        for row in e["replicas"].values():
+            x = row["xfer"]
+            if x and x.get("gib_s") is not None:
+                t = str(x.get("transport"))
+                gib.setdefault(t, []).append(x["gib_s"])
+                bytes_by_transport[t] = (
+                    bytes_by_transport.get(t, 0) + int(x["nbytes"])
+                )
+    heal_gib_s = {
+        t: {
+            "p50": _percentile(v, 50),
+            "min": min(v),
+            "max": max(v),
+            "n": len(v),
+            "bytes": bytes_by_transport.get(t, 0),
+        }
+        for t, v in sorted(gib.items())
+    }
+    causes: Dict[str, int] = {}
+    for e in episodes:
+        causes[e["root_cause"]["kind"]] = (
+            causes.get(e["root_cause"]["kind"], 0) + 1
+        )
+    return {
+        "episodes": episodes,
+        "summary": {
+            "num_episodes": len(episodes),
+            "num_open": sum(1 for e in episodes if e["open"]),
+            "ttr_p50_s": _percentile(ttrs, 50),
+            "ttr_p95_s": _percentile(ttrs, 95),
+            "ttr_max_s": max(ttrs) if ttrs else None,
+            "phases": phases,
+            "heal_gib_s": heal_gib_s,
+            "failed_attempts": sum(
+                r["failed_attempts"]
+                for e in episodes
+                for r in e["replicas"].values()
+            ),
+            "root_causes": causes,
+        },
+    }
+
+
+def check(report: Dict[str, Any]) -> List[str]:
+    """Invariant violations (empty = pass): per-row phase tiling, phase
+    non-negativity, window sanity, root-cause presence."""
+    errs: List[str] = []
+    for e in report["episodes"]:
+        if e["t_end"] < e["t_start"]:
+            errs.append(f"{e['id']}: inverted window")
+        if not e["replicas"]:
+            errs.append(f"{e['id']}: no replica rows")
+        if not e.get("root_cause", {}).get("replica"):
+            errs.append(f"{e['id']}: missing root cause")
+        for rid, row in e["replicas"].items():
+            total = row["t_end"] - row["t_start"]
+            tiled = sum(row["phases"].values())
+            if any(v < -TILE_EPS_S for v in row["phases"].values()):
+                errs.append(f"{e['id']}/{rid}: negative phase")
+            if abs(tiled - total) > max(TILE_EPS_S, 1e-9 * abs(total)):
+                errs.append(
+                    f"{e['id']}/{rid}: phases sum {tiled:.6f}s != window "
+                    f"{total:.6f}s"
+                )
+            for a in row["attempts"]:
+                if not a.get("ok") and not a.get("cause"):
+                    errs.append(
+                        f"{e['id']}/{rid}: failed attempt without a "
+                        "latched cause"
+                    )
+    return errs
+
+
+def emit_episodes(report: Dict[str, Any], path: str) -> int:
+    """Re-journal episodes as ``recovery_episode`` events; returns
+    count (one event per episode, keyed to the primary replica)."""
+    log = telemetry.EventLog(path, replica_id="recovery_report")
+    n = 0
+    try:
+        for e in report["episodes"]:
+            prim = e["replicas"][e["primary"]]
+            log.emit(
+                "recovery_episode",
+                step=e.get("max_step"),
+                replica_id=e["primary"],
+                trace=e.get("trace"),
+                episode=e["id"],
+                ttr_ms=round(e["ttr_s"] * 1e3, 3),
+                detect_ms=round(prim["phases"]["detect"] * 1e3, 3),
+                quorum_ms=round(prim["phases"]["quorum"] * 1e3, 3),
+                transfer_ms=round(prim["phases"]["transfer"] * 1e3, 3),
+                rebuild_ms=round(prim["phases"]["rebuild"] * 1e3, 3),
+                catchup_ms=round(prim["phases"]["catchup"] * 1e3, 3),
+                root_cause=e["root_cause"]["kind"],
+                root_replica=e["root_cause"]["replica"],
+                cascade=[c["to"] for c in e["cascade"]],
+                failed_attempts=sum(
+                    r["failed_attempts"] for r in e["replicas"].values()
+                ),
+                open=e["open"],
+            )
+            n += 1
+    finally:
+        log.close()
+    return n
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    out: List[str] = []
+    s = report["summary"]
+    for e in report["episodes"]:
+        rc = e["root_cause"]
+        state = "OPEN" if e["open"] else f"ttr {e['ttr_s']:.3f}s"
+        detail = ""
+        if rc["kind"] == "chaos" and rc.get("chaos"):
+            c = rc["chaos"]
+            detail = f" ({c.get('kind')}@{c.get('site')})"
+        elif rc["kind"] == "latch" and rc.get("signal"):
+            sig = rc["signal"]
+            detail = f" ({sig.get('event')}"
+            if sig.get("cause"):
+                detail += f": {sig['cause']}"
+            if sig.get("phase"):
+                detail += f"/{sig['phase']}"
+            detail += ")"
+        out.append(
+            f"episode {e['id']}: {state}, root cause {rc['kind']} on "
+            f"replica {rc['replica']}{detail}, primary {e['primary']}"
+            + (f", trace {e['trace']}" if e.get("trace") else "")
+        )
+        for edge in e["cascade"]:
+            out.append(
+                f"  cascade: {edge['from']} -> {edge['to']} "
+                f"({edge['signal']}, +{edge['dt_s']:.3f}s)"
+            )
+        out.append(
+            f"  {'replica':>10} {'detect':>8} {'quorum':>8} "
+            f"{'transfer':>8} {'rebuild':>8} {'catchup':>8} {'ttr':>8}"
+        )
+        for rid in sorted(e["replicas"]):
+            row = e["replicas"][rid]
+            ph = row["phases"]
+            mark = " <- primary" if rid == e["primary"] else ""
+            out.append(
+                f"  {rid:>10} {ph['detect']:>8.3f} {ph['quorum']:>8.3f} "
+                f"{ph['transfer']:>8.3f} {ph['rebuild']:>8.3f} "
+                f"{ph['catchup']:>8.3f} {row['ttr_s']:>8.3f}{mark}"
+            )
+            for a in row["attempts"]:
+                if a.get("ok"):
+                    out.append(
+                        f"    heal ok from peer {a.get('peer')} in "
+                        f"{a.get('elapsed_s', 0.0):.3f}s"
+                    )
+                else:
+                    out.append(
+                        f"    heal FAILED [{a.get('cause')}] in phase "
+                        f"{a.get('phase')}: {a.get('error')}"
+                    )
+            x = row["xfer"]
+            if x:
+                gib = f"{x['gib_s']:.3f} GiB/s" if x.get("gib_s") else "-"
+                out.append(
+                    f"    xfer {x['nbytes'] / (1 << 20):.2f} MiB over "
+                    f"{x['transport']} at {gib} (wire {x['wire_s']:.3f}s, "
+                    f"ser {x['ser_s']:.3f}s, lock {x['lock_s']:.3f}s, "
+                    f"{x['retries']} retries)"
+                )
+        out.append("")
+    out.append(
+        f"{s['num_episodes']} episode(s) ({s['num_open']} open), "
+        + (
+            f"TTR p50 {s['ttr_p50_s']:.3f}s p95 {s['ttr_p95_s']:.3f}s"
+            if s["ttr_p50_s"] is not None
+            else "TTR n/a"
+        )
+        + f", {s['failed_attempts']} failed heal attempt(s)"
+    )
+    for t, g in s["heal_gib_s"].items():
+        out.append(
+            f"heal bandwidth [{t}]: p50 {g['p50']:.3f} GiB/s over "
+            f"{g['n']} transfer(s), {g['bytes'] / (1 << 20):.2f} MiB"
+        )
+    return "\n".join(out)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*",
+                   help="journal files or directories of *.jsonl")
+    p.add_argument("--from-bench", metavar="FILE", default=None,
+                   help="read the journal dir from a BENCH_RECOVERY.json "
+                   "artifact (its journal_dir field)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON")
+    p.add_argument("--emit", metavar="PATH", default=None,
+                   help="append recovery_episode events (JSONL) here")
+    p.add_argument("--check", action="store_true",
+                   help="assert tiling/root-cause invariants; exit 1 on "
+                   "violation")
+    p.add_argument("--min-episodes", type=int, default=0,
+                   help="with --check: at least this many episodes")
+    args = p.parse_args(argv)
+
+    paths = list(args.paths)
+    if args.from_bench:
+        with open(args.from_bench) as f:
+            doc = json.load(f)
+        jd = doc.get("journal_dir")
+        if not jd:
+            print(f"{args.from_bench} has no journal_dir", file=sys.stderr)
+            return 1
+        paths.append(jd)
+    if not paths:
+        p.error("give journal paths or --from-bench")
+
+    events = obs_report.load_events(paths)
+    if not events:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    report = analyze(events)
+
+    n_emitted = 0
+    if args.emit:
+        n_emitted = emit_episodes(report, args.emit)
+
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(render_text(report))
+
+    if args.check:
+        errs = check(report)
+        if args.min_episodes and (
+            report["summary"]["num_episodes"] < args.min_episodes
+        ):
+            errs.append(
+                f"{report['summary']['num_episodes']} episode(s) < "
+                f"--min-episodes {args.min_episodes}"
+            )
+        if args.emit and n_emitted == 0:
+            errs.append("--emit produced no recovery_episode events")
+        if errs:
+            for e in errs:
+                print(f"CHECK FAIL: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"recovery_report check OK: "
+            f"{report['summary']['num_episodes']} episode(s), phases "
+            f"tile, {n_emitted} recovery_episode events emitted"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,12 @@
 #                                       # -> perf_report critical-path/overlap
 #                                       # check, then perf_gate --check vs the
 #                                       # pinned BENCH_LEDGER baselines
+#        bash tools/suite_gate.sh recovery # recovery forensics drill:
+#                                       # kill+heal with heal chaos armed ->
+#                                       # BENCH_RECOVERY.json, episode report
+#                                       # --check (phases must tile TTR), then
+#                                       # perf_gate --check vs pinned TTR /
+#                                       # heal-bandwidth baselines
 #        bash tools/suite_gate.sh wan   # degraded-network drill: 2-region
 #                                       # DiLoCo over a throttled wan link
 #                                       # with mid-collective stripe tears
@@ -100,6 +106,17 @@ if [ "${1:-}" = "wan" ]; then
   echo "== wan replay: same seed must reproduce the injection multiset =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/wan_drill.py \
     --replay BENCH_WAN.json
+fi
+
+if [ "${1:-}" = "recovery" ]; then
+  echo "== recovery drill: kill+heal under heal chaos -> BENCH_RECOVERY =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/recovery_drill.py --quick \
+    || exit 1
+  echo "== recovery report: episode phases must tile TTR exactly =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/recovery_report.py \
+    --from-bench BENCH_RECOVERY.json --check --min-episodes 1 || exit 1
+  echo "== recovery gate: ledger head vs pinned baselines =="
+  exec timeout 120 python tools/perf_gate.py --check
 fi
 
 if [ "${1:-}" = "san" ]; then

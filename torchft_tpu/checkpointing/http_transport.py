@@ -90,9 +90,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(400, "bad step")
             return
         what = parts[2]
+        t_lock0 = time.monotonic()
         if not state.lock.acquire_read(timeout=30.0):
             self.send_error(503, "checkpoint busy")
             return
+        lock_s = time.monotonic() - t_lock0
         try:
             if state.step != step:
                 self.send_error(
@@ -115,12 +117,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # server never builds a payload-sized pickle blob (a 12 GB
                 # state would otherwise spike to 2x its size per request).
                 assigned = list(range(len(state.buffers)))
-                self._respond_stream(
+                stats = self._respond_stream(
                     state.meta,
                     assigned,
                     state.buffers,
                     truncate_frac=trunc.frac if trunc else None,
                 )
+                self._emit_xfer(step, what, lock_s, stats)
             elif what.startswith("chunk_"):
                 idx = int(what[len("chunk_"):])
                 if state.num_chunks == 0 or idx >= state.num_chunks:
@@ -129,12 +132,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # Round-robin buffer split (reference: values[i::num_chunks],
                 # http_transport.py:288-299); chunk 0 carries the meta skeleton.
                 assigned = list(range(idx, len(state.buffers), state.num_chunks))
-                self._respond_stream(
+                stats = self._respond_stream(
                     state.meta if idx == 0 else None,
                     assigned,
                     state.buffers,
                     truncate_frac=trunc.frac if trunc else None,
                 )
+                self._emit_xfer(step, what, lock_s, stats)
             else:
                 self.send_error(404, "unknown resource")
                 return
@@ -153,6 +157,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _emit_xfer(
+        self, step: int, what: str, lock_s: float, stats: dict
+    ) -> None:
+        """Donor-side heal transfer accounting: one ``heal_xfer`` per
+        served payload request, splitting the serve wall into lock-wait
+        (RWLock read acquire), serialization (header pickle + raw views)
+        and wire (socket writes)."""
+        log = get_event_log()
+        if log is None:
+            return
+        log.emit(
+            "heal_xfer",
+            step=step,
+            transport="http",
+            dir="send",
+            what=what,
+            nbytes=int(stats["nbytes"]),
+            elapsed_s=lock_s + stats["ser_s"] + stats["wire_s"],
+            wire_s=stats["wire_s"],
+            ser_s=stats["ser_s"],
+            lock_s=lock_s,
+            retries=0,
+            truncated=stats["truncated"],
+        )
+
     def _respond_stream(
         self,
         meta: Any,
@@ -168,13 +197,20 @@ class _Handler(BaseHTTPRequestHandler):
         ``truncate_frac`` (chaos ``ckpt_truncate``) stops the stream after
         that fraction of the payload bytes — mid-record, with the full
         Content-Length already advertised — and force-closes the
-        connection so the receiver sees a short read, not a clean end."""
+        connection so the receiver sees a short read, not a clean end.
+
+        Returns ``{nbytes, ser_s, wire_s, truncated}`` for the caller's
+        ``heal_xfer`` accounting (bytes actually written; serialization =
+        header pickle + raw-view construction; wire = socket writes)."""
+        t_ser0 = time.monotonic()
         header = pickle.dumps(
             {"meta": meta, "indices": assigned},
             protocol=pickle.HIGHEST_PROTOCOL,
         )
         views = [_raw_view(buffers[i]) for i in assigned]
+        ser_s = time.monotonic() - t_ser0
         total = 8 + len(header) + sum(8 + v.nbytes for v in views)
+        t_wire0 = time.monotonic()
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(total))
@@ -185,16 +221,26 @@ class _Handler(BaseHTTPRequestHandler):
         budget = (
             int(payload * truncate_frac) if truncate_frac is not None else -1
         )
+        sent = 0
         for v in views:
             self.wfile.write(_LEN.pack(v.nbytes))
             if budget >= 0 and v.nbytes > budget:
                 self.wfile.write(v[:budget])
                 self.wfile.flush()
                 self.close_connection = True
-                return
+                sent += budget
+                return {
+                    "nbytes": sent, "ser_s": ser_s,
+                    "wire_s": time.monotonic() - t_wire0, "truncated": True,
+                }
             self.wfile.write(v)
+            sent += v.nbytes
             if budget >= 0:
                 budget -= v.nbytes
+        return {
+            "nbytes": sent, "ser_s": ser_s,
+            "wire_s": time.monotonic() - t_wire0, "truncated": False,
+        }
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -230,7 +276,7 @@ class HTTPTransport(CheckpointTransport):
         # aliases contiguous numpy inputs, and the optimizer mutates those
         # same arrays while peers are still fetching.
         # Wall-time logged like the reference's _timeit (http_transport.py:31-36).
-        with timeit("torchft::http_transport::stage_checkpoint"):
+        with timeit("torchft::http_transport::stage_checkpoint") as t_stage:
             live_ids = _array_leaf_ids(state_dict)
             meta, buffers = split_state(state_dict)
             # Copy ONLY buffers that may alias memory the trainer can
@@ -246,18 +292,36 @@ class HTTPTransport(CheckpointTransport):
                 else b
                 for b in buffers
             ]
+        t_lock0 = time.monotonic()
         with self._state.lock.w_lock(timeout):
+            lock_s = time.monotonic() - t_lock0
             self._state.meta = meta
             self._state.buffers = buffers
             self._state.step = step
         log = get_event_log()
         if log is not None:
+            nbytes = int(sum(b.nbytes for b in buffers))
             log.emit(
                 "ckpt_send",
                 step=step,
                 transport="http",
                 dst_ranks=list(dst_ranks),
-                nbytes=int(sum(b.nbytes for b in buffers)),
+                nbytes=nbytes,
+            )
+            # Staging accounting: ser = host copy/split under no lock,
+            # lock = write-lock wait against in-flight peer fetches. The
+            # wire time lands in the server handler's dir="send" events.
+            log.emit(
+                "heal_xfer",
+                step=step,
+                transport="http",
+                dir="stage",
+                nbytes=nbytes,
+                elapsed_s=t_stage["elapsed_s"] + lock_s,
+                wire_s=0.0,
+                ser_s=t_stage["elapsed_s"],
+                lock_s=lock_s,
+                retries=0,
             )
 
     def disallow_checkpoint(self) -> None:
@@ -276,9 +340,10 @@ class HTTPTransport(CheckpointTransport):
         )
         num_chunks = info["num_chunks"]
         if num_chunks <= 1:
-            meta, parts = self._fetch_records(
+            meta, parts, stats = self._fetch_records(
                 f"{base}/checkpoint/{step}/full", timeout
             )
+            chunk_stats = [stats]
         else:
             # Parallel chunk fetch (reference: http_transport.py:244-267).
             with ThreadPoolExecutor(max_workers=num_chunks) as pool:
@@ -290,12 +355,15 @@ class HTTPTransport(CheckpointTransport):
                         range(num_chunks),
                     )
                 )
-            meta = next(m for m, _ in chunks if m is not None)
+            meta = next(m for m, _, _ in chunks if m is not None)
             parts = {}
-            for _, p in chunks:
+            chunk_stats = []
+            for _, p, s in chunks:
                 parts.update(p)
+                chunk_stats.append(s)
         # Raw record bytes -> typed flat arrays via the meta's refs
         # (frombuffer: no second copy).
+        t_ser0 = time.monotonic()
         refs = collect_refs(meta)
         buffers: List[Optional[Any]] = [None] * len(refs)
         nbytes = 0
@@ -305,6 +373,7 @@ class HTTPTransport(CheckpointTransport):
             buffers[ref.index] = np.frombuffer(
                 raw, dtype=np.dtype(ref.dtype)
             )
+        rebuild_ser_s = time.monotonic() - t_ser0
         log = get_event_log()
         if log is not None:
             log.emit(
@@ -314,6 +383,33 @@ class HTTPTransport(CheckpointTransport):
                 peer=src_rank,
                 nbytes=int(nbytes),
             )
+            # Receiver-side heal transfer accounting: wall = first fetch
+            # start -> now (the chunk fetches overlap in threads, so their
+            # elapsed sums would double-count); wire/ser sum over chunks.
+            t0 = min(s["t0"] for s in chunk_stats)
+            log.emit(
+                "heal_xfer",
+                step=step,
+                transport="http",
+                dir="recv",
+                peer=src_rank,
+                nbytes=int(nbytes),
+                elapsed_s=time.monotonic() - t0,
+                wire_s=sum(s["wire_s"] for s in chunk_stats),
+                ser_s=rebuild_ser_s + sum(s["ser_s"] for s in chunk_stats),
+                lock_s=0.0,
+                retries=sum(s["retries"] for s in chunk_stats),
+                chunks=[
+                    {
+                        "i": i,
+                        "nbytes": int(s["nbytes"]),
+                        "elapsed_s": s["elapsed_s"],
+                        "wire_s": s["wire_s"],
+                        "retries": s["retries"],
+                    }
+                    for i, s in enumerate(chunk_stats[:16])
+                ],
+            )
         return join_state(meta, buffers)
 
     @staticmethod
@@ -321,16 +417,32 @@ class HTTPTransport(CheckpointTransport):
         """Fetches one streamed response: pickle({"meta","indices"})
         header, then each buffer's raw bytes, read record-by-record off
         the socket (no payload-sized intermediate).  Same bounded 404
-        retry as _fetch (sender staging can race the receiver's plan)."""
+        retry as _fetch (sender staging can race the receiver's plan).
+
+        Returns ``(meta, parts, stats)`` where stats carries the
+        per-chunk ``heal_xfer`` accounting: wall window, wire time
+        (socket reads), deserialize time (header unpickle), bytes, and
+        the 404-poll retry count."""
         _chaos.maybe_stall("heal", "ckpt:fetch", match=url)
         deadline = time.monotonic() + timeout
+        retries = 0
         while True:
             try:
+                t0 = time.monotonic()
+                wire_s = ser_s = 0.0
+                nbytes = 0
                 with urllib.request.urlopen(url, timeout=timeout) as resp:
-                    hlen = _LEN.unpack(_read_exact(resp, 8))[0]
-                    header = pickle.loads(_read_exact(resp, hlen))
+                    t_r0 = time.monotonic()
+                    hraw = _read_exact(resp, 8)
+                    hlen = _LEN.unpack(hraw)[0]
+                    hbody = _read_exact(resp, hlen)
+                    wire_s += time.monotonic() - t_r0
+                    t_s0 = time.monotonic()
+                    header = pickle.loads(hbody)
+                    ser_s += time.monotonic() - t_s0
                     parts = {}
                     for idx in header["indices"]:
+                        t_r0 = time.monotonic()
                         blen = _LEN.unpack(_read_exact(resp, 8))[0]
                         # Into a WRITABLE bytearray: healed arrays get
                         # mutated in place by training (frombuffer over
@@ -343,11 +455,22 @@ class HTTPTransport(CheckpointTransport):
                             if not n:
                                 raise EOFError("stream ended mid-record")
                             got += n
+                        wire_s += time.monotonic() - t_r0
+                        nbytes += blen
                         parts[idx] = buf
-                    return header["meta"], parts
+                    stats = {
+                        "t0": t0,
+                        "elapsed_s": time.monotonic() - t0,
+                        "wire_s": wire_s,
+                        "ser_s": ser_s,
+                        "nbytes": nbytes,
+                        "retries": retries,
+                    }
+                    return header["meta"], parts, stats
             except urllib.error.HTTPError as e:
                 if e.code != 404 or time.monotonic() >= deadline:
                     raise
+                retries += 1
                 time.sleep(0.05)
 
     @staticmethod

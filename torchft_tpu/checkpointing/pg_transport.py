@@ -11,6 +11,7 @@ as the replica-axis collectives.
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -63,21 +64,49 @@ class PGTransport(CheckpointTransport):
         if self._sharded:
             self._send_sharded_streaming(dst_ranks, step, state_dict, timeout)
             return
+        t_ser0 = time.monotonic()
         meta, buffers = split_state(state_dict)
         blob = np.frombuffer(pickle.dumps(meta), dtype=np.uint8)
+        ser_s = time.monotonic() - t_ser0
+        wire_s = 0.0
+        chunk_wire = [0.0] * len(buffers)
         for dst in dst_ranks:
             # Length-then-meta-then-buffers; tags keep steps distinct.
+            t_w0 = time.monotonic()
             self._send_preamble(dst, step, blob, timeout)
+            wire_s += time.monotonic() - t_w0
             for i, buf in enumerate(buffers):
+                t_w0 = time.monotonic()
                 self._pg.send([buf], dst, tag=f"ckpt{step}.t{i}").wait(timeout)
+                dt = time.monotonic() - t_w0
+                wire_s += dt
+                chunk_wire[i] += dt
         log = get_event_log()
         if log is not None:
+            nbytes = int(sum(b.nbytes for b in buffers))
             log.emit(
                 "ckpt_send",
                 step=step,
                 transport="pg",
                 dst_ranks=list(dst_ranks),
-                nbytes=int(sum(b.nbytes for b in buffers)),
+                nbytes=nbytes,
+            )
+            log.emit(
+                "heal_xfer",
+                step=step,
+                transport="pg",
+                dir="send",
+                dst_ranks=list(dst_ranks),
+                nbytes=nbytes,
+                elapsed_s=ser_s + wire_s,
+                wire_s=wire_s,
+                ser_s=ser_s,
+                lock_s=0.0,
+                retries=0,
+                chunks=[
+                    {"i": i, "nbytes": int(b.nbytes), "wire_s": chunk_wire[i]}
+                    for i, b in enumerate(buffers[:16])
+                ],
             )
 
     def _send_preamble(
@@ -104,10 +133,15 @@ class PGTransport(CheckpointTransport):
             split_state_sharded_lazy,
         )
 
-        meta, thunks = split_state_sharded_lazy(state_dict)
+        pull_stats: List[dict] = []
+        meta, thunks = split_state_sharded_lazy(state_dict, stats=pull_stats)
         blob = np.frombuffer(pickle.dumps(meta), dtype=np.uint8)
+        wire_s = 0.0
+        chunk_wire = [0.0] * len(thunks)
         for dst in dst_ranks:
+            t_w0 = time.monotonic()
             self._send_preamble(dst, step, blob, timeout)
+            wire_s += time.monotonic() - t_w0
         # Each shard is pulled device->host ONCE and sent to every dst
         # before its host copy is released (a multi-dst heal must not
         # re-pull the whole state per destination).  No per-dst failure
@@ -125,10 +159,44 @@ class PGTransport(CheckpointTransport):
                 else:
                     pending = None
                 for dst in dst_ranks:
+                    t_w0 = time.monotonic()
                     self._pg.send(
                         [buf], dst, tag=f"ckpt{step}.t{i}"
                     ).wait(timeout)
+                    dt = time.monotonic() - t_w0
+                    wire_s += dt
+                    chunk_wire[i] += dt
                 del buf  # release the host copy before the next pull
+        log = get_event_log()
+        if log is not None:
+            # Per-stripe accounting: ser = device->host shard pulls (the
+            # lazy thunks self-report), wire = socket send waits. The
+            # 1-deep prefetch overlaps them, so elapsed <= ser + wire.
+            by_i = {s["i"]: s for s in pull_stats}
+            nbytes = int(sum(s["nbytes"] for s in pull_stats))
+            log.emit(
+                "heal_xfer",
+                step=step,
+                transport="pg",
+                dir="send",
+                sharded=True,
+                dst_ranks=list(dst_ranks),
+                nbytes=nbytes,
+                elapsed_s=wire_s + sum(s["pull_s"] for s in pull_stats),
+                wire_s=wire_s,
+                ser_s=sum(s["pull_s"] for s in pull_stats),
+                lock_s=0.0,
+                retries=0,
+                chunks=[
+                    {
+                        "i": i,
+                        "nbytes": int(by_i[i]["nbytes"]) if i in by_i else 0,
+                        "wire_s": chunk_wire[i],
+                        "pull_s": by_i[i]["pull_s"] if i in by_i else 0.0,
+                    }
+                    for i in range(min(len(thunks), 16))
+                ],
+            )
 
     @timed("torchft::pg_transport::recv_checkpoint")
     def recv_checkpoint(
@@ -141,9 +209,14 @@ class PGTransport(CheckpointTransport):
                 "sharded PGTransport receive needs state_dict_fn to "
                 "supply the destination shardings"
             )
+        t_all0 = time.monotonic()
+        t_w0 = time.monotonic()
         (length,) = self._pg.recv(src_rank, tag=f"ckpt{step}.len").wait(timeout)
         (blob,) = self._pg.recv(src_rank, tag=f"ckpt{step}.meta").wait(timeout)
+        wire_s = time.monotonic() - t_w0
+        t_s0 = time.monotonic()
         meta = pickle.loads(blob.tobytes()[: int(length[0])])
+        ser_s = time.monotonic() - t_s0
 
         if self._sharded:
             from torchft_tpu.checkpointing.sharded import (
@@ -161,31 +234,69 @@ class PGTransport(CheckpointTransport):
             # _send_sharded_streaming).
             target = self._state_dict_fn()
             built: dict = {}
+            nbytes = 0
+            stripes: List[dict] = []
             for ref, t_leaf in collect_ref_target_pairs(meta, target):
                 if isinstance(ref, _ShardedRef):
                     bufs = []
+                    t_w0 = time.monotonic()
+                    leaf_bytes = 0
                     for k in range(len(ref.shapes)):
                         (buf,) = self._pg.recv(
                             src_rank, tag=f"ckpt{step}.t{ref.first + k}"
                         ).wait(timeout)
+                        leaf_bytes += int(buf.nbytes)
                         bufs.append(buf.reshape(-1))
+                    leaf_wire = time.monotonic() - t_w0
+                    t_b0 = time.monotonic()
                     built[ref.first] = build_sharded_leaf(
                         ref, bufs, t_leaf,
                         delete_target_leaf=self._delete_stale,
                     )
+                    leaf_build = time.monotonic() - t_b0
                     del bufs  # host copies released leaf-by-leaf
                 else:
+                    t_w0 = time.monotonic()
                     (buf,) = self._pg.recv(
                         src_rank, tag=f"ckpt{step}.t{ref.index}"
                     ).wait(timeout)
+                    leaf_bytes = int(buf.nbytes)
+                    leaf_wire = time.monotonic() - t_w0
+                    t_b0 = time.monotonic()
                     built[ref.index] = place_plain_leaf(
                         ref, buf.reshape(-1), t_leaf
                     )
+                    leaf_build = time.monotonic() - t_b0
+                wire_s += leaf_wire
+                ser_s += leaf_build
+                nbytes += leaf_bytes
+                if len(stripes) < 16:
+                    stripes.append({
+                        "i": getattr(ref, "first", getattr(ref, "index", 0)),
+                        "nbytes": leaf_bytes,
+                        "wire_s": leaf_wire,
+                        "build_s": leaf_build,
+                    })
             log = get_event_log()
             if log is not None:
                 log.emit(
                     "ckpt_recv", step=step, transport="pg", peer=src_rank,
                     sharded=True,
+                )
+                log.emit(
+                    "heal_xfer",
+                    step=step,
+                    transport="pg",
+                    dir="recv",
+                    sharded=True,
+                    peer=src_rank,
+                    nbytes=nbytes,
+                    elapsed_s=time.monotonic() - t_all0,
+                    wire_s=wire_s,
+                    ser_s=ser_s,
+                    lock_s=0.0,
+                    retries=0,
+                    chunks=stripes,
                 )
             return substitute_built_leaves(meta, built)
 
@@ -193,19 +304,46 @@ class PGTransport(CheckpointTransport):
 
         refs = collect_refs(meta)
         buffers = [None] * len(refs)
+        chunk_wire = []
         for ref in refs:
+            t_w0 = time.monotonic()
             (buf,) = self._pg.recv(src_rank, tag=f"ckpt{step}.t{ref.index}").wait(
                 timeout
             )
+            dt = time.monotonic() - t_w0
+            wire_s += dt
+            if len(chunk_wire) < 16:
+                chunk_wire.append({
+                    "i": ref.index, "nbytes": int(buf.nbytes), "wire_s": dt,
+                })
             buffers[ref.index] = buf.reshape(-1)
+        nbytes = int(sum(b.nbytes for b in buffers if b is not None))
         log = get_event_log()
         if log is not None:
             log.emit(
                 "ckpt_recv", step=step, transport="pg", peer=src_rank,
-                nbytes=int(sum(b.nbytes for b in buffers if b is not None)),
+                nbytes=nbytes,
             )
         inplace = self._state_dict_fn() if self._state_dict_fn else None
-        return join_state(meta, buffers, inplace_into=inplace)
+        t_j0 = time.monotonic()
+        out = join_state(meta, buffers, inplace_into=inplace)
+        ser_s += time.monotonic() - t_j0
+        if log is not None:
+            log.emit(
+                "heal_xfer",
+                step=step,
+                transport="pg",
+                dir="recv",
+                peer=src_rank,
+                nbytes=nbytes,
+                elapsed_s=time.monotonic() - t_all0,
+                wire_s=wire_s,
+                ser_s=ser_s,
+                lock_s=0.0,
+                retries=0,
+                chunks=chunk_wire,
+            )
+        return out
 
     def disallow_checkpoint(self) -> None:
         pass  # nothing is served passively

@@ -27,6 +27,7 @@ group on an identically-configured slice.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
@@ -79,6 +80,7 @@ def _index_key(index: Tuple) -> Tuple:
 
 def split_state_sharded_lazy(
     obj: Any,
+    stats: Optional[List[dict]] = None,
 ) -> Tuple[Any, List]:
     """Like ``_serialization.split_state`` but jax leaves contribute one
     buffer per UNIQUE addressable shard — no gather of the global array,
@@ -89,8 +91,28 @@ def split_state_sharded_lazy(
     (shapes/indices); the device->host pulls happen thunk-by-thunk, so a
     streaming sender holds O(one shard) on the host instead of the whole
     state — the difference between healing a 32 GB state and OOMing the
-    sending host."""
+    sending host.
+
+    When ``stats`` is given, each thunk appends
+    ``{"i", "nbytes", "pull_s"}`` as it runs — the per-stripe
+    device->host pull accounting behind the transports' ``heal_xfer``
+    serialization split (thunks may run on a prefetch thread; list
+    appends are atomic)."""
     thunks: List = []
+
+    def _accounted(fn, i: int):
+        if stats is None:
+            return fn
+        def run():  # noqa: ANN202
+            t0 = time.monotonic()
+            buf = fn()
+            stats.append({
+                "i": i,
+                "nbytes": int(buf.nbytes),
+                "pull_s": time.monotonic() - t0,
+            })
+            return buf
+        return run
 
     def walk(x: Any) -> Any:
         if _is_sharded_jax(x):
@@ -108,9 +130,10 @@ def split_state_sharded_lazy(
                     uniq[key] = len(shapes)
                     shapes.append(tuple(s.data.shape))  # metadata only
                     keys.append(key)
-                    thunks.append(
-                        lambda s=s: np.ascontiguousarray(np.asarray(s.data))
-                    )
+                    thunks.append(_accounted(
+                        lambda s=s: np.ascontiguousarray(np.asarray(s.data)),
+                        len(thunks),
+                    ))
                 slot_map.append(uniq[key])
             return _ShardedRef(
                 first, shapes, slot_map, str(x.dtype), tuple(x.shape),
@@ -119,7 +142,9 @@ def split_state_sharded_lazy(
         if _is_array(x) and not np.isscalar(x):
             arr = np.asarray(x)
             ref = _TensorRef(len(thunks), str(arr.dtype), tuple(arr.shape))
-            thunks.append(lambda arr=arr: np.ascontiguousarray(arr))
+            thunks.append(_accounted(
+                lambda arr=arr: np.ascontiguousarray(arr), len(thunks),
+            ))
             return ref
         if isinstance(x, dict):
             return {k: walk(v) for k, v in x.items()}

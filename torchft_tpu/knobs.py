@@ -308,6 +308,12 @@ _ALL = [
         "Per-shard microbatch chunk size used when computing loss without materializing full logits.",
     ),
     _k(
+        "TORCHFT_TTR_BUDGET_S",
+        "float",
+        "60",
+        "Recovery time-to-restore budget (seconds): tools/obs_top.py flags any replica whose heal p95 exceeds it, and docs/FAULT_MODEL.md's TTR table is written against it.",
+    ),
+    _k(
         "TORCHFT_EXPORT_MAX_REPLICAS",
         "int",
         "64",

@@ -458,7 +458,10 @@ class Manager:
                     self._apply_pending_state_dict()
                 except Exception as e:  # noqa: BLE001 - latched, gate skips
                     self._logger.exception(f"apply healed state failed: {e}")
-                    self._journal("heal_failed", error=str(e)[:200])
+                    self._journal(
+                        "heal_failed", error=str(e)[:200],
+                        cause=type(e).__name__, phase="apply",
+                    )
                     self.report_error(e)
 
     def wait_quorum(self) -> None:
@@ -611,6 +614,9 @@ class Manager:
             def _heal_left() -> float:
                 return max(heal_deadline - time.monotonic(), 0.001)
 
+            # Which stage of the heal the exception escaped from; latched
+            # into heal_failed so a retried heal shows why attempt 1 died.
+            heal_phase = "plan"
             try:
                 if result.recover_dst_replica_ranks:
                     inj = _chaos.maybe(
@@ -619,6 +625,7 @@ class Manager:
                     )
                     if inj is not None:
                         raise _chaos.ChaosError(f"[chaos] heal aborted: {inj}")
+                    heal_phase = "send"
                     self._logger.info(
                         f"sending checkpoint to {result.recover_dst_replica_ranks}"
                     )
@@ -641,6 +648,7 @@ class Manager:
                         dst_ranks=list(result.recover_dst_replica_ranks),
                         elapsed_s=t_send["elapsed_s"],
                     )
+                    heal_phase = "plan"
                 if heal:
                     self._healing = True
                     inj = _chaos.maybe(
@@ -650,6 +658,7 @@ class Manager:
                     )
                     if inj is not None:
                         raise _chaos.ChaosError(f"[chaos] heal aborted: {inj}")
+                    heal_phase = "metadata"
                     src_client = ManagerClient(
                         result.recover_src_manager_address,
                         min(self._connect_timeout, _heal_left()),
@@ -670,6 +679,7 @@ class Manager:
                         peer=result.recover_src_replica_rank,
                         max_step=result.max_step,
                     )
+                    heal_phase = "transfer"
                     with timeit(
                         "torchft::manager::recv_checkpoint", self._logger
                     ) as t_heal:
@@ -691,11 +701,16 @@ class Manager:
                     )
                     # torchft state applies immediately; user state is
                     # deferred to the main thread (manager.py:716-720).
+                    heal_phase = "load"
                     self.load_state_dict(state["torchft"])
                     self._pending_state_dict = state["user"]
             except Exception as e:
                 self._logger.exception(f"recovery failed: {e}")
-                self._journal("heal_failed", error=str(e)[:200])
+                self._journal(
+                    "heal_failed", error=str(e)[:200],
+                    cause=type(e).__name__, phase=heal_phase,
+                    max_step=result.max_step,
+                )
                 self.report_error(e)
 
     def _apply_pending_state_dict(self) -> None:
@@ -944,7 +959,10 @@ class Manager:
                 self._apply_pending_state_dict()
             except Exception as e:  # noqa: BLE001 - latched, gate skips
                 self._logger.exception(f"apply healed state failed: {e}")
-                self._journal("heal_failed", error=str(e)[:200])
+                self._journal(
+                    "heal_failed", error=str(e)[:200],
+                    cause=type(e).__name__, phase="apply",
+                )
                 self.report_error(e)
 
         err = self.errored()

@@ -447,6 +447,12 @@ EVENT_KINDS: Dict[str, str] = {
     # -- perf attribution (perf.py, tools/perf_report.py) --------------
     "perf_model": "compile-time FLOPs/bytes of a jitted train step",
     "perf_step": "per-(step,replica) critical-path/overlap attribution",
+    # -- recovery forensics (checkpointing/*, tools/recovery_report.py) -
+    "heal_xfer": "heal transfer accounting: bytes, wire/serialize/lock "
+                 "windows, per-chunk splits, retry counts",
+    "recovery_episode": "stitched failure->recovery episode with TTR "
+                        "phase decomposition (detect/quorum/transfer/"
+                        "rebuild/catchup)",
 }
 
 
@@ -1311,3 +1317,403 @@ def lane_exposed_attribution(
             a["bytes"] += nbytes
             a["count"] += 1
     return agg
+
+
+# ----------------------------------------------------------------------
+# Recovery forensics: failure -> recovery episode detection.
+#
+# Where the perf plane above attributes ONE steady-state step, this
+# section attributes an entire failure episode: the window from the
+# moment something broke (error latch, abort, process loss) until the
+# first step committed afterwards. Each episode's time-to-recover (TTR)
+# decomposes into five phases that tile the episode window exactly, with
+# the same interval-algebra rigor as ``comm_attribution``:
+#
+#   detect   - uncovered time before the first recovery wait: the error
+#              had happened but no quorum/heal/reconfigure was running
+#              yet (latch latency, backoff, process relaunch).
+#   quorum   - blocking quorum waits (``quorum_ready.elapsed_s`` spans).
+#   transfer - checkpoint transfer (``heal_done.elapsed_s`` spans; the
+#              ``heal_xfer`` events break this down further into wire /
+#              serialize / lock-wait and per-chunk windows).
+#   rebuild  - process-group reconfiguration (``pg_configure`` spans).
+#   catchup  - the uncovered remainder after recovery work started:
+#              re-running the step, optimizer rebuild, the commit gate.
+#
+# Episodes are detected per replica from its own journal, then stitched
+# across replicas by window overlap: a kill on replica 1 produces a
+# relaunch episode on replica 1 AND abort/reconfigure fallout on replica
+# 0 — those merge into one cross-replica episode with a root cause and
+# cascade edges.
+# ----------------------------------------------------------------------
+
+RECOVERY_PHASES = ("detect", "quorum", "transfer", "rebuild", "catchup")
+
+# Journal kinds that latch a failure (open/extend an episode).
+_EPISODE_LATCHES = (
+    "heal_failed", "quorum_abort", "pg_abort", "pg_configure_failed",
+)
+
+
+def _episode_replica(ev: Dict[str, Any]) -> str:
+    """Replica-group key: ``"1:uuid" -> "1"`` (matches obs_report)."""
+    rid = ev.get("replica_id")
+    return str(rid).split(":", 1)[0] if rid is not None else "?"
+
+
+def _new_episode(t_start: float, trigger: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "t_start": t_start,
+        "t_end": None,
+        "trigger": {
+            "event": trigger.get("event"),
+            "ts": float(trigger.get("ts", t_start)),
+            "replica": _episode_replica(trigger),
+        },
+        "win": {"quorum": [], "transfer": [], "rebuild": []},
+        "signals": [],
+        "attempts": [],
+        "xfer": [],
+        "impact": False,
+        "relaunch": False,
+        "failed_gates": 0,
+        "trace": None,
+        "quorum_id": None,
+        "max_step": None,
+        "open": False,
+    }
+
+
+def _local_episodes(revs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One replica's episodes from its ts-sorted journal events.
+
+    An episode opens on a failure latch (``heal_failed``/``quorum_abort``/
+    ``pg_abort``/``pg_configure_failed``), a failed allreduce, or a
+    healing quorum from a relaunched process (the killed incarnation left
+    no latch — its journal just stops). It closes at the first
+    ``commit_gate(committed=True)``. A latch-free window that commits is
+    discarded (not an episode); an episode that never commits before the
+    journal ends stays ``open`` (in-progress at harvest time)."""
+    eps: List[Dict[str, Any]] = []
+    cur: Optional[Dict[str, Any]] = None
+    last_qstart: Optional[Dict[str, Any]] = None
+    for ev in revs:
+        name = ev.get("event")
+        attrs = ev.get("attrs") or {}
+        ts = float(ev.get("ts", 0.0))
+        el = float(attrs.get("elapsed_s") or 0.0)
+        failed_ar = (
+            name == "allreduce_complete" and attrs.get("ok") is False
+        )
+        if name in _EPISODE_LATCHES or failed_ar:
+            if cur is None:
+                cur = _new_episode(ts, ev)
+            if name in _EPISODE_LATCHES:
+                cur["impact"] = True
+            cur["signals"].append({
+                "event": name, "ts": ts, "replica": _episode_replica(ev),
+                "cause": attrs.get("cause") or attrs.get("error"),
+                "phase": attrs.get("phase"),
+            })
+            if name == "heal_failed":
+                cur["attempts"].append({
+                    "ok": False,
+                    "ts": ts,
+                    "cause": attrs.get("cause"),
+                    "phase": attrs.get("phase"),
+                    "error": attrs.get("error"),
+                })
+        elif name == "quorum_start":
+            last_qstart = ev
+        elif name == "quorum_ready":
+            t0 = ts - el
+            if attrs.get("heal") and cur is None:
+                # Relaunched process healing back in: start the episode
+                # at its first quorum attempt (or the wait start if the
+                # quorum_start line predates this incarnation's journal).
+                start = ev
+                if last_qstart is not None and float(
+                    last_qstart.get("ts", 0.0)
+                ) <= t0:
+                    start = last_qstart
+                cur = _new_episode(
+                    min(float(start.get("ts", ts)), t0), start
+                )
+                cur["relaunch"] = True
+            if cur is not None:
+                cur["win"]["quorum"].append((t0, ts))
+                if attrs.get("heal"):
+                    cur["impact"] = True
+                cur["trace"] = ev.get("trace") or cur["trace"]
+                if attrs.get("quorum_id") is not None:
+                    cur["quorum_id"] = attrs.get("quorum_id")
+                if attrs.get("max_step") is not None:
+                    cur["max_step"] = attrs.get("max_step")
+        elif name == "pg_configure":
+            if cur is not None and el > 0:
+                cur["win"]["rebuild"].append((ts - el, ts))
+        elif name == "heal_start":
+            if cur is None:
+                cur = _new_episode(ts, ev)
+            cur["impact"] = True
+        elif name == "heal_done":
+            if cur is None:
+                cur = _new_episode(ts - el, ev)
+            cur["impact"] = True
+            cur["win"]["transfer"].append((ts - el, ts))
+            cur["attempts"].append({
+                "ok": True, "ts": ts, "peer": attrs.get("peer"),
+                "elapsed_s": el,
+            })
+            if attrs.get("max_step") is not None:
+                cur["max_step"] = attrs.get("max_step")
+        elif name == "heal_xfer":
+            if cur is not None:
+                cur["xfer"].append({
+                    "ts": ts,
+                    "dir": attrs.get("dir"),
+                    "transport": attrs.get("transport"),
+                    "nbytes": int(attrs.get("nbytes") or 0),
+                    "elapsed_s": el,
+                    "wire_s": float(attrs.get("wire_s") or 0.0),
+                    "ser_s": float(attrs.get("ser_s") or 0.0),
+                    "lock_s": float(attrs.get("lock_s") or 0.0),
+                    "retries": int(attrs.get("retries") or 0),
+                })
+        elif name == "commit_gate":
+            if cur is None:
+                continue
+            if attrs.get("committed"):
+                if cur["impact"]:
+                    cur["t_end"] = ts
+                    eps.append(cur)
+                cur = None
+            else:
+                cur["impact"] = True
+                cur["failed_gates"] += 1
+    if cur is not None and cur["impact"]:
+        cur["open"] = True
+        last_ts = float(revs[-1].get("ts", cur["t_start"])) if revs else 0.0
+        cur["t_end"] = max(last_ts, cur["t_start"])
+        eps.append(cur)
+    return eps
+
+
+def episode_phase_windows(
+    ep: Dict[str, Any],
+) -> Dict[str, List[Interval]]:
+    """Tile one local episode's window into the five RECOVERY_PHASES.
+
+    Recorded waits are clipped to the episode window and de-overlapped
+    in priority order quorum > transfer > rebuild (a heal that overlaps
+    its quorum wait is counted once). The uncovered remainder splits at
+    the first recovery wait: everything before it is ``detect`` (the
+    failure had happened, no recovery machinery was running yet),
+    everything after is ``catchup``. By construction the five phases
+    tile [t_start, t_end] exactly — the ``recovery_report.py --check``
+    invariant."""
+    t0 = float(ep["t_start"])
+    t1 = float(ep["t_end"] if ep["t_end"] is not None else ep["t_start"])
+    window = [(t0, t1)] if t1 > t0 else []
+    phases: Dict[str, List[Interval]] = {}
+    covered: List[Interval] = []
+    for name in ("quorum", "transfer", "rebuild"):
+        clipped = intersect_intervals(ep["win"][name], window)
+        own = subtract_intervals(clipped, covered)
+        phases[name] = own
+        covered = merge_intervals(covered + own)
+    rest = subtract_intervals(window, covered)
+    split = covered[0][0] if covered else t1
+    phases["detect"] = intersect_intervals(rest, [(t0, split)])
+    phases["catchup"] = subtract_intervals(rest, [(t0, split)])
+    return phases
+
+
+def _episode_row(ep: Dict[str, Any]) -> Dict[str, Any]:
+    """One per-replica row of a cross-replica episode: phase seconds
+    (tiling the row window), heal attempts, and transfer accounting."""
+    wins = episode_phase_windows(ep)
+    t0 = float(ep["t_start"])
+    t1 = float(ep["t_end"] if ep["t_end"] is not None else ep["t_start"])
+    xfer_recv = [x for x in ep["xfer"] if x.get("dir") == "recv"]
+    xfer: Dict[str, Any] = {}
+    if xfer_recv:
+        nbytes = sum(x["nbytes"] for x in xfer_recv)
+        elapsed = sum(x["elapsed_s"] for x in xfer_recv)
+        xfer = {
+            "nbytes": nbytes,
+            "elapsed_s": elapsed,
+            "wire_s": sum(x["wire_s"] for x in xfer_recv),
+            "ser_s": sum(x["ser_s"] for x in xfer_recv),
+            "lock_s": sum(x["lock_s"] for x in xfer_recv),
+            "retries": sum(x["retries"] for x in xfer_recv),
+            "transport": xfer_recv[-1].get("transport"),
+            "gib_s": (
+                (nbytes / float(1 << 30)) / elapsed if elapsed > 0 else None
+            ),
+        }
+    return {
+        "t_start": t0,
+        "t_end": t1,
+        "ttr_s": t1 - t0,
+        "phases": {k: union_s(wins[k]) for k in RECOVERY_PHASES},
+        "phase_windows": {k: wins[k] for k in RECOVERY_PHASES},
+        "trigger": ep["trigger"],
+        "signals": ep["signals"],
+        "attempts": ep["attempts"],
+        "failed_attempts": sum(
+            1 for a in ep["attempts"] if not a.get("ok")
+        ),
+        "failed_gates": ep["failed_gates"],
+        "relaunch": ep["relaunch"],
+        "open": ep["open"],
+        "trace": ep["trace"],
+        "quorum_id": ep["quorum_id"],
+        "max_step": ep["max_step"],
+        "xfer": xfer,
+    }
+
+
+def detect_episodes(
+    events: List[Dict[str, Any]], lookback_s: float = 10.0
+) -> List[Dict[str, Any]]:
+    """Stitch per-replica journals into cross-replica recovery episodes.
+
+    Per-replica episodes whose windows overlap merge into one episode
+    record with an ``id``, the union window, per-replica rows (each
+    tiling its own window into RECOVERY_PHASES), a root cause, cascade
+    edges from the root replica to every other replica that latched a
+    failure inside the window, correlated ``chaos_inject`` records
+    (fired within ``lookback_s`` before the window or inside it), and
+    the donor's ``heal_send_*`` spans."""
+    evs = sorted(events, key=lambda e: float(e.get("ts", 0.0)))
+    by_replica: Dict[str, List[Dict[str, Any]]] = {}
+    chaos: List[Dict[str, Any]] = []
+    sends: List[Dict[str, Any]] = []
+    for ev in evs:
+        name = ev.get("event")
+        if name == "chaos_inject":
+            chaos.append(ev)
+        elif name in ("heal_send_start", "heal_send_done", "heal_xfer"):
+            if name != "heal_xfer" or (
+                (ev.get("attrs") or {}).get("dir") == "send"
+            ):
+                sends.append(ev)
+        by_replica.setdefault(_episode_replica(ev), []).append(ev)
+
+    local: List[Tuple[str, Dict[str, Any]]] = []
+    for rid, revs in by_replica.items():
+        for ep in _local_episodes(revs):
+            local.append((rid, ep))
+    local.sort(key=lambda p: float(p[1]["t_start"]))
+
+    # Merge per-replica episodes by window overlap (chained).
+    groups: List[List[Tuple[str, Dict[str, Any]]]] = []
+    g_end = None
+    for rid, ep in local:
+        t0 = float(ep["t_start"])
+        t1 = float(ep["t_end"] if ep["t_end"] is not None else t0)
+        if groups and g_end is not None and t0 <= g_end:
+            groups[-1].append((rid, ep))
+            g_end = max(g_end, t1)
+        else:
+            groups.append([(rid, ep)])
+            g_end = t1
+    out: List[Dict[str, Any]] = []
+    for idx, group in enumerate(groups):
+        rows = {rid: _episode_row(ep) for rid, ep in group}
+        w0 = min(r["t_start"] for r in rows.values())
+        w1 = max(r["t_end"] for r in rows.values())
+        # Primary replica: the healer (a successful heal attempt), else
+        # a relaunch, else the longest-suffering row.
+        def _rank(rid: str) -> Tuple[int, int, float]:
+            r = rows[rid]
+            healed = any(a.get("ok") for a in r["attempts"])
+            return (
+                1 if healed else 0,
+                1 if r["relaunch"] else 0,
+                r["ttr_s"],
+            )
+        primary = max(rows, key=_rank)
+        ep_chaos = [
+            {
+                "ts": float(c.get("ts", 0.0)),
+                "replica": _episode_replica(c),
+                "kind": (c.get("attrs") or {}).get("kind"),
+                "plane": (c.get("attrs") or {}).get("plane"),
+                "site": (c.get("attrs") or {}).get("site"),
+            }
+            for c in chaos
+            if w0 - lookback_s <= float(c.get("ts", 0.0)) <= w1
+        ]
+        # Root cause precedence: a relaunch pins the loss on the relaunched
+        # process itself (the kill left no latch to point at); else the
+        # earliest correlated chaos injection; else the earliest latch.
+        all_signals = sorted(
+            (s for r in rows.values() for s in r["signals"]),
+            key=lambda s: s["ts"],
+        )
+        if rows[primary]["relaunch"]:
+            # The kill itself left no journal line; the earliest fleet-
+            # wide evidence (a survivor's abort, or the relaunch) dates it.
+            root: Dict[str, Any] = {
+                "replica": primary, "kind": "process_loss", "ts": w0,
+            }
+        elif ep_chaos:
+            c0 = ep_chaos[0]
+            root = {
+                "replica": c0["replica"], "kind": "chaos",
+                "ts": c0["ts"], "chaos": c0,
+            }
+        elif all_signals:
+            s0 = all_signals[0]
+            root = {
+                "replica": s0["replica"], "kind": "latch",
+                "ts": s0["ts"], "signal": s0,
+            }
+        else:
+            root = {
+                "replica": primary, "kind": "unknown",
+                "ts": rows[primary]["t_start"],
+            }
+        cascade = []
+        seen_replicas = {root["replica"]}
+        for s in all_signals:
+            if s["replica"] in seen_replicas:
+                continue
+            seen_replicas.add(s["replica"])
+            cascade.append({
+                "from": root["replica"],
+                "to": s["replica"],
+                "signal": s["event"],
+                "dt_s": s["ts"] - float(root["ts"]),
+            })
+        donors = []
+        for ev in sends:
+            ts = float(ev.get("ts", 0.0))
+            if not (w0 <= ts <= w1):
+                continue
+            attrs = ev.get("attrs") or {}
+            donors.append({
+                "replica": _episode_replica(ev),
+                "event": ev.get("event"),
+                "ts": ts,
+                "elapsed_s": float(attrs.get("elapsed_s") or 0.0),
+                "nbytes": int(attrs.get("nbytes") or 0),
+            })
+        out.append({
+            "id": f"e{idx}",
+            "t_start": w0,
+            "t_end": w1,
+            "ttr_s": w1 - w0,
+            "primary": primary,
+            "replicas": rows,
+            "root_cause": root,
+            "cascade": cascade,
+            "chaos": ep_chaos,
+            "donors": donors,
+            "open": any(r["open"] for r in rows.values()),
+            "trace": rows[primary]["trace"],
+            "max_step": rows[primary]["max_step"],
+        })
+    return out
