@@ -102,6 +102,7 @@ def test_spec_roundtrip():
         "seed:1,spec:reset@ctrl:junk",  # param without '='
         "seed:1,spec:reset@ctrl:zz=1",  # unknown param
         "seed:1,spec:reset@ctrl:after=x",  # non-integer param
+        "seed:1,spec:preempt@any:grace=-1",  # negative grace window
     ],
 )
 def test_parse_spec_rejects(bad):
@@ -286,6 +287,54 @@ def test_scope_nests_and_restores():
             assert chaos._scope_ctx() == ("data", "b", None)
         assert chaos._scope_ctx() == ("ctrl", "a", "quorum")
     assert chaos._scope_ctx() is None
+
+
+# ---------------------------------------------------------------------------
+# preempt kind (elastic membership plane)
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_grace_param_roundtrip():
+    seed, rules = chaos.parse_spec(
+        "seed:3,spec:preempt@any:p=0.5:grace=90000"
+    )
+    assert rules[0].kind == "preempt" and rules[0].grace == 90000
+    again = chaos.parse_spec(chaos.Chaos(seed, rules).spec())[1]
+    assert again[0].spec() == rules[0].spec()
+    # grace=0 (defer to TORCHFT_DRAIN_GRACE_S) stays out of the spec text
+    assert "grace" not in chaos.parse_spec(
+        "seed:3,spec:preempt@any"
+    )[1][0].spec()
+
+
+def test_preempt_injection_carries_grace():
+    st = chaos.Chaos(1, [_rule(kind="preempt", plane="any", grace=1500)])
+    inj = st.pick("preempt", "any", "drill/group0")
+    assert inj is not None and inj.kind == "preempt" and inj.grace == 1500
+    # grace is pinned to the preempt kind, like throttle's rate/bucket
+    assert chaos.Chaos(1, [_rule(ms=5)]).pick(
+        "stall", "data", "s"
+    ).grace == 0
+
+
+def test_preempt_seeded_victim_set_is_deterministic():
+    """The eviction plan the elastic drill derives (which groups of a
+    fleet a p<1 preempt rule fires for) is a pure function of the seed:
+    same seed => same victim set, different seed => a different one
+    somewhere in a small seed neighborhood."""
+
+    def victims(seed):
+        st = chaos.Chaos(
+            seed, [_rule(kind="preempt", plane="any", p=0.5)]
+        )
+        return [
+            g
+            for g in range(8)
+            if st.pick("preempt", "any", f"drill/group{g}") is not None
+        ]
+
+    assert victims(77) == victims(77)
+    assert any(victims(77) != victims(s) for s in range(78, 90))
 
 
 # ---------------------------------------------------------------------------
@@ -480,6 +529,19 @@ def test_native_abi_arm_disarm():
     snap = _native.chaos_snapshot()
     assert snap["seq"] == 0 and snap["events"] == []
     _native.chaos_init(" ")
+    assert not _native.chaos_armed()
+
+
+@native
+def test_native_grammar_accepts_preempt():
+    """py<->cc grammar parity for the new kind: the C++ parser takes the
+    same rule text (kind + grace param) and rejects the same invalid
+    grace the Python parser rejects."""
+    _native.chaos_init("seed:1,spec:preempt@any:p=0.5:grace=90000")
+    assert _native.chaos_armed()
+    _native.chaos_init(" ")
+    with pytest.raises(ValueError):
+        _native.chaos_init("seed:1,spec:preempt@any:grace=-1")
     assert not _native.chaos_armed()
 
 

@@ -46,6 +46,32 @@ def test_replica_group_jobs_topology():
         assert pod["terminationGracePeriodSeconds"] == 120
 
 
+def test_termination_grace_tracks_drain_knob(monkeypatch):
+    """The pod SIGTERM->SIGKILL gap must be the SAME budget the in-pod
+    drain path honors: the renderer's default is read from the
+    TORCHFT_DRAIN_GRACE_S knob, so retuning the knob (e.g. a large model
+    whose final durable snapshot needs longer) retunes the manifests —
+    the two can never drift apart."""
+    from torchft_tpu import knobs
+
+    def grace_of(**kw):
+        jobs = render_replica_groups(
+            ["python", "train_ddp.py"],
+            num_replica_groups=1,
+            lighthouse_addr="lh:29510",
+            **kw,
+        )
+        return jobs[0]["spec"]["template"]["spec"][
+            "terminationGracePeriodSeconds"
+        ]
+
+    assert grace_of() == int(knobs.get_float("TORCHFT_DRAIN_GRACE_S"))
+    monkeypatch.setenv("TORCHFT_DRAIN_GRACE_S", "300")
+    assert grace_of() == 300
+    # An explicit argument still beats the knob.
+    assert grace_of(termination_grace_period_sec=45) == 45
+
+
 def test_lighthouse_deployment_and_service():
     manifests = render_lighthouse(min_replicas=2, port=29999)
     kinds = [m["kind"] for m in manifests]

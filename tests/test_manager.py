@@ -303,6 +303,44 @@ def test_drain_requested_falls_back_to_status_rpc_on_error():
         m.shutdown()
 
 
+def test_drain_requested_journals_failed_status_probe(tmp_path, monkeypatch):
+    """The errored-manager drain_status fallback hitting a dead
+    lighthouse must not swallow the failure invisibly: each failed probe
+    is journaled as ``rpc_retry`` (rpc=drain_status) and the next call
+    retries — a pending operator drain can go dark, never silently
+    masked."""
+    import json
+
+    from torchft_tpu import telemetry
+
+    path = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("TORCHFT_JOURNAL_FILE", path)
+    telemetry.reset_event_log()
+    try:
+        m = make_manager()
+        client = m._test_client
+        try:
+            m.report_error(RuntimeError("quorum failed"))
+            client.drain_status.side_effect = TimeoutError("lighthouse gone")
+            assert m.drain_requested() is False
+            assert m.drain_requested() is False  # retried, not latched off
+            assert client.drain_status.call_count == 2
+            client.drain_status.side_effect = None
+            client.drain_status.return_value = True
+            assert m.drain_requested() is True  # recovers once RPC heals
+        finally:
+            m.shutdown()
+    finally:
+        telemetry.reset_event_log()
+
+    with open(path) as fh:
+        events = [json.loads(line) for line in fh]
+    probes = [e for e in events if e["event"] == "rpc_retry"]
+    assert len(probes) == 2
+    assert probes[0]["attrs"]["rpc"] == "drain_status"
+    assert probes[0]["attrs"]["cause"] == "TimeoutError"
+
+
 def test_start_quorum_after_drain_abort_never_waits():
     """Once a drain abort fired, any later start_quorum aborts before
     issuing the RPC — the signal won the race to before the wait."""

@@ -520,6 +520,25 @@ def test_obs_top_render_and_check_roundtrip():
     assert obs_top.check_frame(fleet, missing)
 
 
+def test_obs_top_world_column_tracks_membership_churn():
+    """The WORLD header cell is the operator's one-glance elastic view:
+    current quorum size plus cumulative join/leave churn from the
+    lighthouse fleet aggregate — and check_frame treats losing it as a
+    frame corruption, same as a dropped replica row."""
+    import obs_top
+
+    fleet = _fake_fleet()
+    fleet["agg"].update(quorum_world=3, joins_total=6, leaves_total=5)
+    frame = obs_top.render(fleet, color=False)
+    assert "world=3(+6/-5)" in frame
+    assert obs_top.check_frame(fleet, frame) == []
+    # A frame whose WORLD cell went missing fails the check.
+    stripped = frame.replace("world=3(+6/-5) ", "")
+    assert any(
+        "WORLD" in p for p in obs_top.check_frame(fleet, stripped)
+    )
+
+
 def test_obs_top_renders_empty_fleet():
     import obs_top
 

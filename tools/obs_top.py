@@ -137,6 +137,12 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
     lines: List[str] = []
     lines.append(paint(
         f"torchft fleet  replicas={int(agg.get('n', 0))} "
+        # WORLD: current quorum size plus cumulative join/leave churn —
+        # the elastic-membership counters the lighthouse folds across
+        # quorum transitions (deliberate resizes and crash churn alike).
+        f"world={int(agg.get('quorum_world', 0))}"
+        f"(+{int(agg.get('joins_total', 0))}"
+        f"/-{int(agg.get('leaves_total', 0))}) "
         f"digests={int(agg.get('n_digest', 0))} "
         f"stragglers={int(agg.get('stragglers', 0))} "
         f"median_rate={_fmt(agg.get('median_rate'), '{:.3f}')}/s "
@@ -254,6 +260,14 @@ def check_frame(fleet: Dict[str, Any], frame: str,
         problems.append("aggregate replica count missing from header")
     if f"stragglers={int(agg.get('stragglers', 0))}" not in head:
         problems.append("aggregate straggler count missing from header")
+    world = (
+        f"world={int(agg.get('quorum_world', 0))}"
+        f"(+{int(agg.get('joins_total', 0))}"
+        f"/-{int(agg.get('leaves_total', 0))})"
+    )
+    if world not in head:
+        problems.append("WORLD (quorum size + join/leave churn) missing "
+                        "from header")
     return problems
 
 

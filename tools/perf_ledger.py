@@ -152,7 +152,8 @@ def record_report(
     """Append a live tool report's headline metrics, reusing the same
     extractors as the legacy-artifact importer so live runs extend the
     backfilled trajectories under identical metric names. ``kind`` is
-    one of bench|pg|fleet|wan|recovery. Returns the number of records
+    one of bench|pg|fleet|wan|recovery|elastic. Returns the number of
+    records
     appended;
     never raises into the calling bench."""
     try:
@@ -329,6 +330,40 @@ def _wan_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _elastic_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """BENCH_ELASTIC.json (tools/elastic_drill.py): time-to-join, heal
+    bandwidth of the join transfers (PR-10 heal_xfer accounting), and
+    goodput retention vs the static 2-replica baseline — the numbers the
+    elastic gate pins (goodput_retention carries the 0.80 budget)."""
+    src = f"tools/elastic_drill.py ({os.path.basename(fn)})"
+    summ = doc.get("summary") or {}
+    out = []
+    n_j = summ.get("num_joins")
+    extra = {"joins": n_j} if n_j is not None else None
+    if summ.get("time_to_join_p95_s") is not None:
+        out.append(("elastic.time_to_join_s",
+                    float(summ["time_to_join_p95_s"]), "s", "lower",
+                    "elastic", src, extra))
+    if summ.get("heal_gib_s") is not None:
+        out.append(("elastic.heal_gib_s", float(summ["heal_gib_s"]),
+                    "GiB/s", "higher", "elastic", src,
+                    {"bytes": summ.get("heal_bytes")}))
+    if summ.get("goodput_retention") is not None:
+        # Goodput is aggregate committed samples/s (world_size x batch x
+        # step rate), not raw step cadence: scaling 2->8 groups on a
+        # shared-core CI box slows every group's cadence while the fleet
+        # still trains MORE examples per second — samples/s is the number
+        # the resize is supposed to keep monotone.
+        out.append(("elastic.goodput_retention",
+                    float(summ["goodput_retention"]), "ratio", "higher",
+                    "elastic", src,
+                    {"baseline_samples_per_s": summ.get(
+                        "baseline_samples_per_s"),
+                     "elastic_samples_per_s": summ.get(
+                         "elastic_samples_per_s")}))
+    return out
+
+
 def _recovery_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     """BENCH_RECOVERY.json (tools/recovery_drill.py): TTR percentiles,
     the per-phase p95 decomposition, and per-transport heal bandwidth —
@@ -364,6 +399,7 @@ _REPORT_EXTRACTORS = {
     "fleet": _fleet_records,
     "wan": _wan_records,
     "recovery": _recovery_records,
+    "elastic": _elastic_records,
 }
 
 

@@ -55,6 +55,14 @@
 #                                       # --check (phases must tile TTR), then
 #                                       # perf_gate --check vs pinned TTR /
 #                                       # heal-bandwidth baselines
+#        bash tools/suite_gate.sh elastic # elastic membership drill:
+#                                       # 2-replica DDP grows to 8 under
+#                                       # load, seeded preemptions drain 5
+#                                       # groups down to 3 -> BENCH_ELASTIC
+#                                       # .json (join latency, heal GiB/s,
+#                                       # goodput retention vs a static
+#                                       # baseline), same-seed replay, then
+#                                       # perf_gate --check vs pins+budget
 #        bash tools/suite_gate.sh wan   # degraded-network drill: 2-region
 #                                       # DiLoCo over a throttled wan link
 #                                       # with mid-collective stripe tears
@@ -106,6 +114,19 @@ if [ "${1:-}" = "wan" ]; then
   echo "== wan replay: same seed must reproduce the injection multiset =="
   exec timeout 600 env JAX_PLATFORMS=cpu python tools/wan_drill.py \
     --replay BENCH_WAN.json
+fi
+
+if [ "${1:-}" = "elastic" ]; then
+  echo "== elastic drill: 2->8->3 walk under seeded preemption =="
+  # ~6 min wall: a static 2-replica goodput baseline leg + the elastic
+  # leg (compute-dominant batch so samples/s is world-fair on 1 core).
+  timeout 1700 env JAX_PLATFORMS=cpu python tools/elastic_drill.py --quick \
+    || exit 1
+  echo "== elastic replay: same seed must reproduce the preemption plan =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/elastic_drill.py \
+    --replay BENCH_ELASTIC.json || exit 1
+  echo "== elastic gate: ledger head vs pinned baselines + goodput budget =="
+  exec timeout 120 python tools/perf_gate.py --check
 fi
 
 if [ "${1:-}" = "recovery" ]; then

@@ -31,9 +31,9 @@ constexpr size_t kEventRing = 1024;
 
 const char* kKindNames[] = {
     "connect_refuse", "reset",    "stall",      "partial_write", "rpc_delay",
-    "rpc_drop",       "abort_heal", "ckpt_truncate", "throttle",
+    "rpc_drop",       "abort_heal", "ckpt_truncate", "throttle", "preempt",
 };
-constexpr int32_t kNumKinds = 9;
+constexpr int32_t kNumKinds = 10;
 
 struct Rule {
   int32_t kind = -1;
@@ -48,6 +48,7 @@ struct Rule {
   double frac = 0.5;
   int64_t rate = int64_t(1) << 20;    // throttle: bytes/second sustained
   int64_t bucket = int64_t(1) << 16;  // throttle: burst bytes
+  int64_t grace = 0;  // preempt: drain window ms (0 = TORCHFT_DRAIN_GRACE_S)
 };
 
 struct Event {
@@ -57,7 +58,7 @@ struct Event {
   int32_t rule = 0;
   int64_t visit = 0, step = -1, ms = 0;
   double frac = 0.0;
-  int64_t rate = 0, bucket = 0;
+  int64_t rate = 0, bucket = 0, grace = 0;
   uint64_t ts_ns = 0;
 };
 
@@ -238,6 +239,9 @@ bool parse_rule(const std::string& text, int32_t index, Rule* out,
       } else if (k == "bucket") {
         r.bucket = std::stoll(v);
         if (r.bucket <= 0) throw std::runtime_error("bucket");
+      } else if (k == "grace") {
+        r.grace = std::stoll(v);
+        if (r.grace < 0) throw std::runtime_error("grace");
       } else {
         *err = "rule '" + text + "': unknown param '" + k + "'";
         return false;
@@ -458,6 +462,7 @@ Decision pick(int32_t kind, const std::string& site) {
         d.rate = r.rate;
         d.bucket = r.bucket;
       }
+      if (kind == kPreempt) d.grace = r.grace;
       ev.seq = st.seq;
       ev.kind = kind;
       ev.plane = t_ctx.plane;
@@ -469,6 +474,7 @@ Decision pick(int32_t kind, const std::string& site) {
       ev.frac = r.frac;
       ev.rate = d.rate;
       ev.bucket = d.bucket;
+      ev.grace = d.grace;
       ev.ts_ns = now_realtime_ns();
       st.events.push_back(ev);
       if (st.events.size() > kEventRing) st.events.pop_front();
@@ -648,6 +654,7 @@ int64_t tft_chaos_snapshot(int64_t since_seq, char* buf, int64_t cap) {
       je["frac"] = Json::of(ev.frac);
       je["rate"] = Json::of(ev.rate);
       je["bucket"] = Json::of(ev.bucket);
+      je["grace"] = Json::of(ev.grace);
       je["ts_ns"] = Json::of(static_cast<int64_t>(ev.ts_ns));
       events.push(std::move(je));
     }

@@ -37,6 +37,7 @@ enum Kind : int32_t {
   kAbortHeal = 6,
   kCkptTruncate = 7,
   kThrottle = 8,
+  kPreempt = 9,
 };
 
 // Parses `spec` (TORCHFT_CHAOS grammar) and arms the global schedule.
@@ -63,6 +64,7 @@ struct Decision {
   double frac = 0.0;
   int64_t rate = 0;    // throttle: sustained bytes/second
   int64_t bucket = 0;  // throttle: burst bytes
+  int64_t grace = 0;   // preempt: drain window ms before hard kill
 };
 
 // One eligible visit at `site` for `kind` under the current thread context.

@@ -720,6 +720,15 @@ Json Lighthouse::fleet_agg_locked(int64_t now) {
   agg["max_commit_failures"] =
       Json::of(agg_cfs_.empty() ? int64_t{0} : *agg_cfs_.rbegin());
   agg["anomalies_dropped"] = Json::of(anomalies_dropped_);
+  // Elastic-membership view: current quorum size plus cumulative
+  // join/leave churn, so obs_top's WORLD column tracks capacity changes
+  // (deliberate scale-up/down AND crash churn) from the same counters
+  // /metrics exports.
+  agg["quorum_world"] = Json::of(
+      last_quorum_ ? static_cast<int64_t>(last_quorum_->participants.size())
+                   : int64_t{0});
+  agg["joins_total"] = Json::of(joins_total_);
+  agg["leaves_total"] = Json::of(leaves_total_);
   return agg;
 }
 

@@ -13,7 +13,7 @@ single source of truth for what gets injected where:
 
   Kinds: ``connect_refuse``, ``reset``, ``stall``, ``partial_write``,
   ``rpc_delay``, ``rpc_drop``, ``abort_heal``, ``ckpt_truncate``,
-  ``throttle``.
+  ``throttle``, ``preempt``.
   Planes: ``ctrl`` (framed-RPC client/server path), ``data`` (process-group
   send/recv, both socket and native backends), ``heal`` (checkpoint
   transport), or ``any``.
@@ -26,7 +26,17 @@ single source of truth for what gets injected where:
   ``count=<n>`` (max fires, default unlimited), ``ms=<int>`` (stall/delay
   duration, default 100), ``frac=<float>`` (fraction written before the cut,
   default 0.5), ``rate=<bytes/s>`` + ``bucket=<bytes>`` (throttle token
-  bucket: sustained rate and burst size, defaults 1 MiB/s and 64 KiB).
+  bucket: sustained rate and burst size, defaults 1 MiB/s and 64 KiB),
+  ``grace=<ms>`` (preempt grace window before hard kill; 0 = defer to the
+  ``TORCHFT_DRAIN_GRACE_S`` knob).
+
+  ``preempt`` models a spot/preemptible eviction notice: the seeded
+  decision picks *which* visits of a preemption site deliver a SIGTERM,
+  and ``grace`` bounds the drain window the victim gets before SIGKILL —
+  the same budget k8s grants via ``terminationGracePeriodSeconds``. The
+  decision is pure hash like every other kind; the actual signal delivery
+  is the caller's job (see ``tools/elastic_drill.py``), keeping the replay
+  multiset exact.
 
   ``throttle`` is special: the seeded decision (after/every/p/count, per
   visit) picks *when a site's bandwidth cap switches on*; from that visit on
@@ -103,6 +113,7 @@ KINDS = (
     "abort_heal",
     "ckpt_truncate",
     "throttle",
+    "preempt",
 )
 
 PLANES = ("ctrl", "data", "heal", "srv", "any")
@@ -176,6 +187,7 @@ class Rule:
     frac: float = 0.5
     rate: int = 1 << 20
     bucket: int = 1 << 16
+    grace: int = 0
 
     def spec(self) -> str:
         """Round-trip the rule back to grammar form (for CHAOS_SOAK.json)."""
@@ -205,6 +217,8 @@ class Rule:
             parts.append(f"rate={self.rate}")
         if self.kind == "throttle" or self.bucket != (1 << 16):
             parts.append(f"bucket={self.bucket}")
+        if self.grace != 0:
+            parts.append(f"grace={self.grace}")
         return ":".join(parts)
 
 
@@ -257,6 +271,10 @@ def parse_rule(text: str, index: int) -> Rule:
                 r.bucket = int(v)
                 if r.bucket <= 0:
                     raise ValueError("bucket must be > 0")
+            elif k == "grace":
+                r.grace = int(v)
+                if r.grace < 0:
+                    raise ValueError("grace must be >= 0")
             else:
                 raise ValueError(f"unknown param '{k}'")
         except ChaosSpecError:
@@ -307,6 +325,7 @@ class Injection:
     frac: float
     rate: int = 0
     bucket: int = 0
+    grace: int = 0
 
     def __str__(self) -> str:
         return (
@@ -452,6 +471,7 @@ class Chaos:
                         frac=r.frac,
                         rate=r.rate if r.kind == "throttle" else 0,
                         bucket=r.bucket if r.kind == "throttle" else 0,
+                        grace=r.grace if r.kind == "preempt" else 0,
                     )
         if inj is not None:
             self._journal(inj, peer=peer, match=match, step=step)
@@ -482,6 +502,7 @@ class Chaos:
                     frac=inj.frac,
                     rate=inj.rate,
                     bucket=inj.bucket,
+                    grace=inj.grace,
                     peer=peer,
                     match=match,
                 )

@@ -282,6 +282,12 @@ _ALL = [
         "1",
         "Deliver SIGKILL to replica children when the runner dies; any value but `0` keeps it on (Linux only).",
     ),
+    _k(
+        "TORCHFT_DRAIN_GRACE_S",
+        "float",
+        "120",
+        "Preemption drain grace window (seconds) shared by every layer that budgets a SIGTERM->SIGKILL gap: orchestration/k8s.py renders it as `terminationGracePeriodSeconds`, the chaos `preempt` kind defaults its `grace=` param to it, and tools/elastic_drill.py waits this long for a drained exit before hard-killing.",
+    ),
     # -- backend probe / collectives --------------------------------------
     _k(
         "TORCHFT_PROBE_TIMEOUT",

@@ -182,6 +182,9 @@ class Manager:
         self._trace_id = ""
         self._drained = False
         self._drain_requested = False
+        # One-shot latch: the first healing quorum of a mid-run start is a
+        # deliberate elastic join (journaled once as elastic_join).
+        self._elastic_join_emitted = False
         # Drain-abort of a blocked sync quorum (see abort_pending_quorum):
         # _quorum_rpc_pending brackets the client RPC so the abort only
         # fires into a live (or imminent) wait.
@@ -546,6 +549,20 @@ class Manager:
         # loop-top check).
         if getattr(result, "drain_requested", False):
             self._drain_requested = True
+
+        # A replica group started mid-run heals into a live quorum whose
+        # max_step is already past 0: that is a deliberate elastic join
+        # (scale-up), not crash recovery of this process — journal it once
+        # so the drill/forensics planes can time capacity changes.
+        if heal and result.max_step > 0 and not self._elastic_join_emitted:
+            self._elastic_join_emitted = True
+            self._journal(
+                "elastic_join",
+                quorum_id=result.quorum_id,
+                replica_rank=result.replica_rank,
+                replica_world_size=result.replica_world_size,
+                max_step=result.max_step,
+            )
 
         # Participation (reference: manager.py:621-640). Async quorums train
         # with the max-step group only (healing ranks rejoin next step);
@@ -1148,8 +1165,17 @@ class Manager:
         if not self._drain_requested and self._errored is not None:
             try:
                 self._drain_requested = self._client.drain_status()
-            except (RuntimeError, TimeoutError):
-                pass
+            except (RuntimeError, TimeoutError) as e:
+                # A dead lighthouse/manager server must not silently mask a
+                # pending drain forever: journal the failed probe so the
+                # forensics plane sees the drain signal went dark, and the
+                # next drain_requested() call retries (idempotent read).
+                self._journal(
+                    "rpc_retry",
+                    rpc="drain_status",
+                    error=str(e)[:200],
+                    cause=type(e).__name__,
+                )
         return self._drain_requested
 
     def abort_pending_quorum(self) -> bool:
@@ -1205,8 +1231,12 @@ class Manager:
             sent = self._client.leave(timeout=timeout)
         except (RuntimeError, TimeoutError) as e:
             self._logger.warn(f"graceful leave failed (peers will age us out): {e}")
+            self._journal(
+                "elastic_leave", confirmed=False, error=str(e)[:200],
+            )
             return False
         self._logger.info("left the quorum (graceful drain)")
+        self._journal("elastic_leave", confirmed=bool(sent))
         return sent
 
     # ------------------------------------------------------------------

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from torchft_tpu import knobs
+
 
 def _env_list(env: Dict[str, str]) -> List[Dict[str, str]]:
     return [{"name": k, "value": str(v)} for k, v in sorted(env.items())]
@@ -87,7 +89,7 @@ def render_replica_groups(
     max_restarts: int = 100,
     timeout_sec: Optional[float] = None,
     quorum_timeout_sec: Optional[float] = None,
-    termination_grace_period_sec: int = 120,
+    termination_grace_period_sec: Optional[int] = None,
 ) -> List[dict]:
     """One Kubernetes Job per replica group (the reference's torchx role
     per group, torchx.py:41-76). The cluster restarts failed pods up to
@@ -97,8 +99,11 @@ def render_replica_groups(
     ``termination_grace_period_sec``: pod deletion / node drain delivers
     SIGTERM, the trainers' ``--drain-on-sigterm`` path finishes the
     step, leaves the quorum, and (with ``--durable-dir``) writes a final
-    durable snapshot — the default 120 s (vs k8s's 30 s) leaves room for
-    that snapshot on large models before the SIGKILL follow-up.
+    durable snapshot — the default comes from the registered
+    ``TORCHFT_DRAIN_GRACE_S`` knob (120 s vs k8s's 30 s) so the renderer,
+    the chaos ``preempt`` kind, and the SIGTERM drain path all budget the
+    SAME SIGTERM->SIGKILL gap; the snapshot must fit inside it on large
+    models.
 
     The FT env contract is OWNED by launcher.render_topology — this
     renderer just re-emits its ProcessSpecs as Jobs, so the two launch
@@ -106,6 +111,10 @@ def render_replica_groups(
     """
     from torchft_tpu.orchestration.launcher import render_topology
 
+    if termination_grace_period_sec is None:
+        termination_grace_period_sec = int(
+            knobs.get_float("TORCHFT_DRAIN_GRACE_S")
+        )
     specs = render_topology(
         cmd,
         num_replica_groups=num_replica_groups,

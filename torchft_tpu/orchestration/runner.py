@@ -76,6 +76,7 @@ class ReplicaGroupRunner:
         self._clean_exit: Dict[int, bool] = {}
         self._lock = threading.Lock()
         self._stopping = False
+        self._retired: set = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -149,6 +150,15 @@ class ReplicaGroupRunner:
                 continue
             if self._stopping:
                 continue
+            if idx in self._retired:
+                # Deliberate scale-down: the exit is final, clean or not —
+                # a retired group must never resurrect (a relaunch would
+                # silently undo the resize).
+                logger.info(
+                    "%s retired; not relaunching (rc=%d)", spec.name, rc
+                )
+                self._clean_exit[idx] = False
+                continue
             if self._restarts[idx] >= self._max_restarts:
                 logger.error(
                     "%s died (rc=%d) and exhausted %d restarts",
@@ -213,6 +223,17 @@ class ReplicaGroupRunner:
         )
         proc.send_signal(sig)
         return True
+
+    def retire_group(self, idx: int) -> None:
+        """Marks one group as deliberately scaled down: its NEXT exit is
+        final (no relaunch, however it dies). Call before delivering a
+        preemption SIGTERM — a drain that overruns its grace window and
+        eats a SIGKILL must stay gone, not resurrect via the restart
+        budget and silently undo the resize. A clean (rc 0) drained exit
+        still counts as clean; any other exit of a retired group marks it
+        failed-final."""
+        with self._lock:
+            self._retired.add(idx)
 
     def clean_exit(self, idx: int) -> bool:
         """Whether spec ``idx`` has exited with rc 0 (False while running,

@@ -453,6 +453,11 @@ EVENT_KINDS: Dict[str, str] = {
     "recovery_episode": "stitched failure->recovery episode with TTR "
                         "phase decomposition (detect/quorum/transfer/"
                         "rebuild/catchup)",
+    # -- elastic membership (manager.py) -------------------------------
+    "elastic_join": "replica group joined a live quorum mid-run (deliberate "
+                    "scale-up; healed in via checkpoint transport)",
+    "elastic_leave": "replica group left the quorum gracefully (drain/"
+                     "preemption; step committed, peers unpoisoned)",
 }
 
 
