@@ -1,0 +1,239 @@
+"""Control-plane HA: manager heartbeat/quorum behavior when the lighthouse
+itself fails (the fault class tools/lighthouse_drill.py proves end-to-end).
+
+Live in-proc servers on ephemeral ports, as in test_coordination.py. The
+scenarios here are the satellite coverage for the drill: connection refused
+mid-run (primary killed, warm standby takes over), an unresolvable address
+in the failover list, drain racing a failover, and warm-restart quorum-id
+monotonicity — plus no-thread-leak and no-resurrection-after-leave checks.
+"""
+
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+
+
+def _wait(pred, deadline_s: float = 10.0, tick_s: float = 0.05):
+    """Poll pred() until truthy; return its value or fail the test."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(tick_s)
+    pytest.fail(f"condition not met within {deadline_s}s: {pred}")
+
+
+def _mgr(replica_id: str, lh_list: str, lease_ms: int = 500) -> ManagerServer:
+    return ManagerServer(
+        replica_id=replica_id,
+        lighthouse_addr=lh_list,
+        store_address=f"store-{replica_id}:1",
+        world_size=1,
+        heartbeat_interval_ms=50,
+        lighthouse_lease_ms=lease_ms,
+    )
+
+
+def test_failover_on_connection_refused_mid_run() -> None:
+    """Primary dies mid-run (connection refused on every subsequent RPC):
+    the heartbeat loop must fail over to the warm standby within the lease,
+    and the next quorum must succeed there under a bumped fencing epoch."""
+    threads_before = threading.active_count()
+    primary = LighthouseServer(min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20)
+    standby = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20, standby=True
+    )
+    mgr = ManagerServer(
+        replica_id="ha0",
+        lighthouse_addr=f"{primary.address()},{standby.address()}",
+        store_address="store-ha0:1",
+        world_size=1,
+        heartbeat_interval_ms=50,
+        lighthouse_lease_ms=500,
+    )
+    mc = ManagerClient(mgr.address())
+    try:
+        lh_c = LighthouseClient(primary.address())
+        _wait(lambda: "ha0" in lh_c.status()["heartbeat_ages_ms"])
+        lh_c.close()
+
+        # One quorum against the live primary establishes epoch 1 at the
+        # manager (the fence the standby must then exceed).
+        r1 = mc._quorum(group_rank=0, step=1, checkpoint_metadata="m", shrink_only=False, timeout=15.0)
+        assert int(r1.lh.get("epoch", 0)) == 1
+
+        # Hard-kill the primary process: every subsequent heartbeat and
+        # quorum RPC to it gets ECONNREFUSED, which is exactly the
+        # "lighthouse unreachable" (not "quorum denied") path.
+        primary._server._proc.kill()
+        primary._server._proc.wait()
+
+        info = _wait(
+            lambda: (
+                lambda i: i if int(i["lh"]["failovers"]) >= 1 else None
+            )(mc.info())
+        )
+        assert int(info["lh"]["active"]) == 1
+        assert info["lh"]["addr"] == standby.address()
+
+        # Quorum now lands at the standby, which takes over with a
+        # strictly higher epoch; the manager accepts (and re-fences on) it.
+        r2 = mc._quorum(group_rank=0, step=2, checkpoint_metadata="m", shrink_only=False, timeout=15.0)
+        assert int(r2.lh.get("epoch", 0)) == 2
+        assert r2.quorum.quorum_id > r1.quorum.quorum_id
+
+        sb_c = LighthouseClient(standby.address())
+        st = sb_c.status()
+        assert st["role"] == "active"
+        assert int(st["takeovers"]) == 1
+        sb_c.close()
+    finally:
+        mc.close()
+        mgr.shutdown()
+        standby.shutdown()
+        primary.shutdown()
+    # Servers are subprocesses; the only Python threads this test spawns
+    # live inside the client objects — all closed above, so the count must
+    # return to baseline (no leaked heartbeat/reader threads).
+    _wait(lambda: threading.active_count() <= threads_before)
+
+
+def test_unresolvable_address_in_failover_list() -> None:
+    """A garbage entry in TORCHFT_LIGHTHOUSE must cost one failover hop,
+    not wedge the manager: heartbeats and quorums land on the live entry."""
+    live = LighthouseServer(min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20)
+    mgr = _mgr("ha-dns", f"host.invalid:19999,{live.address()}", lease_ms=400)
+    mc = ManagerClient(mgr.address())
+    try:
+        lh_c = LighthouseClient(live.address())
+        # Managers heartbeat every list entry, so the live one hears from us
+        # immediately; the active index only advances once the dead entry's
+        # lease lapses.
+        _wait(lambda: "ha-dns" in lh_c.status()["heartbeat_ages_ms"], 15.0)
+        info = _wait(
+            lambda: (
+                lambda i: i if int(i["lh"]["failovers"]) >= 1 else None
+            )(mc.info()),
+            15.0,
+        )
+        assert info["lh"]["addr"] == live.address()
+        r = mc._quorum(group_rank=0, step=1, checkpoint_metadata="m", shrink_only=False, timeout=15.0)
+        assert r.quorum.quorum_id >= 1
+        lh_c.close()
+    finally:
+        mc.close()
+        mgr.shutdown()
+        live.shutdown()
+
+
+def test_drain_racing_failover_no_resurrection() -> None:
+    """Kill the primary and immediately drain: leave() must walk the
+    failover list to a live lighthouse, and the tombstone must hold there —
+    the drained replica's in-flight heartbeats cannot resurrect it."""
+    primary = LighthouseServer(min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20)
+    standby = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20, standby=True
+    )
+    mgr = _mgr("drainer", f"{primary.address()},{standby.address()}", lease_ms=400)
+    mc = ManagerClient(mgr.address())
+    try:
+        lh_c = LighthouseClient(primary.address())
+        _wait(lambda: "drainer" in lh_c.status()["heartbeat_ages_ms"])
+        lh_c.close()
+
+        primary._server._proc.kill()
+        primary._server._proc.wait()
+        assert mc.leave(timeout=10.0) is True
+
+        sb_c = LighthouseClient(standby.address())
+        # The leave must register at the standby (tombstone), and hold: wait
+        # out several heartbeat intervals and confirm no resurrection.
+        _wait(lambda: "drainer" not in sb_c.status()["heartbeat_ages_ms"])
+        time.sleep(0.5)
+        assert "drainer" not in sb_c.status()["heartbeat_ages_ms"]
+        sb_c.close()
+    finally:
+        mc.close()
+        mgr.shutdown()
+        standby.shutdown()
+        primary.shutdown()
+
+
+def test_warm_restart_monotone_quorum_ids(tmp_path) -> None:
+    """Same state_dir across a stop/start: the epoch survives (no spurious
+    takeover bump) and quorum ids resume strictly above the pre-crash ones."""
+    state_dir = str(tmp_path / "lh_state")
+    first = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20, state_dir=state_dir
+    )
+    c = LighthouseClient(first.address())
+    q1 = c.quorum(replica_id="wr0", timeout=10.0, address="a0")
+    st1 = c.status()
+    c.close()
+    first.shutdown()
+    assert int(st1["epoch"]) == 1
+
+    second = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20, state_dir=state_dir
+    )
+    try:
+        c = LighthouseClient(second.address())
+        st2 = c.status()
+        # Warm restart resumes the reign: same epoch, no takeover bump.
+        assert int(st2["epoch"]) == 1
+        assert st2["role"] == "active"
+        q2 = c.quorum(replica_id="wr0", timeout=10.0, address="a0")
+        assert q2.quorum_id > q1.quorum_id
+        assert q2.epoch == q1.epoch == 1
+        c.close()
+    finally:
+        second.shutdown()
+
+
+def test_standby_takeover_resumes_quorum_numbering() -> None:
+    """A takeover standby has no disk snapshot from the dead primary; the
+    heartbeat-carried quorum_id high-water mark is what keeps ids strictly
+    monotone across the failover (one epoch owner per quorum_id)."""
+    primary = LighthouseServer(min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20)
+    standby = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20, standby=True
+    )
+    mgr = _mgr("mono", f"{primary.address()},{standby.address()}", lease_ms=400)
+    mc = ManagerClient(mgr.address())
+    try:
+        # A few quorums against the primary advance its quorum_id.
+        last = None
+        for step in range(1, 4):
+            last = mc._quorum(
+                group_rank=0, step=step, checkpoint_metadata="m", shrink_only=False, timeout=15.0
+            )
+        # Let at least one heartbeat carry the accepted high-water mark to
+        # the standby before the primary dies.
+        sb_c = LighthouseClient(standby.address())
+        _wait(
+            lambda: int(sb_c.status()["observed_quorum_id"])
+            >= last.quorum.quorum_id
+        )
+
+        primary._server._proc.kill()
+        primary._server._proc.wait()
+        _wait(lambda: int(mc.info()["lh"]["failovers"]) >= 1)
+
+        r = mc._quorum(group_rank=0, step=4, checkpoint_metadata="m", shrink_only=False, timeout=15.0)
+        assert r.quorum.quorum_id > last.quorum.quorum_id
+        assert r.quorum.epoch > last.quorum.epoch
+        sb_c.close()
+    finally:
+        mc.close()
+        mgr.shutdown()
+        standby.shutdown()
+        primary.shutdown()

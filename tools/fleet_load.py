@@ -448,6 +448,70 @@ def run_fleet(n: int, rounds: int, probes: int,
     return out
 
 
+def restart_scenario(n: int, rounds: int) -> Dict[str, Any]:
+    """Warm-restart storm at fleet size N: register N synthetic replicas
+    against a state-dir'd lighthouse, kill it, restart it on the SAME
+    port + state dir, then measure the re-register storm (all N conns
+    reconnected and heartbeat-acked) and the time for the ``/fleet.json``
+    aggregates to repopulate (``agg.n`` back to N) — the fleet tables are
+    deliberately volatile (rebuilt from the heartbeat stream), so this is
+    the observable cost of the durable-state design choice."""
+    import tempfile
+
+    from torchft_tpu.coordination import LighthouseClient
+
+    state_dir = tempfile.mkdtemp(prefix="tft_lh_restart_")
+    mk = lambda bind: LighthouseServer(  # noqa: E731
+        bind=bind, min_replicas=n, join_timeout_ms=120_000,
+        quorum_tick_ms=50, heartbeat_timeout_ms=120_000,
+        fleet_snap_ms=100, state_dir=state_dir,
+    )
+    out: Dict[str, Any] = {"n": n}
+    server = mk("0.0.0.0:0")
+    try:
+        addr = server.address()
+        port = addr.rsplit(":", 1)[1]
+        conns = connect_fleet(addr, n)
+        out["register"] = heartbeat_phase(conns, rounds)
+        close_fleet(conns)
+
+        t0 = time.monotonic()
+        server.shutdown()
+        server = mk(f"0.0.0.0:{port}")
+        out["restart_s"] = round(time.monotonic() - t0, 3)
+
+        # Re-register storm: every replica reconnects at once (the real
+        # fleet's managers all notice the dead conn within one heartbeat
+        # interval) and must get a heartbeat ack from the warm process.
+        t1 = time.monotonic()
+        conns = connect_fleet(server.address(), n)
+        try:
+            out["reregister"] = heartbeat_phase(conns, 1)
+            out["reregister_s"] = round(time.monotonic() - t1, 3)
+
+            # Repopulation: /fleet.json aggregates are rebuilt from the
+            # heartbeat stream; poll until the row count is back to N.
+            cli = LighthouseClient(server.address())
+            try:
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    agg = (cli.fleet() or {}).get("agg") or {}
+                    if int(agg.get("n", 0)) >= n:
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise TimeoutError(
+                        f"fleet agg never repopulated to n={n}")
+                out["repopulate_s"] = round(time.monotonic() - t1, 3)
+            finally:
+                cli.close()
+        finally:
+            close_fleet(conns)
+    finally:
+        server.shutdown()
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--sizes", type=int, nargs="+", default=None,
@@ -460,11 +524,59 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="concurrent pollers per endpoint (default 4)")
     p.add_argument("--quick", action="store_true",
                    help="CI lane: N=64 only, no before/after experiment")
+    p.add_argument("--restart-lighthouse", action="store_true",
+                   help="run ONLY the warm-restart storm scenario at "
+                        "N=256 (64 with --quick) and merge the result "
+                        "into the existing report")
     p.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_FLEET.json"))
     args = p.parse_args(argv)
     sizes = args.sizes or ([64] if args.quick else [64, 256, 1024])
+
+    if args.restart_lighthouse:
+        # Standalone scenario: merge into the existing BENCH_FLEET.json
+        # (the ladder results stay) and append to the ledger.
+        n = 64 if args.quick else 256
+        print(f"[fleet_load] N={n}: lighthouse warm-restart storm",
+              flush=True)
+        rst = restart_scenario(n, rounds=2)
+        try:
+            with open(args.out) as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            report = {"schema": 1, "fleets": {}}
+        report["restart"] = rst
+        failures = []
+        # Tripwires, not targets: a warm restart that takes this long to
+        # re-absorb the fleet would blow the control-plane TTR budget.
+        if rst["reregister_s"] > 30:
+            failures.append(
+                f"N={n}: re-register storm {rst['reregister_s']}s > 30s")
+        if rst["repopulate_s"] > 60:
+            failures.append(
+                f"N={n}: fleet repopulate {rst['repopulate_s']}s > 60s")
+        report["restart"]["pass"] = not failures
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        try:
+            import perf_ledger
+
+            perf_ledger.record_report(
+                "fleet", {"fleets": {}, "restart": rst},
+                "tools/fleet_load.py (live)"
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"[fleet_load] ledger append skipped: {e}",
+                  file=sys.stderr)
+        print(f"[fleet_load] restart: down={rst['restart_s']}s "
+              f"reregister={rst['reregister_s']}s "
+              f"repopulate={rst['repopulate_s']}s -> {args.out}",
+              flush=True)
+        for msg in failures:
+            print(f"[fleet_load] BUDGET FAIL: {msg}", file=sys.stderr)
+        return 1 if failures else 0
 
     report: Dict[str, Any] = {
         "schema": 1, "quick": bool(args.quick),

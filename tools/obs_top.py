@@ -143,6 +143,10 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
         f"world={int(agg.get('quorum_world', 0))}"
         f"(+{int(agg.get('joins_total', 0))}"
         f"/-{int(agg.get('leaves_total', 0))}) "
+        # EPOCH: the serving lighthouse's fencing epoch — a jump flags a
+        # standby takeover; distinct values across scrapes of different
+        # addresses would flag split-brain.
+        f"epoch={int(agg.get('epoch', 0))} "
         f"digests={int(agg.get('n_digest', 0))} "
         f"stragglers={int(agg.get('stragglers', 0))} "
         f"median_rate={_fmt(agg.get('median_rate'), '{:.3f}')}/s "

@@ -152,8 +152,8 @@ def record_report(
     """Append a live tool report's headline metrics, reusing the same
     extractors as the legacy-artifact importer so live runs extend the
     backfilled trajectories under identical metric names. ``kind`` is
-    one of bench|pg|fleet|wan|recovery|elastic. Returns the number of
-    records
+    one of bench|pg|fleet|wan|recovery|elastic|control. Returns the
+    number of records
     appended;
     never raises into the calling bench."""
     try:
@@ -309,6 +309,20 @@ def _fleet_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
         if fj is not None:
             out.append((f"fleet.fleet_json_p95_us.n{n}", float(fj), "us",
                         "lower", "fleet", src, None))
+    # --restart-lighthouse scenario: warm-restart re-register storm (time
+    # for all N conns to heartbeat-ack against the restarted process) and
+    # /fleet.json aggregate repopulation (agg.n back to N).
+    rst = doc.get("restart") or {}
+    n = rst.get("n")
+    if n is not None:
+        if rst.get("reregister_s") is not None:
+            out.append((f"fleet.restart_reregister_s.n{n}",
+                        float(rst["reregister_s"]), "s", "lower", "fleet",
+                        src, {"restart_s": rst.get("restart_s")}))
+        if rst.get("repopulate_s") is not None:
+            out.append((f"fleet.restart_repopulate_s.n{n}",
+                        float(rst["repopulate_s"]), "s", "lower", "fleet",
+                        src, None))
     return out
 
 
@@ -391,6 +405,36 @@ def _recovery_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _control_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """BENCH_CONTROL.json (tools/lighthouse_drill.py): control-plane TTR
+    after killing the active lighthouse — failover detection latency,
+    quorum-service gap (longest step-visible stall), stale quorums the
+    fence let through (must be 0) — the numbers the control gate pins
+    with absolute budgets."""
+    src = f"tools/lighthouse_drill.py ({os.path.basename(fn)})"
+    summ = doc.get("summary") or {}
+    out = []
+    n_f = summ.get("num_failovers")
+    extra = {"failovers": n_f} if n_f is not None else None
+    if summ.get("failover_p50_s") is not None:
+        out.append(("control.failover_p50_s",
+                    float(summ["failover_p50_s"]), "s", "lower", "control",
+                    src, extra))
+    if summ.get("failover_p95_s") is not None:
+        out.append(("control.failover_p95_s",
+                    float(summ["failover_p95_s"]), "s", "lower", "control",
+                    src, extra))
+    if summ.get("quorum_gap_s") is not None:
+        out.append(("control.quorum_gap_s", float(summ["quorum_gap_s"]),
+                    "s", "lower", "control", src, None))
+    if summ.get("stale_quorums_accepted") is not None:
+        out.append(("control.stale_quorums_accepted",
+                    float(summ["stale_quorums_accepted"]), "count",
+                    "lower", "control", src,
+                    {"demotions": summ.get("demotions")}))
+    return out
+
+
 # Live benches reuse the same extractors via record_report(), so one
 # metric name has exactly one extraction path (import-time and run-time).
 _REPORT_EXTRACTORS = {
@@ -400,6 +444,7 @@ _REPORT_EXTRACTORS = {
     "wan": _wan_records,
     "recovery": _recovery_records,
     "elastic": _elastic_records,
+    "control": _control_records,
 }
 
 

@@ -69,6 +69,14 @@
 #                                       # -> BENCH_WAN.json, then a same-seed
 #                                       # replay asserting the injection
 #                                       # multiset is identical
+#        bash tools/suite_gate.sh control # control-plane-loss drill: kill
+#                                       # the active lighthouse mid-run ->
+#                                       # warm-standby takeover (epoch+1),
+#                                       # resurrected stale primary fenced
+#                                       # out, bit-exact survivors ->
+#                                       # BENCH_CONTROL.json, same-seed
+#                                       # replay, then perf_gate --check vs
+#                                       # pinned failover-TTR budgets
 set -u
 cd "$(dirname "$0")/.."
 
@@ -137,6 +145,17 @@ if [ "${1:-}" = "recovery" ]; then
   timeout 120 env JAX_PLATFORMS=cpu python tools/recovery_report.py \
     --from-bench BENCH_RECOVERY.json --check --min-episodes 1 || exit 1
   echo "== recovery gate: ledger head vs pinned baselines =="
+  exec timeout 120 python tools/perf_gate.py --check
+fi
+
+if [ "${1:-}" = "control" ]; then
+  echo "== control drill: lighthouse kill -> standby takeover -> fence =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/lighthouse_drill.py --quick \
+    || exit 1
+  echo "== control replay: same seed must reproduce the kill schedule =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/lighthouse_drill.py \
+    --replay || exit 1
+  echo "== control gate: ledger head vs pinned failover budgets =="
   exec timeout 120 python tools/perf_gate.py --check
 fi
 

@@ -15,7 +15,8 @@
 static const char* kUsage =
     "usage: lighthouse --min-replicas N [--bind-host H] [--port P]\n"
     "                  [--join-timeout-ms N] [--quorum-tick-ms N]\n"
-    "                  [--heartbeat-timeout-ms N] [--fleet-snap-ms N]\n";
+    "                  [--heartbeat-timeout-ms N] [--fleet-snap-ms N]\n"
+    "                  [--state-dir DIR] [--standby]\n";
 
 int main(int argc, char** argv) {
   std::string bind_host = "0.0.0.0";
@@ -27,6 +28,10 @@ int main(int argc, char** argv) {
   const char* snap_env = std::getenv("TORCHFT_FLEET_SNAP_MS");
   if (snap_env != nullptr && *snap_env != '\0')
     opts.fleet_snap_ms = std::stoll(snap_env);
+  // Durable-state dir (epoch + quorum-id snapshot); the flag wins over the
+  // env knob, empty disables persistence (the pre-HA behavior).
+  const char* sd_env = std::getenv("TORCHFT_LH_STATE_DIR");
+  if (sd_env != nullptr && *sd_env != '\0') opts.state_dir = sd_env;
   bool have_min = false;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -52,6 +57,10 @@ int main(int argc, char** argv) {
       opts.heartbeat_timeout_ms = std::stoll(next());
     } else if (a == "--fleet-snap-ms") {
       opts.fleet_snap_ms = std::stoll(next());
+    } else if (a == "--state-dir") {
+      opts.state_dir = next();
+    } else if (a == "--standby") {
+      opts.standby = true;
     } else if (a == "--parent-pid") {
       tft::watch_parent(std::stoll(next()));
     } else {

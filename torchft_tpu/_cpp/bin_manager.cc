@@ -13,14 +13,19 @@
 #include "net.hpp"
 
 static const char* kUsage =
-    "usage: torchft_manager --replica-id ID --lighthouse HOST:PORT\n"
+    "usage: torchft_manager --replica-id ID --lighthouse HOST:PORT[,...]\n"
     "         --store-address HOST:PORT --world-size N\n"
     "         [--advertise-host H] [--bind-host H] [--port P]\n"
     "         [--heartbeat-interval-ms N] [--connect-timeout-ms N]\n"
-    "         [--quorum-retries N]\n";
+    "         [--quorum-retries N] [--lh-lease-ms N]\n";
 
 int main(int argc, char** argv) {
   tft::ManagerOpts opts;
+  // Active-lighthouse lease before failing over down the --lighthouse list;
+  // the flag wins over the env knob.
+  const char* lease_env = std::getenv("TORCHFT_LH_LEASE_MS");
+  if (lease_env != nullptr && *lease_env != '\0')
+    opts.lighthouse_lease_ms = std::stoll(lease_env);
   int64_t parent_pid = 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -51,6 +56,8 @@ int main(int argc, char** argv) {
       opts.connect_timeout_ms = std::stoll(next());
     } else if (a == "--quorum-retries") {
       opts.quorum_retries = std::stoll(next());
+    } else if (a == "--lh-lease-ms") {
+      opts.lighthouse_lease_ms = std::stoll(next());
     } else if (a == "--parent-pid") {
       parent_pid = std::stoll(next());
     } else {

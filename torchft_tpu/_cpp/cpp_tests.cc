@@ -6,6 +6,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cstdlib>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -424,6 +425,246 @@ static void test_lighthouse_leave() {
   CHECK_EQ(rc.get("quorum").get("participants").arr.size(), size_t(3));
   s = lighthouse_call(addr, sreq, 2000);
   CHECK_EQ(s.get("status").get("left").arr.size(), size_t(0));
+  lh.stop();
+}
+
+// ---- Lighthouse HA: durable state, fencing epoch, standby failover ----
+
+static void test_lh_durable_state() {
+  char tmpl[] = "/tmp/tft_lhstate_XXXXXX";
+  CHECK(mkdtemp(tmpl) != nullptr);
+  std::string dir = tmpl;
+
+  // Missing file: load fails, output untouched semantics don't matter.
+  LighthouseDurable d;
+  CHECK(!lh_state_load(dir, &d));
+
+  d.epoch = 3;
+  d.quorum_id = 7;
+  d.generation = 42;
+  CHECK(lh_state_save(dir, d));
+  LighthouseDurable r;
+  CHECK(lh_state_load(dir, &r));
+  CHECK_EQ(r.epoch, 3);
+  CHECK_EQ(r.quorum_id, 7);
+  CHECK_EQ(r.generation, 42);
+
+  // Overwrite (the rename path must replace, not append).
+  d.epoch = 4;
+  d.quorum_id = 9;
+  CHECK(lh_state_save(dir, d));
+  CHECK(lh_state_load(dir, &r));
+  CHECK_EQ(r.epoch, 4);
+  CHECK_EQ(r.quorum_id, 9);
+
+  // Garbage snapshot: load must fail cleanly (caller boots fresh), never
+  // crash or half-apply.
+  {
+    FILE* f = fopen((dir + "/lighthouse_state.json").c_str(), "w");
+    CHECK(f != nullptr);
+    fputs("{not json", f);
+    fclose(f);
+  }
+  CHECK(!lh_state_load(dir, &r));
+
+  // Unwritable dir: save reports failure instead of silently dropping state.
+  CHECK(!lh_state_save(dir + "/no/such/dir", d));
+}
+
+static void test_quorum_epoch_json_roundtrip() {
+  Quorum q;
+  q.quorum_id = 11;
+  q.epoch = 5;
+  q.generation = 9;
+  q.participants.push_back(mk_member("repA", 3));
+  Quorum r = Quorum::from_json(q.to_json());
+  CHECK_EQ(r.quorum_id, 11);
+  CHECK_EQ(r.epoch, 5);
+  CHECK_EQ(r.generation, 9);
+
+  // Pre-HA wire frames carry no epoch/generation: defaults must be 0 so a
+  // mixed-version fleet doesn't spuriously trip the fence.
+  Json j;
+  std::string err;
+  CHECK(Json::parse("{\"quorum_id\":2,\"participants\":[]}", &j, &err));
+  Quorum old = Quorum::from_json(j);
+  CHECK_EQ(old.epoch, 0);
+  CHECK_EQ(old.generation, 0);
+}
+
+static void test_lighthouse_warm_restart() {
+  char tmpl[] = "/tmp/tft_lhwarm_XXXXXX";
+  CHECK(mkdtemp(tmpl) != nullptr);
+  std::string dir = tmpl;
+
+  LighthouseOpts opt;
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 5000;
+  opt.state_dir = dir;
+
+  int64_t qid1 = 0, epoch1 = 0, gen1 = 0;
+  {
+    Lighthouse lh("127.0.0.1", 0, opt);
+    CHECK(lh.start());
+    std::string addr = lh.address();
+    auto quorum_req = [&](const std::string& id, int64_t step) {
+      Json req = Json::object();
+      req["type"] = Json::of("quorum");
+      req["timeout_ms"] = Json::of(int64_t(5000));
+      req["requester"] = mk_member(id, step).to_json();
+      return lighthouse_call(addr, req, 6000);
+    };
+    Json ra, rb;
+    std::thread ta([&] { ra = quorum_req("repA", 1); });
+    std::thread tb([&] { rb = quorum_req("repB", 1); });
+    ta.join();
+    tb.join();
+    CHECK(ra.get("ok").as_bool());
+    qid1 = ra.get("quorum").get("quorum_id").as_int();
+    epoch1 = ra.get("quorum").get("epoch").as_int();
+    gen1 = ra.get("quorum").get("generation").as_int();
+    CHECK_EQ(epoch1, 1);  // fresh active boot
+    CHECK(gen1 >= 1);
+    lh.stop();
+  }
+
+  // Warm restart from the same state dir: the reign resumes (same epoch — no
+  // takeover happened), but quorum ids and generations must stay strictly
+  // monotone even though the generation counter was only persisted with
+  // reserve headroom, never per broadcast.
+  {
+    Lighthouse lh("127.0.0.1", 0, opt);
+    CHECK(lh.start());
+    std::string addr = lh.address();
+    auto quorum_req = [&](const std::string& id, int64_t step) {
+      Json req = Json::object();
+      req["type"] = Json::of("quorum");
+      req["timeout_ms"] = Json::of(int64_t(5000));
+      req["requester"] = mk_member(id, step).to_json();
+      return lighthouse_call(addr, req, 6000);
+    };
+    Json ra, rb;
+    std::thread ta([&] { ra = quorum_req("repA", 2); });
+    std::thread tb([&] { rb = quorum_req("repB", 2); });
+    ta.join();
+    tb.join();
+    CHECK(ra.get("ok").as_bool());
+    CHECK_EQ(ra.get("quorum").get("epoch").as_int(), epoch1);
+    CHECK(ra.get("quorum").get("quorum_id").as_int() > qid1);
+    CHECK(ra.get("quorum").get("generation").as_int() > gen1);
+
+    Json sreq = Json::object();
+    sreq["type"] = Json::of("status");
+    Json s = lighthouse_call(addr, sreq, 2000).get("status");
+    CHECK_EQ(s.get("role").as_str(), std::string("active"));
+    CHECK_EQ(s.get("epoch").as_int(), epoch1);
+    lh.stop();
+  }
+}
+
+static void test_lighthouse_standby_takeover() {
+  // A standby absorbs heartbeats read-only; the first quorum request to
+  // reach it means the fleet failed over, and it must take over with a
+  // strictly higher epoch than anything it has observed.
+  LighthouseOpts opt;
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 5000;
+  opt.standby = true;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  // Heartbeats carry the fleet's max accepted epoch (here: 3, stamped by
+  // managers that accepted quorums from the dead primary).
+  Json hreq = Json::object();
+  hreq["type"] = Json::of("heartbeat");
+  hreq["replica_id"] = Json::of(std::string("repA"));
+  hreq["epoch"] = Json::of(int64_t(3));
+  // ...and the max accepted quorum_id (7): the takeover must resume
+  // numbering strictly above it, not restart from 1.
+  hreq["quorum_id"] = Json::of(int64_t(7));
+  CHECK(lighthouse_call(addr, hreq, 2000).get("ok").as_bool());
+
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s = lighthouse_call(addr, sreq, 2000).get("status");
+  CHECK_EQ(s.get("role").as_str(), std::string("standby"));
+  CHECK_EQ(s.get("observed_epoch").as_int(), 3);
+  CHECK_EQ(s.get("observed_quorum_id").as_int(), 7);
+
+  auto quorum_req = [&](const std::string& id, int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["timeout_ms"] = Json::of(int64_t(5000));
+    req["requester"] = mk_member(id, step).to_json();
+    return lighthouse_call(addr, req, 6000);
+  };
+  Json ra, rb;
+  std::thread ta([&] { ra = quorum_req("repA", 1); });
+  std::thread tb([&] { rb = quorum_req("repB", 1); });
+  ta.join();
+  tb.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("epoch").as_int(), 4);  // observed(3) + 1
+  // Quorum ids continue past the dead primary's high-water mark.
+  CHECK_EQ(ra.get("quorum").get("quorum_id").as_int(), 8);  // observed(7) + 1
+
+  s = lighthouse_call(addr, sreq, 2000).get("status");
+  CHECK_EQ(s.get("role").as_str(), std::string("active"));
+  CHECK_EQ(s.get("takeovers").as_int(), 1);
+  lh.stop();
+}
+
+static void test_lighthouse_demotion() {
+  // A resurrected stale primary boots active, then sees heartbeats stamped
+  // with the successor's higher epoch: it must fence itself out (demote to
+  // standby), not compete for the fleet.
+  LighthouseOpts opt;
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 5000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s = lighthouse_call(addr, sreq, 2000).get("status");
+  CHECK_EQ(s.get("role").as_str(), std::string("active"));
+  CHECK_EQ(s.get("epoch").as_int(), 1);
+
+  Json hreq = Json::object();
+  hreq["type"] = Json::of("heartbeat");
+  hreq["replica_id"] = Json::of(std::string("repA"));
+  hreq["epoch"] = Json::of(int64_t(5));
+  CHECK(lighthouse_call(addr, hreq, 2000).get("ok").as_bool());
+
+  s = lighthouse_call(addr, sreq, 2000).get("status");
+  CHECK_EQ(s.get("role").as_str(), std::string("standby"));
+  CHECK_EQ(s.get("demotions").as_int(), 1);
+  CHECK_EQ(s.get("observed_epoch").as_int(), 5);
+
+  // If the fleet later fails over TO this instance (quorum request arrives),
+  // it re-takes with epoch above everything observed — ids never go back.
+  auto quorum_req = [&](const std::string& id, int64_t step) {
+    Json req = Json::object();
+    req["type"] = Json::of("quorum");
+    req["timeout_ms"] = Json::of(int64_t(5000));
+    req["requester"] = mk_member(id, step).to_json();
+    return lighthouse_call(addr, req, 6000);
+  };
+  Json ra, rb;
+  std::thread ta([&] { ra = quorum_req("repA", 1); });
+  std::thread tb([&] { rb = quorum_req("repB", 1); });
+  ta.join();
+  tb.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("epoch").as_int(), 6);
   lh.stop();
 }
 
@@ -1403,6 +1644,11 @@ int main() {
   test_fleet_snapshot_concurrent();
   test_lighthouse_e2e();
   test_lighthouse_leave();
+  test_lh_durable_state();
+  test_quorum_epoch_json_roundtrip();
+  test_lighthouse_warm_restart();
+  test_lighthouse_standby_takeover();
+  test_lighthouse_demotion();
   test_manager_leave();
   test_operator_drain_request();
   test_operator_drain_all();

@@ -41,10 +41,51 @@ Lighthouse::Lighthouse(const std::string& bind_host, int port,
 
 Lighthouse::~Lighthouse() { stop(); }
 
+// Reserve this much generation headroom on every durable save: generations
+// bump on every broadcast but are only persisted on (rare) quorum_id/epoch
+// changes, so a reload must jump past anything possibly handed out since
+// the last fsync to keep (epoch, generation) strictly monotone.
+static constexpr int64_t kGenReserve = 1 << 20;
+
+void Lighthouse::persist_locked() {
+  if (opts_.state_dir.empty()) return;
+  LighthouseDurable d;
+  d.epoch = epoch_;
+  d.quorum_id = state_.quorum_id;
+  d.generation = quorum_gen_ + kGenReserve;
+  if (!lh_state_save(opts_.state_dir, d)) {
+    fprintf(stderr, "[lighthouse] WARNING: failed to persist state to %s\n",
+            opts_.state_dir.c_str());
+  }
+}
+
 bool Lighthouse::start() {
   listen_fd_ = tcp_listen(bind_host_, port_);
   if (listen_fd_ < 0) return false;
   port_ = bound_port(listen_fd_);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    active_ = !opts_.standby;
+    LighthouseDurable d;
+    if (!opts_.state_dir.empty() && lh_state_load(opts_.state_dir, &d)) {
+      // Warm restart: resume the persisted reign — same epoch (we may still
+      // be the rightful owner), quorum ids continue strictly monotone, and
+      // generations jump past the reserved headroom. Participant/fleet
+      // tables rebuild from the live heartbeat stream.
+      epoch_ = d.epoch;
+      state_.quorum_id = d.quorum_id;
+      quorum_gen_ = d.generation;
+      fprintf(stderr,
+              "[lighthouse] warm restart from %s: epoch=%lld quorum_id=%lld "
+              "gen=%lld%s\n",
+              opts_.state_dir.c_str(), static_cast<long long>(epoch_),
+              static_cast<long long>(state_.quorum_id),
+              static_cast<long long>(quorum_gen_),
+              active_ ? "" : " (standby)");
+    }
+    if (active_ && epoch_ == 0) epoch_ = 1;  // fresh active boot
+    if (active_) persist_locked();
+  }
   running_ = true;
   accept_thread_ = std::thread([this] { accept_loop(); });
   tick_thread_ = std::thread([this] { tick_loop(); });
@@ -99,6 +140,13 @@ void Lighthouse::tick() {
   // the tick so a wedged replica is flagged while it is STILL wedged —
   // before its step completes or its heartbeat resumes.
   fleet_scan_locked(now_ms());
+  // A standby absorbs heartbeats (keeping fleet/participant tables warm)
+  // but must not form quorums — there is exactly one epoch owner, and it is
+  // not us until a manager fails over and its quorum request promotes us.
+  if (!active_) {
+    last_reason_ = "standby (not forming quorums)";
+    return;
+  }
   std::string reason;
   int64_t q_t0 = now_us_steady();
   auto members = quorum_compute(now_ms(), state_, opts_, &reason);
@@ -122,7 +170,13 @@ void Lighthouse::tick() {
     for (const auto& m : *members)
       if (m.commit_failures > 0) bump = true;
   }
-  if (bump) state_.quorum_id += 1;
+  if (bump) {
+    state_.quorum_id += 1;
+    // Fsync the new id BEFORE publishing the quorum: a crash between
+    // publish and persist could otherwise let a warm restart re-issue an id
+    // the fleet has already seen.
+    persist_locked();
+  }
 
   // Participant churn across quorum transitions (surfaced via status +
   // /metrics): a member present now but not in the previous quorum is a
@@ -145,6 +199,8 @@ void Lighthouse::tick() {
   q.quorum_id = state_.quorum_id;
   q.participants = *members;
   q.created_ms = now_ms();
+  q.epoch = epoch_;
+  q.generation = quorum_gen_ + 1;
   state_.prev_quorum = q;
   state_.participants.clear();  // next round starts fresh (lighthouse.rs:336)
   last_quorum_ = q;
@@ -215,6 +271,31 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     {
       std::lock_guard<std::mutex> lk(mu_);
       const std::string replica_id = req.get("replica_id").as_str();
+      // Managers stamp the max quorum epoch they have accepted into every
+      // heartbeat: this is how a standby (or a resurrected stale primary)
+      // learns the fleet's current owner without any lighthouse-to-
+      // lighthouse channel. An active instance seeing a higher epoch has
+      // been superseded by a takeover — it fences itself out (demotes to
+      // standby) instead of competing for the fleet.
+      int64_t hb_epoch = req.get("epoch").as_int(0);
+      if (hb_epoch > observed_epoch_) observed_epoch_ = hb_epoch;
+      // Max accepted quorum_id rides the same frames: a standby resumes
+      // numbering above it on takeover (strict monotonicity across
+      // failover, where no disk snapshot is available to restore from).
+      int64_t hb_qid = req.get("quorum_id").as_int(0);
+      if (hb_qid > observed_quorum_id_) observed_quorum_id_ = hb_qid;
+      if (active_ && observed_epoch_ > epoch_) {
+        active_ = false;
+        demotions_ += 1;
+        last_reason_ = "fenced: observed epoch " +
+                       std::to_string(observed_epoch_) + " > own epoch " +
+                       std::to_string(epoch_);
+        fprintf(stderr,
+                "[lighthouse] demoting to standby: fleet is on epoch %lld, "
+                "ours is %lld (stale primary fenced out)\n",
+                static_cast<long long>(observed_epoch_),
+                static_cast<long long>(epoch_));
+      }
       // A drained replica's manager may have one heartbeat in flight when
       // its leave lands; the tombstone keeps it from resurrecting the entry
       // (which would stall the survivors' next quorum until heartbeat
@@ -390,6 +471,24 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
   }
   const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
   std::unique_lock<std::mutex> lk(mu_);
+  // Warm-standby takeover: managers only send quorum RPCs to their active
+  // target, so a quorum request arriving at a standby means the fleet's
+  // lease on the old primary lapsed and failover chose us. Claim the reign
+  // with a strictly higher epoch than anything observed (fencing out the
+  // old primary) and persist it before serving a single quorum.
+  if (!active_) {
+    epoch_ = std::max(epoch_, observed_epoch_) + 1;
+    // Resume quorum numbering above anything the fleet accepted from the
+    // old primary: each quorum_id must have exactly one (epoch) owner.
+    state_.quorum_id = std::max(state_.quorum_id, observed_quorum_id_);
+    active_ = true;
+    takeovers_ += 1;
+    persist_locked();
+    fprintf(stderr,
+            "[lighthouse] standby takeover: now active with epoch %lld "
+            "(first quorum request from %s)\n",
+            static_cast<long long>(epoch_), me.replica_id.c_str());
+  }
   // Joining is an implicit heartbeat (lighthouse.rs:502-512) and clears any
   // graceful-leave tombstone (a drained replica relaunching to rejoin).
   state_.left.erase(me.replica_id);
@@ -452,6 +551,12 @@ Json Lighthouse::status_json() {
   s["quorum_generation"] = Json::of(quorum_gen_);
   s["joins_total"] = Json::of(joins_total_);
   s["leaves_total"] = Json::of(leaves_total_);
+  s["epoch"] = Json::of(epoch_);
+  s["observed_epoch"] = Json::of(observed_epoch_);
+  s["observed_quorum_id"] = Json::of(observed_quorum_id_);
+  s["role"] = Json::of(std::string(active_ ? "active" : "standby"));
+  s["takeovers"] = Json::of(takeovers_);
+  s["demotions"] = Json::of(demotions_);
   int64_t now = now_ms();
   Json hb = Json::object();
   for (const auto& kv : state_.heartbeats)
@@ -729,6 +834,11 @@ Json Lighthouse::fleet_agg_locked(int64_t now) {
                    : int64_t{0});
   agg["joins_total"] = Json::of(joins_total_);
   agg["leaves_total"] = Json::of(leaves_total_);
+  // Control-plane ownership view: the fencing epoch this instance stamps on
+  // quorums (obs_top's EPOCH column). A jump means a standby takeover; a
+  // reader comparing two lighthouses can tell owner from fenced stale
+  // primary by it.
+  agg["epoch"] = Json::of(epoch_);
   return agg;
 }
 
@@ -884,6 +994,8 @@ std::string Lighthouse::render_metrics() {
     double rate = 0.0;
   };
   int64_t now, quorum_id, quorum_gen, joins, leaves, aseq, adropped, gen;
+  int64_t epoch, takeovers, demotions;
+  bool is_active;
   size_t n_participants, n_members;
   std::vector<std::pair<std::string, int64_t>> hb_ages;
   std::vector<std::pair<std::string, int64_t>> member_steps;
@@ -898,6 +1010,10 @@ std::string Lighthouse::render_metrics() {
     quorum_gen = quorum_gen_;
     joins = joins_total_;
     leaves = leaves_total_;
+    epoch = epoch_;
+    takeovers = takeovers_;
+    demotions = demotions_;
+    is_active = active_;
     aseq = anomaly_seq_;
     adropped = anomalies_dropped_;
     gen = fleet_gen_;
@@ -943,6 +1059,21 @@ std::string Lighthouse::render_metrics() {
        "boot.\n"
     << "# TYPE torchft_lighthouse_quorum_generation counter\n"
     << "torchft_lighthouse_quorum_generation " << quorum_gen << "\n";
+  m << "# HELP torchft_lighthouse_epoch Fencing epoch stamped on quorums.\n"
+    << "# TYPE torchft_lighthouse_epoch gauge\n"
+    << "torchft_lighthouse_epoch " << epoch << "\n";
+  m << "# HELP torchft_lighthouse_active 1 when this instance owns the "
+       "fleet (forms quorums); 0 when standby/fenced.\n"
+    << "# TYPE torchft_lighthouse_active gauge\n"
+    << "torchft_lighthouse_active " << (is_active ? 1 : 0) << "\n";
+  m << "# HELP torchft_lighthouse_takeovers_total Standby->active "
+       "transitions.\n"
+    << "# TYPE torchft_lighthouse_takeovers_total counter\n"
+    << "torchft_lighthouse_takeovers_total " << takeovers << "\n";
+  m << "# HELP torchft_lighthouse_demotions_total Active->standby fences "
+       "(superseded by a higher epoch).\n"
+    << "# TYPE torchft_lighthouse_demotions_total counter\n"
+    << "torchft_lighthouse_demotions_total " << demotions << "\n";
   m << "# HELP torchft_lighthouse_joins_total Members added across quorum "
        "transitions.\n"
     << "# TYPE torchft_lighthouse_joins_total counter\n"

@@ -271,6 +271,26 @@ class Lighthouse {
   int64_t leaves_total_ = 0;  // members gone across quorum transitions
   std::string last_reason_;            // why no quorum yet (for status page)
 
+  // ---- HA / fencing state (guarded by mu_ unless noted) ----
+  // Fencing epoch this instance stamps on quorums while active. Restored
+  // from the durable snapshot on warm restart; bumped past observed_epoch_
+  // on standby takeover. 0 only before a fresh active boot assigns 1.
+  int64_t epoch_ = 0;
+  // Max epoch seen in manager heartbeats — the fleet's view of the current
+  // owner. A standby uses it to fence its takeover epoch; an active
+  // instance that observes a higher value has been superseded and demotes.
+  int64_t observed_epoch_ = 0;
+  // Max quorum_id seen in manager heartbeats. A standby resumes numbering
+  // above it on takeover so quorum ids stay strictly monotone across
+  // failover (a standby has no disk state from the old primary to restore).
+  int64_t observed_quorum_id_ = 0;
+  bool active_ = true;        // false = standby: absorb heartbeats only
+  int64_t takeovers_ = 0;     // standby -> active transitions
+  int64_t demotions_ = 0;     // active -> standby (fenced by higher epoch)
+  // Persist {epoch_, state_.quorum_id, quorum_gen_} with mu_ held; called
+  // before a new quorum is published so ids stay monotone across crashes.
+  void persist_locked();
+
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;

@@ -16,6 +16,20 @@ namespace tft {
 ManagerServer::ManagerServer(ManagerOpts opts) : opts_(std::move(opts)) {
   if (opts_.bind_host.empty()) opts_.bind_host = "0.0.0.0";
   if (opts_.advertise_host.empty()) opts_.advertise_host = "127.0.0.1";
+  // Parse the ordered lighthouse list once; the vector is read-only after
+  // construction so both the heartbeat thread and quorum path can index it
+  // with only the atomic active index.
+  std::string rest = opts_.lighthouse_addr;
+  while (!rest.empty()) {
+    size_t comma = rest.find(',');
+    std::string one = rest.substr(0, comma);
+    size_t b = one.find_first_not_of(" \t");
+    size_t e = one.find_last_not_of(" \t");
+    if (b != std::string::npos) lh_addrs_.push_back(one.substr(b, e - b + 1));
+    if (comma == std::string::npos) break;
+    rest = rest.substr(comma + 1);
+  }
+  if (lh_addrs_.empty()) lh_addrs_.push_back(opts_.lighthouse_addr);
 }
 
 ManagerServer::~ManagerServer() { stop(); }
@@ -62,16 +76,36 @@ void ManagerServer::accept_loop() {
 }
 
 void ManagerServer::heartbeat_loop() {
-  // Pings the lighthouse every heartbeat_interval_ms over a persistent
-  // connection, recreating it on failure (manager.rs:194-216).
-  std::string host;
-  int port = 0;
-  if (!split_host_port(opts_.lighthouse_addr, &host, &port)) {
-    fprintf(stderr, "[manager %s] bad lighthouse addr '%s'\n",
-            opts_.replica_id.c_str(), opts_.lighthouse_addr.c_str());
-    return;
+  // Pings EVERY lighthouse in the ordered list each round over persistent
+  // connections (manager.rs:194-216, extended for HA): the active entry's
+  // ack renews its lease; standbys receive the same heartbeats read-only so
+  // their fleet/participant tables stay warm for takeover. When the active
+  // entry's lease lapses (no ack for lighthouse_lease_ms) we fail over
+  // deterministically to the next address down the list, with the shared
+  // seeded-jitter backoff so a fleet of managers doesn't storm the standby
+  // in lockstep.
+  const size_t n = lh_addrs_.size();
+  std::vector<std::string> hosts(n);
+  std::vector<int> ports(n, -1);
+  size_t n_ok = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (split_host_port(lh_addrs_[i], &hosts[i], &ports[i])) {
+      n_ok++;
+    } else {
+      ports[i] = -1;
+      fprintf(stderr, "[manager %s] bad lighthouse addr '%s' (entry %zu)\n",
+              opts_.replica_id.c_str(), lh_addrs_[i].c_str(), i);
+    }
   }
-  int fd = -1;
+  if (n_ok == 0) return;
+  std::vector<int> fds(n, -1);
+  // Per-address reconnect backoff: a dead standby must not stall every
+  // round behind its connect timeout, and the active entry's connect budget
+  // must stay well inside the lease so a down primary is detected in time.
+  std::vector<int64_t> next_try_ms(n, 0);
+  std::vector<uint64_t> fail_streak(n, 0);
+  int64_t last_active_ok_ms = now_ms();
+  uint64_t failover_streak = 0;  // consecutive failovers without any ack
   while (running_) {
     if (draining_) {
       // Graceful drain in progress: no more heartbeats (a fresh heartbeat
@@ -79,38 +113,104 @@ void ManagerServer::heartbeat_loop() {
       sleep_ms(opts_.heartbeat_interval_ms);
       continue;
     }
-    // Attribute heartbeat I/O to (ctrl, lighthouse-host, "heartbeat") for
-    // the chaos plane: a stall@ctrl:match=heartbeat spec can delay THIS
-    // replica's heartbeats (the fleet lane's straggler signal) without
-    // touching quorum or data traffic.
-    chaos::ScopedCtx chaos_ctx("ctrl", host, "heartbeat");
-    if (fd < 0) fd = tcp_connect(host, port, opts_.connect_timeout_ms);
-    if (fd >= 0) {
-      Json req = Json::object();
-      req["type"] = Json::of("heartbeat");
-      req["replica_id"] = Json::of(opts_.replica_id);
-      // Carry our address: lets the lighthouse drain_all reach us even if
-      // we never managed to register a quorum (drain_all blind spot).
-      req["address"] = Json::of(address());
-      // Our nominal cadence: lets the lighthouse derive a deterministic
-      // jitter threshold instead of guessing from arrival statistics.
-      req["hb_interval_ms"] = Json::of(opts_.heartbeat_interval_ms);
-      {
-        // Piggyback the latest health digest (if the trainer pushed one).
-        // Old lighthouses read only the keys they know, so this is free
-        // to send unconditionally.
-        std::lock_guard<std::mutex> lk(digest_mu_);
-        if (has_digest_) req["digest"] = digest_;
+    const int active = lh_active_.load() % static_cast<int>(n);
+    for (size_t i = 0; i < n && running_ && !draining_; i++) {
+      if (ports[i] < 0) continue;
+      const bool is_active = static_cast<int>(i) == active;
+      int64_t now = now_ms();
+      if (!is_active && fds[i] < 0 && now < next_try_ms[i]) continue;
+      // Attribute heartbeat I/O to (ctrl, lighthouse-host, "heartbeat") for
+      // the chaos plane: a stall@ctrl:match=heartbeat spec can delay THIS
+      // replica's heartbeats (the fleet lane's straggler signal) without
+      // touching quorum or data traffic.
+      chaos::ScopedCtx chaos_ctx("ctrl", hosts[i], "heartbeat");
+      if (fds[i] < 0) {
+        // Connect budget: a third of the lease for the active entry (a dead
+        // primary must be detected within the lease, not behind a 10 s
+        // connect), a short probe for standbys.
+        int64_t budget = is_active
+                             ? std::max<int64_t>(
+                                   50, std::min(opts_.lighthouse_lease_ms / 3,
+                                                opts_.connect_timeout_ms))
+                             : 250;
+        fds[i] = tcp_connect(hosts[i], ports[i], budget);
       }
-      Json resp;
-      if (!call_json(fd, req, &resp, 5000)) {
-        close(fd);
-        fd = -1;
+      bool acked = false;
+      if (fds[i] >= 0) {
+        Json req = Json::object();
+        req["type"] = Json::of("heartbeat");
+        req["replica_id"] = Json::of(opts_.replica_id);
+        // Carry our address: lets the lighthouse drain_all reach us even if
+        // we never managed to register a quorum (drain_all blind spot).
+        req["address"] = Json::of(address());
+        // Our nominal cadence: lets the lighthouse derive a deterministic
+        // jitter threshold instead of guessing from arrival statistics.
+        req["hb_interval_ms"] = Json::of(opts_.heartbeat_interval_ms);
+        // The max quorum epoch we have accepted: the heartbeat stream is how
+        // standbys learn the fleet's current owner (for a fenced takeover
+        // epoch) and how a resurrected stale primary learns it has been
+        // superseded (self-demotes).
+        req["epoch"] = Json::of(lh_epoch_.load());
+        // Max accepted quorum_id rides along so a takeover standby can
+        // resume numbering strictly above the old primary's quorums.
+        req["quorum_id"] = Json::of(lh_quorum_id_.load());
+        req["lh_index"] = Json::of(static_cast<int64_t>(active));
+        {
+          // Piggyback the latest health digest (if the trainer pushed one).
+          // Old lighthouses read only the keys they know, so this is free
+          // to send unconditionally.
+          std::lock_guard<std::mutex> lk(digest_mu_);
+          if (has_digest_) req["digest"] = digest_;
+        }
+        Json resp;
+        if (call_json(fds[i], req, &resp, 5000)) {
+          acked = resp.get("ok").as_bool();
+        } else {
+          close(fds[i]);
+          fds[i] = -1;
+        }
       }
+      if (acked) {
+        fail_streak[i] = 0;
+        next_try_ms[i] = 0;
+        if (is_active) {
+          last_active_ok_ms = now_ms();
+          failover_streak = 0;
+        }
+      } else if (fds[i] < 0) {
+        fail_streak[i] += 1;
+        double unit = chaos::backoff_unit(
+            opts_.replica_id + "|hb|" + lh_addrs_[i], fail_streak[i]);
+        next_try_ms[i] =
+            now_ms() + static_cast<int64_t>(unit * 2000.0);  // cap 2 s
+      }
+    }
+    if (!draining_ &&
+        now_ms() - last_active_ok_ms > opts_.lighthouse_lease_ms) {
+      // Lease lapsed: deterministic failover down the list (wrapping, so a
+      // resurrected earlier entry can be re-adopted if everything later
+      // also dies — it will take over with a freshly fenced epoch).
+      failover_streak += 1;
+      int next = (active + 1) % static_cast<int>(n);
+      lh_active_.store(next);
+      lh_failovers_.fetch_add(1);
+      last_active_ok_ms = now_ms();
+      fprintf(stderr,
+              "[manager %s] lighthouse lease lapsed on %s: failing over to "
+              "%s (failover #%lld)\n",
+              opts_.replica_id.c_str(), lh_addrs_[active].c_str(),
+              lh_addrs_[next].c_str(),
+              static_cast<long long>(lh_failovers_.load()));
+      // Seeded full-jitter pause (shared PR-7 backoff) so the whole fleet
+      // doesn't re-register against the standby in the same instant.
+      double unit = chaos::backoff_unit(opts_.replica_id + "|lh_failover",
+                                        failover_streak);
+      sleep_ms(static_cast<int64_t>(unit * 500.0));
     }
     sleep_ms(opts_.heartbeat_interval_ms);
   }
-  if (fd >= 0) close(fd);
+  for (size_t i = 0; i < n; i++)
+    if (fds[i] >= 0) close(fds[i]);
 }
 
 void ManagerServer::handle_conn(int fd) {
@@ -208,6 +308,7 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     resp["replica_id"] = Json::of(opts_.replica_id);
     resp["address"] = Json::of(address());
     resp["world_size"] = Json::of(opts_.world_size);
+    resp["lh"] = lh_info_json();
     return resp;
   }
   resp["ok"] = Json::of(false);
@@ -215,22 +316,51 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
   return resp;
 }
 
+Json ManagerServer::lh_info_json() const {
+  Json lh = Json::object();
+  int idx = lh_active_.load() % static_cast<int>(lh_addrs_.size());
+  lh["active"] = Json::of(static_cast<int64_t>(idx));
+  lh["addr"] = Json::of(lh_addrs_[idx]);
+  lh["failovers"] = Json::of(lh_failovers_.load());
+  lh["epoch"] = Json::of(lh_epoch_.load());
+  lh["stale_rejected"] = Json::of(lh_stale_rejected_.load());
+  lh["unreachable_retries"] = Json::of(lh_unreachable_retries_.load());
+  return lh;
+}
+
 std::optional<Quorum> ManagerServer::lighthouse_quorum(
-    const QuorumMember& me, int64_t deadline_ms, const std::string& trace_id) {
+    const QuorumMember& me, int64_t deadline_ms, const std::string& trace_id,
+    std::string* error) {
   // Retry with per-attempt deadline slices (manager.rs:250-306): each attempt
-  // gets total/(retries+1); sleeps at least 100ms between attempts.
+  // gets total/(retries+1). A connect-level failure (lighthouse unreachable —
+  // a transient blip or a dead primary mid-failover) is absorbed with the
+  // shared seeded full-jitter backoff rather than failing the step; a live
+  // lighthouse's explicit refusal is a different error. The active target is
+  // re-read every attempt: the heartbeat thread's lease may fail over
+  // mid-retry and the next attempt must follow it down the list.
   int64_t attempts = std::max<int64_t>(1, opts_.quorum_retries + 1);
   int64_t total = std::max<int64_t>(1, deadline_ms - now_ms());
   int64_t slice = std::max<int64_t>(100, total / attempts);
-  std::string host;
-  int port = 0;
-  if (!split_host_port(opts_.lighthouse_addr, &host, &port)) return std::nullopt;
+  int64_t unreachable = 0;
+  std::string last_addr;
+  std::string denied;
 
   for (int64_t a = 0; a < attempts && running_; a++) {
+    const std::string addr =
+        lh_addrs_[lh_active_.load() % static_cast<int>(lh_addrs_.size())];
+    last_addr = addr;
+    std::string host;
+    int port = 0;
+    int fd = -1;
     int64_t attempt_deadline = std::min(deadline_ms, now_ms() + slice);
-    int fd = tcp_connect_retry(host, port,
-                               std::min<int64_t>(slice, opts_.connect_timeout_ms));
-    if (fd >= 0) {
+    if (split_host_port(addr, &host, &port)) {
+      fd = tcp_connect_retry(host, port,
+                             std::min<int64_t>(slice, opts_.connect_timeout_ms));
+    }
+    if (fd < 0) {
+      unreachable += 1;
+      lh_unreachable_retries_.fetch_add(1);
+    } else {
       Json req = Json::object();
       req["type"] = Json::of("quorum");
       req["timeout_ms"] = Json::of(attempt_deadline - now_ms());
@@ -239,12 +369,54 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(
       Json resp;
       bool ok = call_json(fd, req, &resp, attempt_deadline - now_ms());
       close(fd);
-      if (ok && resp.get("ok").as_bool()) {
-        return Quorum::from_json(resp.get("quorum"));
+      if (!ok) {
+        // Torn mid-RPC (connection reset / partition): same bucket as
+        // unreachable — retry, don't latch.
+        unreachable += 1;
+        lh_unreachable_retries_.fetch_add(1);
+      } else if (!resp.get("ok").as_bool()) {
+        denied = resp.get("error").as_str("quorum denied");
+      } else {
+        Quorum q = Quorum::from_json(resp.get("quorum"));
+        int64_t fence = lh_epoch_.load();
+        if (q.epoch < fence) {
+          // Split-brain fence: a resurrected stale primary can answer
+          // quorums, but its epoch is below what the fleet has already
+          // accepted from the takeover. Never deliver it to the trainer.
+          lh_stale_rejected_.fetch_add(1);
+          denied = "stale quorum fenced: epoch " + std::to_string(q.epoch) +
+                   " < " + std::to_string(fence) + " (from " + addr + ")";
+          fprintf(stderr, "[manager %s] %s\n", opts_.replica_id.c_str(),
+                  denied.c_str());
+        } else {
+          while (q.epoch > fence &&
+                 !lh_epoch_.compare_exchange_weak(fence, q.epoch)) {
+          }
+          int64_t qid = lh_quorum_id_.load();
+          while (q.quorum_id > qid &&
+                 !lh_quorum_id_.compare_exchange_weak(qid, q.quorum_id)) {
+          }
+          return q;
+        }
       }
     }
     if (now_ms() >= deadline_ms) break;
-    if (a + 1 < attempts) sleep_ms(std::min<int64_t>(100, deadline_ms - now_ms()));
+    if (a + 1 < attempts) {
+      // Seeded full-jitter between attempts (chaos.backoff_jitter's C++
+      // twin, keyed per replica so retries across the fleet decorrelate).
+      double unit = chaos::backoff_unit(
+          opts_.replica_id + "|lh_quorum|" + addr, static_cast<uint64_t>(a + 1));
+      int64_t cap = std::min<int64_t>(1000, deadline_ms - now_ms());
+      sleep_ms(std::max<int64_t>(10, static_cast<int64_t>(unit * cap)));
+    }
+  }
+  if (error) {
+    if (!denied.empty()) {
+      *error = "lighthouse quorum denied: " + denied;
+    } else {
+      *error = "lighthouse unreachable after " + std::to_string(unreachable) +
+               " attempts (last: " + last_addr + ")";
+    }
   }
   return std::nullopt;
 }
@@ -262,19 +434,30 @@ bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
   draining_ = true;
   if (left_sent_) return true;
   bool sent = false;
-  std::string host;
-  int port = 0;
-  if (split_host_port(opts_.lighthouse_addr, &host, &port)) {
-    // One budget for the WHOLE attempt (connect + RPC): the parent-death
-    // watchdog passes a small budget so an unreachable lighthouse
-    // (whole-machine / partition loss, where the leave is moot anyway)
-    // can't hold the orphaned binary alive — a slow connect must not let
-    // the RPC wait spend the full budget again on top.
-    int64_t deadline = now_ms() + budget_ms;
-    int fd = tcp_connect(host, port,
-                         std::min<int64_t>(budget_ms, opts_.connect_timeout_ms));
+  // One budget for the WHOLE attempt (connect + RPC, across however many
+  // list entries we manage to try): the parent-death watchdog passes a
+  // small budget so an unreachable lighthouse (whole-machine / partition
+  // loss, where the leave is moot anyway) can't hold the orphaned binary
+  // alive — a slow connect must not let the RPC wait spend the full budget
+  // again on top. Starting at the ACTIVE entry (and walking down the list
+  // on failure) covers a drain racing a failover: the leave must land on
+  // whichever lighthouse will form the survivors' next quorum.
+  int64_t deadline = now_ms() + budget_ms;
+  const size_t n = lh_addrs_.size();
+  const int start = lh_active_.load() % static_cast<int>(n);
+  for (size_t k = 0; k < n && !sent; k++) {
+    const std::string& addr = lh_addrs_[(start + k) % n];
+    std::string host;
+    int port = 0;
+    if (!split_host_port(addr, &host, &port)) continue;
+    int64_t remaining = deadline - now_ms();
+    if (remaining < 100 && k > 0) break;
+    int fd = tcp_connect(
+        host, port,
+        std::max<int64_t>(100, std::min<int64_t>(
+                                   remaining, opts_.connect_timeout_ms)));
     if (fd >= 0) {
-      int64_t remaining = std::max<int64_t>(200, deadline - now_ms());
+      remaining = std::max<int64_t>(200, deadline - now_ms());
       Json lv = Json::object();
       lv["type"] = Json::of("leave");
       lv["replica_id"] = Json::of(opts_.replica_id);
@@ -338,14 +521,17 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
       me.commit_failures = std::max(me.commit_failures, kv.second.commit_failures);
     }
     lk.unlock();
-    auto q = lighthouse_quorum(me, deadline_ms, trace_id);
+    std::string lherr;
+    auto q = lighthouse_quorum(me, deadline_ms, trace_id, &lherr);
     lk.lock();
     if (q) {
       current_quorum_ = q;
       quorum_error_.clear();
     } else {
       current_quorum_.reset();
-      quorum_error_ = "lighthouse quorum failed (timeout or unreachable)";
+      quorum_error_ = lherr.empty()
+                          ? "lighthouse quorum failed (timeout or unreachable)"
+                          : lherr;
     }
     quorum_round_ += 1;
     participants_.clear();
@@ -372,6 +558,7 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
     resp["ok"] = Json::of(false);
     resp["error"] = Json::of(
         quorum_error_.empty() ? "no quorum delivered" : quorum_error_);
+    resp["lh"] = lh_info_json();
     return resp;
   }
   std::string err;
@@ -386,6 +573,9 @@ Json ManagerServer::quorum_rpc(const Json& req, int64_t deadline_ms) {
   resp["result"] = result->to_json();
   resp["quorum"] = current_quorum_->to_json();
   resp["drain_requested"] = Json::of(drain_requested_.load());
+  // HA telemetry: epoch/failover/retry counters so the Python Manager can
+  // journal lh_epoch / lh_failover / rpc_retry transitions per step.
+  resp["lh"] = lh_info_json();
   return resp;
 }
 

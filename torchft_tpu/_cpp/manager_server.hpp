@@ -44,7 +44,11 @@ namespace tft {
 
 struct ManagerOpts {
   std::string replica_id;
-  std::string lighthouse_addr;     // host:port
+  // Ordered comma list "host:port[,host:port...]": first entry is the
+  // primary lighthouse, the rest are warm standbys. Managers heartbeat every
+  // entry (standbys stay warm, read-only) and fail over down the list when
+  // the active entry's lease lapses.
+  std::string lighthouse_addr;
   std::string advertise_host;      // host other processes can reach us at
   int port = 0;                    // 0 = ephemeral
   std::string bind_host;           // default 0.0.0.0
@@ -53,6 +57,10 @@ struct ManagerOpts {
   int64_t heartbeat_interval_ms = 100;
   int64_t connect_timeout_ms = 10000;
   int64_t quorum_retries = 0;
+  // Lease on the active lighthouse: no successful heartbeat ack for this
+  // long => deterministically advance to the next address in the list
+  // (TORCHFT_LH_LEASE_MS / --lh-lease-ms).
+  int64_t lighthouse_lease_ms = 3000;
 };
 
 class ManagerServer {
@@ -82,14 +90,37 @@ class ManagerServer {
   Json handle_request(const Json& req, int64_t deadline_ms);
   Json quorum_rpc(const Json& req, int64_t deadline_ms);
   Json should_commit_rpc(const Json& req, int64_t deadline_ms);
-  // Calls the lighthouse Quorum RPC with retries; returns nullopt on failure.
-  // `trace_id` (may be empty) is forwarded so the lighthouse leg of the
-  // step's control-plane path carries the same correlation id.
+  // Calls the lighthouse Quorum RPC with retries; returns nullopt on failure
+  // with a human-readable reason in *error that distinguishes "lighthouse
+  // unreachable" (connect-level, retried with the shared seeded-jitter
+  // backoff) from "quorum denied" (a live lighthouse said no) from "stale
+  // quorum fenced" (epoch below the fence). `trace_id` (may be empty) is
+  // forwarded so the lighthouse leg of the step's control-plane path carries
+  // the same correlation id.
   std::optional<Quorum> lighthouse_quorum(const QuorumMember& me,
                                           int64_t deadline_ms,
-                                          const std::string& trace_id);
+                                          const std::string& trace_id,
+                                          std::string* error);
+  // HA counters snapshot attached to quorum/info responses so the Python
+  // Manager can journal lh_failover / lh_epoch / rpc_retry events.
+  Json lh_info_json() const;
 
   ManagerOpts opts_;
+  // ---- lighthouse HA state ----
+  // Parsed ordered address list (set in the constructor, then read-only).
+  std::vector<std::string> lh_addrs_;
+  std::atomic<int> lh_active_{0};       // index of the current active target
+  std::atomic<int64_t> lh_failovers_{0};
+  // Max quorum epoch ever accepted: the split-brain fence. Any delivered
+  // quorum with a lower epoch (a resurrected stale primary) is rejected.
+  std::atomic<int64_t> lh_epoch_{0};
+  // Max quorum_id ever accepted; heartbeat-carried so a takeover standby
+  // resumes numbering above it (strict quorum-id monotonicity w/o a
+  // lighthouse-to-lighthouse channel).
+  std::atomic<int64_t> lh_quorum_id_{0};
+  std::atomic<int64_t> lh_stale_rejected_{0};
+  // Connect-level quorum retries absorbed before latching quorum_error_.
+  std::atomic<int64_t> lh_unreachable_retries_{0};
   int port_ = 0;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};

@@ -1,7 +1,14 @@
 #include "quorum.hpp"
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <cstdio>
+#include <fstream>
 #include <set>
+#include <sstream>
 
 namespace tft {
 
@@ -35,6 +42,8 @@ Json Quorum::to_json() const {
   Json j = Json::object();
   j["quorum_id"] = Json::of(quorum_id);
   j["created_ms"] = Json::of(created_ms);
+  j["epoch"] = Json::of(epoch);
+  j["generation"] = Json::of(generation);
   Json parts = Json::array();
   for (const auto& p : participants) parts.push(p.to_json());
   j["participants"] = parts;
@@ -45,6 +54,8 @@ Quorum Quorum::from_json(const Json& j) {
   Quorum q;
   q.quorum_id = j.get("quorum_id").as_int();
   q.created_ms = j.get("created_ms").as_int();
+  q.epoch = j.get("epoch").as_int(0);
+  q.generation = j.get("generation").as_int(0);
   for (const auto& p : j.get("participants").arr)
     q.participants.push_back(QuorumMember::from_json(p));
   return q;
@@ -173,6 +184,62 @@ Json ManagerQuorumResult::to_json() const {
   j["heal"] = Json::of(heal);
   j["commit_failures"] = Json::of(commit_failures);
   return j;
+}
+
+static std::string lh_state_path(const std::string& state_dir) {
+  return state_dir + "/lighthouse_state.json";
+}
+
+bool lh_state_save(const std::string& state_dir, const LighthouseDurable& d) {
+  if (state_dir.empty()) return false;
+  Json j = Json::object();
+  j["schema"] = Json::of(static_cast<int64_t>(1));
+  j["epoch"] = Json::of(d.epoch);
+  j["quorum_id"] = Json::of(d.quorum_id);
+  j["generation"] = Json::of(d.generation);
+  const std::string body = j.dump();
+  // Best-effort single-level mkdir: operators point --state-dir at a fresh
+  // per-instance path (the drill does too), so create it rather than fail.
+  ::mkdir(state_dir.c_str(), 0777);
+  const std::string tmp = lh_state_path(state_dir) + ".tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  size_t off = 0;
+  while (off < body.size()) {
+    ssize_t n = ::write(fd, body.data() + off, body.size() - off);
+    if (n <= 0) {
+      ::close(fd);
+      std::remove(tmp.c_str());
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  // fsync before rename: the snapshot is the fence's source of truth — a
+  // torn write that survives a crash could hand a resurrected lighthouse a
+  // lower epoch than the fleet has already accepted.
+  if (::fsync(fd) != 0 || ::close(fd) != 0) {
+    std::remove(tmp.c_str());
+    return false;
+  }
+  if (std::rename(tmp.c_str(), lh_state_path(state_dir).c_str()) != 0) {
+    std::remove(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
+bool lh_state_load(const std::string& state_dir, LighthouseDurable* d) {
+  if (state_dir.empty() || d == nullptr) return false;
+  std::ifstream f(lh_state_path(state_dir));
+  if (!f) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  Json j;
+  if (!Json::parse(ss.str(), &j)) return false;
+  d->epoch = j.get("epoch").as_int(0);
+  d->quorum_id = j.get("quorum_id").as_int(0);
+  d->generation = j.get("generation").as_int(0);
+  return true;
 }
 
 std::optional<ManagerQuorumResult> compute_quorum_results(

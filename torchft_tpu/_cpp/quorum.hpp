@@ -40,6 +40,15 @@ struct Quorum {
   int64_t quorum_id = 0;
   std::vector<QuorumMember> participants;
   int64_t created_ms = 0;
+  // Fencing epoch of the lighthouse instance that formed this quorum. A
+  // warm-restarted primary keeps its epoch; a standby takeover bumps it, so
+  // managers can reject quorums from a resurrected stale primary by
+  // comparing against the max epoch they have ever accepted.
+  int64_t epoch = 0;
+  // Broadcast counter of the forming lighthouse (monotone across restarts
+  // via the durable snapshot). (epoch, generation) orders every quorum the
+  // fleet has ever seen, even across lighthouse identities.
+  int64_t generation = 0;
 
   Json to_json() const;
   static Quorum from_json(const Json& j);
@@ -56,7 +65,33 @@ struct LighthouseOpts {
   // TORCHFT_FLEET_SNAP_MS / --fleet-snap-ms; direct embedders (tests)
   // default to uncached for read-after-write determinism.
   int64_t fleet_snap_ms = 0;
+  // Durable-state directory. When non-empty the lighthouse persists a tiny
+  // fsync'd snapshot {epoch, quorum_id, generation} and restores it on boot,
+  // so quorum ids and the fencing epoch stay strictly monotone across
+  // restarts. Empty = fully in-memory (the pre-HA behavior).
+  std::string state_dir;
+  // Boot as a warm standby: absorb heartbeats (keeping fleet/participant
+  // tables warm) but do not form or serve quorums until the first quorum
+  // request arrives — managers only send quorum RPCs to their active
+  // target, so a request here means the fleet failed over to us and we take
+  // over with epoch = max(observed) + 1.
+  bool standby = false;
 };
+
+// Durable lighthouse snapshot: the only state that must survive a restart.
+// Participant/fleet tables are rebuilt from the live heartbeat stream.
+struct LighthouseDurable {
+  int64_t epoch = 0;
+  int64_t quorum_id = 0;
+  int64_t generation = 0;
+};
+
+// Atomic (tmp + fsync + rename) snapshot save/load under state_dir. Load
+// returns false when no snapshot exists or it cannot be parsed; save returns
+// false on I/O failure. Pure file-format helpers, unit-tested in
+// cpp_tests.cc; the threading/ownership policy lives in lighthouse.cc.
+bool lh_state_save(const std::string& state_dir, const LighthouseDurable& d);
+bool lh_state_load(const std::string& state_dir, LighthouseDurable* d);
 
 // Mutable lighthouse state operated on by the tick loop.
 struct LighthouseState {

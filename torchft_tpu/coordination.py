@@ -139,6 +139,14 @@ class Quorum:
     quorum_id: int
     participants: List[QuorumMember]
     created_ms: int = 0
+    # Fencing epoch of the lighthouse that formed this quorum: bumped only on
+    # standby takeover, so a resurrected stale primary's quorums carry a lower
+    # epoch and are rejected manager-side (split-brain fence). 0 = pre-HA.
+    epoch: int = 0
+    # Quorum-generation counter, strictly monotone across lighthouse restarts
+    # (persisted with reserve headroom). (epoch, generation) totally orders
+    # every quorum the control plane ever delivered.
+    generation: int = 0
 
     @staticmethod
     def from_json(j: Dict[str, Any]) -> "Quorum":
@@ -148,6 +156,8 @@ class Quorum:
                 QuorumMember.from_json(p) for p in j.get("participants", [])
             ],
             created_ms=j.get("created_ms", 0),
+            epoch=j.get("epoch", 0),
+            generation=j.get("generation", 0),
         )
 
 
@@ -174,6 +184,11 @@ class QuorumResult:
     # Manager.leave(), and exit 0. Piggybacked on the quorum response — no
     # extra RPC per step.
     drain_requested: bool = False
+    # Lighthouse-HA counters snapshot from the manager server ("lh" on the
+    # quorum response): active index/addr, failovers, max accepted epoch,
+    # stale_rejected, unreachable_retries. The Manager diffs consecutive
+    # snapshots to journal lh_failover / lh_epoch / rpc_retry events.
+    lh: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(j: Dict[str, Any], quorum: Optional[Quorum] = None) -> "QuorumResult":
@@ -498,6 +513,8 @@ class LighthouseServer:
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
         fleet_snap_ms: Optional[int] = None,
+        state_dir: Optional[str] = None,
+        standby: bool = False,
     ) -> None:
         host, port = _split_bind(bind)
         argv = [
@@ -521,6 +538,15 @@ class LighthouseServer:
             # payload on every request (read-after-write determinism, the
             # "before" mode the fleet_load harness benchmarks against).
             argv += ["--fleet-snap-ms", str(fleet_snap_ms)]
+        if state_dir:
+            # Durable epoch/quorum-id snapshot dir: survives crash/restart so
+            # quorum ids stay strictly monotone (see docs/FAULT_MODEL.md,
+            # control plane). None = pre-HA volatile behavior.
+            argv += ["--state-dir", str(state_dir)]
+        if standby:
+            # Warm standby: absorbs heartbeats read-only, takes over with a
+            # bumped fencing epoch when the first quorum request arrives.
+            argv += ["--standby"]
         self._server = _ServerProcess(argv, "lighthouse")
 
     def address(self) -> str:
@@ -542,10 +568,14 @@ class LighthouseClient:
         timeout: float = 5.0,
         digest: Optional[Dict[str, Any]] = None,
         hb_interval_ms: int = 0,
+        epoch: int = 0,
     ) -> None:
         """One heartbeat, optionally carrying a :class:`~torchft_tpu.
         telemetry.StepDigest` wire dict (``StepDigest.to_wire()``) plus
-        the sender's nominal heartbeat interval. Old lighthouses read only
+        the sender's nominal heartbeat interval and the max quorum epoch
+        the sender has accepted (how standbys and resurrected stale
+        primaries learn the fleet's current owner — there is no
+        lighthouse-to-lighthouse channel). Old lighthouses read only
         the keys they know, so the extra fields are silently dropped —
         a new client never breaks an old fleet."""
         req: Dict[str, Any] = {
@@ -556,6 +586,8 @@ class LighthouseClient:
             req["digest"] = digest
         if hb_interval_ms > 0:
             req["hb_interval_ms"] = int(hb_interval_ms)
+        if epoch > 0:
+            req["epoch"] = int(epoch)
         self._client.call(req, timeout)
 
     def fleet(self, timeout: float = 5.0) -> Dict[str, Any]:
@@ -660,7 +692,13 @@ class LighthouseClient:
 
 class ManagerServer:
     """Spawns the per-replica-group C++ manager server (reference:
-    ManagerServer, lib.rs:80-144 / src/manager.rs:118-174)."""
+    ManagerServer, lib.rs:80-144 / src/manager.rs:118-174).
+
+    ``lighthouse_addr`` may be an ordered comma list
+    ``host:port[,host:port...]``: the first entry is the primary
+    lighthouse, the rest warm standbys. The server heartbeats every entry
+    and fails over down the list when the active entry's lease lapses
+    (``lighthouse_lease_ms`` / TORCHFT_LH_LEASE_MS)."""
 
     def __init__(
         self,
@@ -672,35 +710,39 @@ class ManagerServer:
         heartbeat_interval_ms: int = 100,
         connect_timeout_ms: int = 10000,
         quorum_retries: int = 0,
+        lighthouse_lease_ms: Optional[int] = None,
     ) -> None:
         host, port = _split_bind(bind)
         self.replica_id = replica_id
-        self._server = _ServerProcess(
-            [
-                str(_BIN_DIR / "torchft_manager"),
-                "--replica-id",
-                replica_id,
-                "--lighthouse",
-                lighthouse_addr,
-                "--advertise-host",
-                advertise_host(),
-                "--bind-host",
-                host,
-                "--port",
-                str(port),
-                "--store-address",
-                store_address,
-                "--world-size",
-                str(world_size),
-                "--heartbeat-interval-ms",
-                str(heartbeat_interval_ms),
-                "--connect-timeout-ms",
-                str(connect_timeout_ms),
-                "--quorum-retries",
-                str(quorum_retries),
-            ],
-            f"manager[{replica_id}]",
-        )
+        argv = [
+            str(_BIN_DIR / "torchft_manager"),
+            "--replica-id",
+            replica_id,
+            "--lighthouse",
+            lighthouse_addr,
+            "--advertise-host",
+            advertise_host(),
+            "--bind-host",
+            host,
+            "--port",
+            str(port),
+            "--store-address",
+            store_address,
+            "--world-size",
+            str(world_size),
+            "--heartbeat-interval-ms",
+            str(heartbeat_interval_ms),
+            "--connect-timeout-ms",
+            str(connect_timeout_ms),
+            "--quorum-retries",
+            str(quorum_retries),
+        ]
+        if lighthouse_lease_ms is not None:
+            # Active-lighthouse lease before failing over down the comma
+            # list in lighthouse_addr. None defers to the binary's default
+            # (3000 ms, or TORCHFT_LH_LEASE_MS).
+            argv += ["--lh-lease-ms", str(lighthouse_lease_ms)]
+        self._server = _ServerProcess(argv, f"manager[{replica_id}]")
 
     def address(self) -> str:
         return f"{advertise_host()}:{self._server.port}"
@@ -761,6 +803,7 @@ class ManagerClient:
         quorum = Quorum.from_json(resp["quorum"]) if "quorum" in resp else None
         result = QuorumResult.from_json(resp["result"], quorum)
         result.drain_requested = bool(resp.get("drain_requested", False))
+        result.lh = dict(resp.get("lh") or {})
         return result
 
     def drain_status(self, timeout: float = 2.0) -> bool:

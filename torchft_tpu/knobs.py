@@ -168,7 +168,21 @@ _ALL = [
         "TORCHFT_LIGHTHOUSE",
         "str",
         None,
-        "Lighthouse address `host:port`; required by Manager when no address argument is given, optional default for obs tools.",
+        "Lighthouse address list `host:port[,host:port...]` (first entry = primary, rest = warm standbys, failover in order); required by Manager when no address argument is given, optional default for obs tools.",
+    ),
+    _k(
+        "TORCHFT_LH_LEASE_MS",
+        "int",
+        "3000",
+        "Manager's lease on the active lighthouse: no heartbeat ack for this long fails over to the next address in the TORCHFT_LIGHTHOUSE list.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_STATE_DIR",
+        "str",
+        None,
+        "Lighthouse durable-state directory (fsync'd epoch/quorum-id snapshot, survives crash/restart so quorum ids stay monotone); unset = volatile pre-HA behavior.",
+        scope="cpp",
     ),
     _k(
         "TORCHFT_TIMEOUT_SEC",

@@ -783,7 +783,7 @@ FLEET_ROW_KEYS = {
 FLEET_AGG_KEYS = {
     "n", "n_digest", "stragglers", "median_rate", "median_step",
     "median_goodput", "max_commit_failures", "anomalies_dropped",
-    "quorum_world", "joins_total", "leaves_total",
+    "quorum_world", "joins_total", "leaves_total", "epoch",
 }
 
 # Consumer read sites: variable name -> which key level it addresses.

@@ -185,6 +185,10 @@ class Manager:
         # One-shot latch: the first healing quorum of a mid-run start is a
         # deliberate elastic join (journaled once as elastic_join).
         self._elastic_join_emitted = False
+        # Last-seen lighthouse-HA counters from the manager server's "lh"
+        # snapshot on quorum responses: diffed each quorum to journal
+        # lh_failover / lh_epoch / rpc_retry exactly once per change.
+        self._lh_last: Dict[str, int] = {}
         # Drain-abort of a blocked sync quorum (see abort_pending_quorum):
         # _quorum_rpc_pending brackets the client RPC so the abort only
         # fires into a live (or imminent) wait.
@@ -422,6 +426,46 @@ class Manager:
                 **attrs,
             )
 
+    def _journal_lh_transitions(self, lh: Dict[str, Any]) -> None:
+        """Diffs the manager server's lighthouse-HA counters against the
+        last quorum's snapshot and journals each transition once:
+        ``lh_failover`` (active target advanced down the list),
+        ``lh_epoch`` (a new fencing epoch was accepted — takeover), and
+        ``rpc_retry`` (connect-level quorum retries absorbed by the
+        seeded-jitter backoff before the round succeeded or latched)."""
+        if not lh:
+            return
+        prev = self._lh_last
+        failovers = int(lh.get("failovers", 0))
+        if failovers > prev.get("failovers", 0):
+            self._journal(
+                "lh_failover",
+                failovers=failovers,
+                lh_active=int(lh.get("active", 0)),
+                lh_addr=str(lh.get("addr", "")),
+            )
+        epoch = int(lh.get("epoch", 0))
+        if epoch > prev.get("epoch", 0):
+            self._journal(
+                "lh_epoch",
+                epoch=epoch,
+                prev_epoch=prev.get("epoch", 0),
+                lh_addr=str(lh.get("addr", "")),
+            )
+        retries = int(lh.get("unreachable_retries", 0))
+        if retries > prev.get("unreachable_retries", 0):
+            self._journal(
+                "rpc_retry",
+                rpc="lighthouse_quorum",
+                retries=retries - prev.get("unreachable_retries", 0),
+                total_retries=retries,
+            )
+        self._lh_last = {
+            "failovers": failovers,
+            "epoch": epoch,
+            "unreachable_retries": retries,
+        }
+
     def start_quorum(
         self,
         allow_heal: bool = True,
@@ -535,6 +579,7 @@ class Manager:
                 set_trace(self._trace_id)
             except Exception:  # noqa: BLE001 - tracing must never fail a step
                 pass
+        lh = getattr(result, "lh", None) or {}
         self._journal(
             "quorum_ready",
             quorum_id=result.quorum_id,
@@ -543,7 +588,11 @@ class Manager:
             max_step=result.max_step,
             heal=bool(heal),
             elapsed_s=time.monotonic() - t_quorum0,
+            # Fencing epoch of the lighthouse that formed this quorum: the
+            # drill's exactly-one-epoch-owner assertion joins on this.
+            epoch=int(lh.get("epoch", 0)),
         )
+        self._journal_lh_transitions(lh)
         # Operator-initiated drain flag (latched: a one-shot observation
         # must not be lost if a later quorum response races the trainer's
         # loop-top check).

@@ -458,6 +458,11 @@ EVENT_KINDS: Dict[str, str] = {
                     "scale-up; healed in via checkpoint transport)",
     "elastic_leave": "replica group left the quorum gracefully (drain/"
                      "preemption; step committed, peers unpoisoned)",
+    # -- control-plane HA (manager.py) ----------------------------------
+    "lh_failover": "manager advanced to the next lighthouse in the list "
+                   "(active entry's heartbeat lease lapsed)",
+    "lh_epoch": "a quorum carrying a new fencing epoch was accepted "
+                "(standby takeover observed; stale primaries now fenced)",
 }
 
 

@@ -128,6 +128,12 @@ def main() -> int:
     )
     parser.add_argument("--durable-every", type=int, default=50)
     parser.add_argument(
+        "--step-min-s", type=float, default=0.0,
+        help="minimum wall seconds per step (drill pacing: a CPU toy "
+        "step runs in ~ms, too fast for lease-based control-plane "
+        "failure windows to land mid-run; 0 = full speed)",
+    )
+    parser.add_argument(
         "--world-size-mode",
         choices=("dynamic", "fixed_with_spares"),
         default="dynamic",
@@ -330,6 +336,8 @@ def main() -> int:
             # Pass the factory, not the state: durable_state() is a full
             # device->host materialization, built only on cadence steps.
             ckpt.on_commit(manager.current_step(), durable_state)
+        if args.step_min_s > 0:
+            time.sleep(max(0.0, args.step_min_s - (time.time() - t_step0)))
 
     if ckpt is not None:
         ckpt.close()
