@@ -503,3 +503,147 @@ def test_hb_jitter_flags_closed_gap():
         client.close()
     finally:
         server.shutdown()
+
+# ---------------------------------------------------------------------------
+# Job namespaces: isolation, wire back-compat, per-job snapshot scoping
+# ---------------------------------------------------------------------------
+
+
+def test_job_namespace_isolation(lighthouse) -> None:
+    """Churn (quorums, leaves, anomalies) in one job namespace must not
+    move a sibling namespace's quorum generation, fleet generation, or
+    anomaly ring — the hard-isolation contract multi-tenancy rests on."""
+    c = LighthouseClient(lighthouse.address())
+    # Settle both islands: a quorum in each, digests in each fleet table.
+    for rid in ("a0", "a1"):
+        c.heartbeat(rid, digest=_dg(5, 1.0), hb_interval_ms=60000,
+                    job="alpha")
+    for rid in ("b0", "b1"):
+        c.heartbeat(rid, digest=_dg(5, 1.0), hb_interval_ms=60000,
+                    job="beta")
+
+    def form(job, rids):
+        # One client + thread per replica: all must block in the same
+        # quorum round for the namespace to form its full-world quorum.
+        out = {}
+        clients = [LighthouseClient(lighthouse.address()) for _ in rids]
+        threads = [
+            threading.Thread(
+                target=lambda cl=cl, r=r: out.setdefault(
+                    r, cl.quorum(r, timeout=10.0, step=1, job=job)))
+            for cl, r in zip(clients, rids)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for cl in clients:
+            cl.close()
+        return out
+
+    qa = form("alpha", ["a0", "a1"])
+    qb = form("beta", ["b0", "b1"])
+    assert sorted(m.replica_id for m in qa["a0"].participants) == ["a0", "a1"]
+    assert qa["a0"].job == "alpha"
+    assert qb["b0"].job == "beta"
+
+    status = c.status()
+    before = status["jobs"]["beta"]
+    # Storm alpha: a graceful leave (quorum transition + churn counters)
+    # plus a commit-failure streak (commit_stall anomaly).
+    c.leave("a1", job="alpha")
+    c.heartbeat("a0", digest=_dg(6, 1.0, cf=5), hb_interval_ms=60000,
+                job="alpha")
+    after = c.status()["jobs"]["beta"]
+    for key in ("quorum_id", "quorum_generation", "joins_total",
+                "leaves_total"):
+        assert after[key] == before[key], (key, before, after)
+    assert after["fleet"]["anomaly_seq"] == before["fleet"]["anomaly_seq"]
+    # Alpha's island did move, and its anomaly carries its own job tag.
+    alpha = c.status()["jobs"]["alpha"]
+    assert alpha["joins_total"] >= 2  # both members joined its formation
+    assert alpha["fleet"]["anomaly_seq"] >= 1
+    a_fleet = c.fleet(job="alpha")
+    assert a_fleet["job"] == "alpha"
+    assert any(a["kind"] == "commit_stall" for a in a_fleet["anomalies"])
+    # Per-job fleet payloads never leak sibling rows.
+    assert set(a_fleet["replicas"]) == {"a0"}
+    assert set(c.fleet(job="beta")["replicas"]) == {"b0", "b1"}
+    c.close()
+
+
+def test_job_wire_backcompat_default_namespace(lighthouse) -> None:
+    """Frames without a ``job`` key (pre-namespace clients) must land in
+    the default island, and the composite fleet payload must keep the
+    legacy top-level schema those clients already parse."""
+    from torchft_tpu.coordination import Quorum
+
+    c = LighthouseClient(lighthouse.address())
+    c.heartbeat("old-style", digest=_dg(3, 1.0), hb_interval_ms=60000)
+    fleet = c.fleet()  # no job key on the request either
+    assert "old-style" in fleet["replicas"]
+    assert fleet["job"] == "default"
+    # Legacy readers' keys survive on the composite payload...
+    for key in ("ts_ms", "gen", "replicas", "agg", "anomalies",
+                "anomaly_seq"):
+        assert key in fleet, key
+    # ...which additionally carries the namespace + federation maps.
+    assert "default" in fleet["jobs"]
+    assert "districts" in fleet
+    # Job-tagged traffic round-trips its namespace on the quorum frame;
+    # an un-tagged quorum JSON decodes as the default namespace.
+    assert Quorum.from_json({"quorum_id": 1, "participants": [],
+                             "created_ms": 0}).job == "default"
+    q = json.loads(json.dumps({"quorum_id": 1, "participants": [],
+                               "created_ms": 0, "job": "alpha"}))
+    assert Quorum.from_json(q).job == "alpha"
+    # HTTP twin: ?job= scopes, bare stays composite.
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/fleet.json?job=alpha", timeout=5
+    ) as resp:
+        scoped = json.loads(resp.read())
+    assert scoped["job"] == "alpha"
+    assert "old-style" not in scoped["replicas"]
+    c.close()
+
+
+def test_manager_job_knob_scopes_namespace(monkeypatch) -> None:
+    """A manager's job namespace — via the --job flag or the TORCHFT_JOB
+    env knob inherited by the C++ binary — routes its heartbeats and
+    quorums into that island: two single-replica jobs each form their own
+    world without ever seeing each other."""
+    server = LighthouseServer(
+        min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
+    )
+    try:
+        ma = ManagerServer(
+            replica_id="m-a", lighthouse_addr=server.address(),
+            store_address="store:1", world_size=1,
+            heartbeat_interval_ms=50, job="tenant-a",
+        )
+        # Env-knob path: the spawned binary reads TORCHFT_JOB itself.
+        monkeypatch.setenv("TORCHFT_JOB", "tenant-b")
+        mb = ManagerServer(
+            replica_id="m-b", lighthouse_addr=server.address(),
+            store_address="store:2", world_size=1,
+            heartbeat_interval_ms=50,
+        )
+        try:
+            ca = ManagerClient(ma.address())
+            cb = ManagerClient(mb.address())
+            ra = ca._quorum(group_rank=0, step=0, checkpoint_metadata="",
+                            shrink_only=False, timeout=10.0)
+            rb = cb._quorum(group_rank=0, step=0, checkpoint_metadata="",
+                            shrink_only=False, timeout=10.0)
+            assert [m.replica_id for m in ra.quorum.participants] == ["m-a"]
+            assert [m.replica_id for m in rb.quorum.participants] == ["m-b"]
+            assert ra.quorum.job == "tenant-a"
+            assert rb.quorum.job == "tenant-b"
+            ca.close()
+            cb.close()
+        finally:
+            ma.shutdown()
+            mb.shutdown()
+    finally:
+        server.shutdown()

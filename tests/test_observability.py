@@ -456,21 +456,37 @@ def _fake_fleet():
 
 
 def test_render_fleet_prometheus_gauges():
+    # Every fleet series carries the payload's job namespace as a label
+    # (a fleet without a "job" key — an old lighthouse — is "default").
     text = obs_export.render_fleet_prometheus(_fake_fleet())
-    assert "torchft_exporter_fleet_replicas 2" in text
-    assert "torchft_exporter_fleet_stragglers 1" in text
-    assert "torchft_exporter_fleet_anomalies_total 3" in text
-    assert "torchft_exporter_fleet_median_step_rate 1.5" in text
-    assert 'torchft_exporter_replica_straggler{replica="a"} 1' in text
-    assert 'torchft_exporter_replica_straggler{replica="b"} 0' in text
-    assert ('torchft_exporter_replica_anomaly{replica="a",'
+    assert 'torchft_exporter_fleet_replicas{job="default"} 2' in text
+    assert 'torchft_exporter_fleet_stragglers{job="default"} 1' in text
+    assert 'torchft_exporter_fleet_anomalies_total{job="default"} 3' in text
+    assert ('torchft_exporter_fleet_median_step_rate{job="default"} 1.5'
+            in text)
+    assert ('torchft_exporter_replica_straggler{job="default",'
+            'replica="a"} 1') in text
+    assert ('torchft_exporter_replica_straggler{job="default",'
+            'replica="b"} 0') in text
+    assert ('torchft_exporter_replica_anomaly{job="default",replica="a",'
             'kind="hb_jitter"} 1') in text
-    assert 'torchft_exporter_replica_step_rate{replica="a"} 1.5' in text
-    assert 'torchft_exporter_replica_commit_failures{replica="a"} 4' in text
+    assert ('torchft_exporter_replica_step_rate{job="default",'
+            'replica="a"} 1.5') in text
+    assert ('torchft_exporter_replica_commit_failures{job="default",'
+            'replica="a"} 4') in text
     # Digest-less replica renders no rate/goodput sample, but keeps the
     # cf gauge at zero (absence of evidence, not a gap in the series).
-    assert 'torchft_exporter_replica_step_rate{replica="b"}' not in text
-    assert 'torchft_exporter_replica_commit_failures{replica="b"} 0' in text
+    assert 'torchft_exporter_replica_step_rate{job="default",replica="b"}' \
+        not in text
+    assert ('torchft_exporter_replica_commit_failures{job="default",'
+            'replica="b"} 0') in text
+    # A namespaced payload stamps its own job on the same series.
+    scoped = _fake_fleet()
+    scoped["job"] = "tenant-a"
+    text = obs_export.render_fleet_prometheus(scoped)
+    assert 'torchft_exporter_fleet_replicas{job="tenant-a"} 2' in text
+    assert ('torchft_exporter_replica_straggler{job="tenant-a",'
+            'replica="a"} 1') in text
 
 
 def test_journal_anomalies_cursor_dedup(tmp_path):
@@ -628,21 +644,112 @@ def test_obs_export_caps_replica_label_cardinality():
     fleet = _synthetic_fleet(200)
     text = obs_export.render_fleet_prometheus(fleet, max_replicas=64)
     # Aggregates always present.
-    assert "torchft_exporter_fleet_replicas 200" in text
-    assert "torchft_exporter_fleet_anomalies_dropped 0" in text
+    assert 'torchft_exporter_fleet_replicas{job="default"} 200' in text
+    assert ('torchft_exporter_fleet_anomalies_dropped{job="default"} 0'
+            in text)
     # Per-replica series survive only for rows a pager would fire on.
-    assert 'torchft_exporter_replica_straggler{replica="w0007"} 1' in text
-    assert ('torchft_exporter_replica_anomaly{replica="w0007",'
-            'kind="commit_stall"} 1') in text
+    assert ('torchft_exporter_replica_straggler{job="default",'
+            'replica="w0007"} 1') in text
+    assert ('torchft_exporter_replica_anomaly{job="default",'
+            'replica="w0007",kind="commit_stall"} 1') in text
     assert 'replica="w0150"' not in text
     shown = sum(1 for r in fleet["replicas"].values()
                 if r["straggler"] or r["flags"])
-    assert (f"torchft_exporter_replicas_suppressed {200 - shown}"
-            in text)
+    assert (f'torchft_exporter_replicas_suppressed{{job="default"}} '
+            f"{200 - shown}" in text)
     # Under the cap nothing is suppressed.
     text = obs_export.render_fleet_prometheus(fleet, max_replicas=200)
-    assert "torchft_exporter_replicas_suppressed 0" in text
+    assert 'torchft_exporter_replicas_suppressed{job="default"} 0' in text
     assert 'replica="w0150"' in text
+
+
+def _composite_fleet():
+    """A composite (no ?job= filter) payload: default job's table plus the
+    cross-job summary map and the root's district table."""
+    fleet = _fake_fleet()
+    fleet["job"] = "default"
+    fleet["jobs"] = {
+        "default": {"n": 2, "quorum_world": 2, "stragglers": 1,
+                    "median_rate": 1.5, "anomaly_seq": 3},
+        "tenant-a": {"n": 4, "quorum_world": 4, "stragglers": 0,
+                     "median_rate": 2.0, "anomaly_seq": 0},
+        "tenant-b": {"n": 8, "quorum_world": 7, "stragglers": 2,
+                     "median_rate": 0.5, "anomaly_seq": 9},
+    }
+    fleet["districts"] = {
+        "d0": {"age_ms": 120, "epoch": 2, "hb_count": 40, "failovers": 1,
+               "stale_dropped": 3, "lost": False,
+               "jobs": {"tenant-a": {"n": 4}}},
+        "d1": {"age_ms": 9000, "epoch": 1, "hb_count": 7, "failovers": 0,
+               "stale_dropped": 0, "lost": True,
+               "jobs": {"tenant-b": {"n": 8}}},
+    }
+    return fleet
+
+
+def test_obs_export_job_rollup_gauges_and_cap():
+    fleet = _composite_fleet()
+    text = obs_export.render_fleet_prometheus(fleet, max_replicas=64)
+    assert 'torchft_exporter_job_replicas{job="tenant-a"} 4' in text
+    assert 'torchft_exporter_job_quorum_world{job="tenant-b"} 7' in text
+    assert 'torchft_exporter_job_stragglers{job="tenant-b"} 2' in text
+    assert 'torchft_exporter_job_anomalies_total{job="tenant-b"} 9' in text
+    assert "torchft_exporter_jobs_suppressed 0" in text
+    # District liveness + fencing ride the same composite scrape.
+    assert 'torchft_exporter_district_lost{district="d0"} 0' in text
+    assert 'torchft_exporter_district_lost{district="d1"} 1' in text
+    assert 'torchft_exporter_district_failovers{district="d0"} 1' in text
+    assert 'torchft_exporter_district_stale_dropped{district="d0"} 3' in text
+    # Above the job cap, healthy namespaces collapse; jobs a pager would
+    # fire on (stragglers or anomalies) keep their series.
+    import torchft_tpu.knobs as _knobs
+    orig = _knobs.get_int
+    _knobs.get_int = lambda name: 2 if name == "TORCHFT_EXPORT_MAX_JOBS" \
+        else orig(name)
+    try:
+        capped = obs_export.render_fleet_prometheus(fleet, max_replicas=64)
+    finally:
+        _knobs.get_int = orig
+    assert "torchft_exporter_jobs_suppressed 1" in capped
+    assert 'torchft_exporter_job_replicas{job="tenant-b"} 8' in capped
+    assert 'torchft_exporter_job_replicas{job="tenant-a"}' not in capped
+
+
+def test_obs_top_job_and_district_rollups():
+    import obs_top
+
+    fleet = _composite_fleet()
+    frame = obs_top.render(fleet, color=False)
+    assert obs_top.check_frame(fleet, frame) == []
+    # One rollup line per job island, plus the federation table.
+    assert "jobs:" in frame
+    assert any("tenant-b" in ln and "8" in ln for ln in frame.splitlines())
+    assert "districts:" in frame
+    assert any("d1" in ln and "LOST" in ln for ln in frame.splitlines())
+    assert any("d0" in ln and "up" in ln and "failovers=1" in ln
+               for ln in frame.splitlines())
+    # Dropping a job's rollup row or a district row fails the check.
+    no_job = "\n".join(ln for ln in frame.splitlines()
+                       if "tenant-a" not in ln)
+    assert any("tenant-a" in p
+               for p in obs_top.check_frame(fleet, no_job))
+    no_district = "\n".join(ln for ln in frame.splitlines()
+                            if not ln.strip().startswith("d1"))
+    assert any("d1" in p
+               for p in obs_top.check_frame(fleet, no_district))
+
+
+def test_obs_top_job_scoped_header_tag():
+    import obs_top
+
+    fleet = _fake_fleet()
+    fleet["job"] = "tenant-a"
+    frame = obs_top.render(fleet, color=False)
+    assert "job=tenant-a" in frame.splitlines()[0]
+    assert obs_top.check_frame(fleet, frame) == []
+    # The default namespace keeps the pre-namespace header verbatim.
+    fleet["job"] = "default"
+    assert "job=" not in obs_top.render(fleet, color=False).splitlines()[0]
 
 
 def test_obs_export_journals_overflow_rise_edge(tmp_path):

@@ -59,11 +59,24 @@ from torchft_tpu.telemetry import StepDigest  # noqa: E402
 # shared core, N server threads): the budgets are tripwires for O(N)
 # regressions on the hot paths, not performance targets.
 BUDGETS_US = {
-    64: {"heartbeat_p95_us": 100_000, "fleet_json_p95_us": 200_000},
-    256: {"heartbeat_p95_us": 200_000, "fleet_json_p95_us": 300_000},
-    1024: {"heartbeat_p95_us": 400_000, "fleet_json_p95_us": 500_000},
+    64: {"heartbeat_p95_us": 100_000, "fleet_json_p95_us": 200_000,
+         "quorum_formation_ms": 1500},
+    256: {"heartbeat_p95_us": 200_000, "fleet_json_p95_us": 300_000,
+          "quorum_formation_ms": 2000},
+    1024: {"heartbeat_p95_us": 400_000, "fleet_json_p95_us": 500_000,
+           # Half the 4003 ms the pre-incremental (timer-scan) quorum
+           # recorded at this N: the delta-driven gate must fire the
+           # round inline at the last arrival, not wait out tick scans.
+           "quorum_formation_ms": 2000},
 }
 MIN_SPEEDUP = 2.0  # cached vs uncached /fleet.json p95 at the largest N
+
+# Multi-job federation scenario budgets (M jobs x N replicas across a
+# district->root topology). Same philosophy: O(N)-regression tripwires.
+MULTIJOB_BUDGETS = {
+    "formation_p95_ms": 2000,       # per-job quorum formation across M jobs
+    "sibling_hb_p95_us": 400_000,   # sibling hot path DURING a churn storm
+}
 
 _CLK_TCK = os.sysconf("SC_CLK_TCK")
 
@@ -106,14 +119,17 @@ class Conn:
     one costs an append, not a JSON encode — the harness must not spend
     the shared core it is trying to load the lighthouse with."""
 
-    __slots__ = ("sock", "rid", "rid_n", "out", "inbuf", "need", "t0",
-                 "rtts_us", "rounds", "step", "done", "hb_frame",
+    __slots__ = ("sock", "rid", "rid_n", "job", "out", "inbuf", "need",
+                 "t0", "rtts_us", "rounds", "step", "done", "hb_frame",
                  "pending", "next_at")
 
-    def __init__(self, sock: socket.socket, rid_n: int) -> None:
+    def __init__(self, sock: socket.socket, rid_n: int, job: str = "",
+                 hb_interval_ms: int = 1000) -> None:
         self.sock = sock
         self.rid_n = rid_n
-        self.rid = f"synth-{rid_n:05d}"
+        self.job = job
+        self.rid = (f"{job}:synth-{rid_n:05d}" if job
+                    else f"synth-{rid_n:05d}")
         self.out = bytearray()
         self.inbuf = bytearray()
         self.need: Optional[int] = None  # payload bytes still expected
@@ -124,11 +140,14 @@ class Conn:
         self.done = False
         self.pending = False
         self.next_at = 0.0
-        payload = json.dumps({
+        hb: Dict[str, Any] = {
             "type": "heartbeat", "replica_id": self.rid,
-            "timeout_ms": 5000, "hb_interval_ms": 1000,
+            "timeout_ms": 5000, "hb_interval_ms": hb_interval_ms,
             "digest": _mk_digest(self.step, rid_n),
-        }, separators=(",", ":")).encode()
+        }
+        if job:
+            hb["job"] = job
+        payload = json.dumps(hb, separators=(",", ":")).encode()
         self.hb_frame = struct.pack(">I", len(payload)) + payload
 
     def queue(self, obj: Dict[str, Any]) -> None:
@@ -175,9 +194,11 @@ class Conn:
             del self.out[:n]
 
 
-def connect_fleet(addr: str, n: int, batch: int = 64) -> List[Conn]:
+def connect_fleet(addr: str, n: int, batch: int = 64, job: str = "",
+                  hb_interval_ms: int = 1000) -> List[Conn]:
     """N nonblocking connections, batched under the listener's backlog
-    (128) so a 1024-strong fleet doesn't SYN-flood its own lighthouse."""
+    (128) so a 1024-strong fleet doesn't SYN-flood its own lighthouse.
+    ``job`` tags every frame with that namespace (multi-tenant mode)."""
     host, port = _net.parse_addr(addr)
     conns: List[Conn] = []
     for lo in range(0, n, batch):
@@ -191,7 +212,7 @@ def connect_fleet(addr: str, n: int, batch: int = 64) -> List[Conn]:
                 s.connect((host, port))
             except BlockingIOError:
                 pass
-            c = Conn(s, i)
+            c = Conn(s, i, job=job, hb_interval_ms=hb_interval_ms)
             pending[s.fileno()] = c
             sel.register(s, selectors.EVENT_WRITE, c)
         deadline = time.monotonic() + 30
@@ -261,20 +282,32 @@ def heartbeat_phase(conns: List[Conn], rounds: int,
             "p95_us": round(_pct(rtts, 0.95))}
 
 
-def quorum_phase(conns: List[Conn], timeout_s: float = 300.0) -> Dict[str, Any]:
+def quorum_phase(conns: List[Conn], timeout_s: float = 300.0,
+                 stagger_first_s: float = 0.0) -> Dict[str, Any]:
     """All N replicas request one quorum (the lighthouse was started with
-    ``min_replicas=N``); latency is first-send -> own response."""
+    ``min_replicas=N``); latency is first-send -> own response.
+
+    ``stagger_first_s`` flushes ``conns[0]``'s request that long before
+    the rest of the fleet: the elastic-rejoin order, where the joiner
+    registers before the incumbent members re-request. Without it a
+    one-shot round can race the incumbents' prev-member fast path (the
+    joiner would be picked up by the NEXT round — which a one-shot
+    harness never issues)."""
     sel = selectors.DefaultSelector()
-    for c in conns:
+
+    def enqueue(c: Conn) -> None:
         c.rtts_us, c.done = [], False
-        c.queue({
+        req: Dict[str, Any] = {
             "type": "quorum", "timeout_ms": int(timeout_s * 1000),
             "requester": {
                 "replica_id": c.rid, "address": f"addr-{c.rid}",
                 "store_address": "", "step": c.step, "world_size": 1,
                 "shrink_only": False, "commit_failures": 0, "data": {},
             },
-        })
+        }
+        if c.job:
+            req["job"] = c.job
+        c.queue(req)
         sel.register(c.sock, selectors.EVENT_READ | selectors.EVENT_WRITE, c)
 
     def on_frame(c: Conn) -> None:
@@ -282,6 +315,23 @@ def quorum_phase(conns: List[Conn], timeout_s: float = 300.0) -> Dict[str, Any]:
         c.done = True
 
     t0 = time.monotonic()
+    rest = conns
+    if stagger_first_s > 0 and len(conns) > 1:
+        enqueue(conns[0])
+        stop = time.monotonic() + stagger_first_s
+        while time.monotonic() < stop:
+            for key, mask in sel.select(timeout=0.05):
+                c = key.data
+                if mask & selectors.EVENT_WRITE:
+                    c.on_writable()
+                    if not c.out:
+                        sel.modify(c.sock, selectors.EVENT_READ, c)
+                if mask & selectors.EVENT_READ:
+                    for _ in range(c.on_readable()):
+                        on_frame(c)
+        rest = conns[1:]
+    for c in rest:
+        enqueue(c)
     _pump(sel, conns, on_frame, t0 + timeout_s + 30)
     sel.close()
     lat = [v for c in conns for v in c.rtts_us]
@@ -512,6 +562,289 @@ def restart_scenario(n: int, rounds: int) -> Dict[str, Any]:
     return out
 
 
+def roundtrip_phase(conns: List[Conn], mk_frame,
+                    timeout_s: float = 60.0) -> None:
+    """Send one arbitrary frame per connection, wait for every ack."""
+    sel = selectors.DefaultSelector()
+    for c in conns:
+        c.done = False
+        c.queue(mk_frame(c))
+        sel.register(c.sock, selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+
+    def on_frame(c: Conn) -> None:
+        c.done = True
+
+    _pump(sel, conns, on_frame, time.monotonic() + timeout_s)
+    sel.close()
+
+
+def _job_state(status: Dict[str, Any], job: str) -> Dict[str, Any]:
+    """The isolation-relevant slice of one job island's status: every
+    field a sibling's churn storm must leave bit-exact."""
+    j = (status.get("jobs") or {}).get(job) or {}
+    fleet = j.get("fleet") or {}
+    return {
+        "quorum_id": j.get("quorum_id"),
+        "quorum_generation": j.get("quorum_generation"),
+        "joins_total": j.get("joins_total"),
+        "leaves_total": j.get("leaves_total"),
+        "anomaly_seq": fleet.get("anomaly_seq"),
+    }
+
+
+def multijob_scenario(m_jobs: int, n_per_job: int,
+                      seed: int = 1234) -> Dict[str, Any]:
+    """M jobs x N replicas across a district->root lighthouse topology.
+
+    Proves the three namespace-plane contracts in one harness run:
+
+    * **per-job quorum formation** — every job forms its own quorum on a
+      shared district lighthouse; formation p50/p95 across jobs goes into
+      the report (budgeted via MULTIJOB_BUDGETS),
+    * **cross-job isolation** — a seeded churn storm (leave/rejoin bursts)
+      inside one job must leave every sibling job's quorum id/generation,
+      join/leave counters, and anomaly ring bit-exact, while the siblings'
+      heartbeat hot path keeps meeting its latency budget,
+    * **district failover fencing** — a warm standby takes over the storm
+      job's district (PR-15 HA semantics: bumped fencing epoch); the root
+      must record exactly that district's failover and keep its view of
+      the sibling district's jobs untouched, and sibling-district quorums
+      must stay un-wedged.
+
+    Emits ``job_churn`` / ``district_failover`` journal events when a
+    journal is configured (TORCHFT_JOURNAL_FILE / _DIR)."""
+    import random
+    import tempfile
+
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.telemetry import get_event_log
+
+    rng = random.Random(seed)
+    jobs = [f"job{i:02d}" for i in range(m_jobs)]
+    # Jobs alternate across two districts; the storm job (and the HA drill)
+    # live on d0, so d1 is the pure-sibling district.
+    district_of = {job: ("d0" if i % 2 == 0 else "d1")
+                   for i, job in enumerate(jobs)}
+    storm_job = jobs[0]
+    out: Dict[str, Any] = {
+        "m_jobs": m_jobs, "n_per_job": n_per_job, "seed": seed,
+        "districts": sorted(set(district_of.values())),
+        "storm_job": storm_job,
+    }
+    failures: List[str] = []
+
+    mk_opts = dict(min_replicas=n_per_job, join_timeout_ms=120_000,
+                   quorum_tick_ms=50, heartbeat_timeout_ms=120_000,
+                   fleet_snap_ms=100)
+    root = LighthouseServer(min_replicas=1, join_timeout_ms=120_000,
+                            quorum_tick_ms=50, heartbeat_timeout_ms=120_000)
+    d0_state = tempfile.mkdtemp(prefix="tft_lh_d0_")
+    d0 = LighthouseServer(state_dir=d0_state, district="d0",
+                          root_addr=root.address(), **mk_opts)
+    d1 = LighthouseServer(district="d1", root_addr=root.address(),
+                          **mk_opts)
+    d0_standby: Optional[LighthouseServer] = None
+    addr_of = {"d0": d0.address(), "d1": d1.address()}
+    job_conns: Dict[str, List[Conn]] = {}
+    try:
+        # The storm job gets one extra elastic replica so each churn burst
+        # genuinely changes quorum membership (leave/rejoin alternation).
+        for job in jobs:
+            n = n_per_job + (1 if job == storm_job else 0)
+            job_conns[job] = connect_fleet(
+                addr_of[district_of[job]], n, job=job,
+                hb_interval_ms=600_000)
+        all_conns = [c for cs in job_conns.values() for c in cs]
+        out["heartbeat"] = heartbeat_phase(all_conns, rounds=2)
+
+        # Per-job quorum formation on shared, multi-tenant lighthouses.
+        formation_ms: List[float] = []
+        for job in jobs:
+            q = quorum_phase(job_conns[job])
+            formation_ms.append(q["formation_ms"])
+        out["formation_ms_per_job"] = formation_ms
+        out["formation_p50_ms"] = round(_pct(formation_ms, 0.50))
+        out["formation_p95_ms"] = round(_pct(formation_ms, 0.95))
+
+        # Baseline sibling state, then the seeded churn storm in one job.
+        siblings = [j for j in jobs if j != storm_job]
+        clients = {d: LighthouseClient(a) for d, a in addr_of.items()}
+        before = {
+            j: _job_state(clients[district_of[j]].status(), j)
+            for j in siblings
+        }
+        storm = job_conns[storm_job]
+        extra, base = storm[-1], storm[:-1]
+        bursts = 4
+        for burst in range(bursts):
+            if burst % 2 == 0:
+                roundtrip_phase([extra], lambda c: {
+                    "type": "leave", "replica_id": c.rid, "job": c.job,
+                    "timeout_ms": 5000,
+                })
+                members = base
+                stagger = 0.0
+            else:
+                # The elastic replica rejoins: it registers first (the
+                # real elastic-join order), then the incumbents re-request.
+                members = [extra] + base
+                stagger = 0.3
+            for c in members:
+                c.step += 1
+            quorum_phase(members, stagger_first_s=stagger)
+        # Unfenced chaos inside the island: a commit-failure streak flags a
+        # commit_stall anomaly in the STORM job's ring only.
+        victim = rng.choice(base)
+        roundtrip_phase([victim], lambda c: {
+            "type": "heartbeat", "replica_id": c.rid, "job": c.job,
+            "timeout_ms": 5000, "hb_interval_ms": 600_000,
+            "digest": dict(_mk_digest(c.step, c.rid_n), cf=5),
+        })
+        log = get_event_log()
+        if log is not None:
+            log.emit("job_churn", replica_id="fleet_load", job=storm_job,
+                     bursts=bursts, district=district_of[storm_job])
+
+        # Sibling hot path DURING the aftermath of the storm, then the
+        # bit-exact isolation check.
+        sib_conns = [c for j in siblings for c in job_conns[j]]
+        sib_hb = heartbeat_phase(sib_conns, rounds=2)
+        out["sibling_heartbeat"] = sib_hb
+        after = {
+            j: _job_state(clients[district_of[j]].status(), j)
+            for j in siblings
+        }
+        violations = [
+            {"job": j, "before": before[j], "after": after[j]}
+            for j in siblings if before[j] != after[j]
+        ]
+        storm_state = _job_state(
+            clients[district_of[storm_job]].status(), storm_job)
+        out["storm"] = {
+            "bursts": bursts,
+            "quorum_generation": storm_state["quorum_generation"],
+            "anomaly_seq": storm_state["anomaly_seq"],
+        }
+        out["isolation"] = {
+            "siblings": len(siblings),
+            "violations": violations,
+        }
+        if violations:
+            failures.append(
+                f"multijob: {len(violations)} sibling jobs perturbed by "
+                f"{storm_job}'s churn storm")
+        if (storm_state["quorum_generation"] or 0) < bursts:
+            failures.append(
+                f"multijob: storm job generation "
+                f"{storm_state['quorum_generation']} did not advance "
+                f"across {bursts} churn bursts")
+        if not storm_state["anomaly_seq"]:
+            failures.append(
+                "multijob: storm job's commit-stall anomaly never fired")
+        if sib_hb["p95_us"] > MULTIJOB_BUDGETS["sibling_hb_p95_us"]:
+            failures.append(
+                f"multijob: sibling heartbeat p95 {sib_hb['p95_us']}us > "
+                f"budget {MULTIJOB_BUDGETS['sibling_hb_p95_us']}us")
+        if out["formation_p95_ms"] > MULTIJOB_BUDGETS["formation_p95_ms"]:
+            failures.append(
+                f"multijob: per-job formation p95 "
+                f"{out['formation_p95_ms']}ms > budget "
+                f"{MULTIJOB_BUDGETS['formation_p95_ms']}ms")
+
+        # District failover drill: a warm standby (same durable state dir)
+        # takes over d0 with a bumped fencing epoch; the root must count
+        # exactly one d0 failover and keep d1's rollup untouched.
+        rcli = LighthouseClient(root.address())
+        # Wait for the rollup cadence to converge (every d1 job visible at
+        # the root), then freeze the "before" view for the bit-exact check.
+        d1_expect = {j for j in jobs if district_of[j] == "d1"}
+        d1_jobs_before: Dict[str, Any] = {}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            root_before = rcli.status()
+            d1_jobs_before = {
+                j: (info or {}).get("n")
+                for j, info in ((root_before.get("districts") or {})
+                                .get("d1", {}).get("jobs") or {}).items()
+            }
+            if d1_expect <= set(d1_jobs_before):
+                break
+            time.sleep(0.25)
+        else:
+            failures.append(
+                "multijob: root never converged on d1's job rollup")
+        d0_standby = LighthouseServer(
+            state_dir=d0_state, standby=True, district="d0",
+            root_addr=root.address(), **mk_opts)
+        close_fleet(storm)
+        d0.shutdown()
+        # The fleet's managers reconnect and re-request: the first quorum
+        # RPC triggers the standby takeover (epoch fence bump).
+        storm2 = connect_fleet(d0_standby.address(), n_per_job,
+                               job=storm_job, hb_interval_ms=600_000)
+        job_conns[storm_job] = storm2
+        heartbeat_phase(storm2, rounds=1)
+        quorum_phase(storm2)
+        d0_after: Dict[str, Any] = {}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rs = rcli.status()
+            d0_after = (rs.get("districts") or {}).get("d0") or {}
+            if int(d0_after.get("failovers", 0)) >= 1:
+                break
+            time.sleep(0.25)
+        else:
+            failures.append(
+                "multijob: root never observed the d0 standby takeover")
+        rs = rcli.status()
+        d1_after = (rs.get("districts") or {}).get("d1") or {}
+        d1_jobs_after = {
+            j: (info or {}).get("n")
+            for j, info in (d1_after.get("jobs") or {}).items()
+        }
+        # Sibling-district quorums stay un-wedged through the takeover.
+        sib_d1 = next(j for j in siblings if district_of[j] == "d1")
+        for c in job_conns[sib_d1]:
+            c.step += 1
+        sib_q = quorum_phase(job_conns[sib_d1])
+        out["failover"] = {
+            "district": "d0",
+            "epoch": d0_after.get("epoch"),
+            "root_failovers": d0_after.get("failovers"),
+            "stale_dropped": d0_after.get("stale_dropped"),
+            "sibling_failovers": d1_after.get("failovers"),
+            "sibling_jobs_before": d1_jobs_before,
+            "sibling_jobs_after": d1_jobs_after,
+            "sibling_formation_ms": sib_q["formation_ms"],
+        }
+        if int(d1_after.get("failovers", 0)) != 0:
+            failures.append(
+                "multijob: d1 recorded a failover during d0's takeover")
+        if d1_jobs_before != d1_jobs_after:
+            failures.append(
+                "multijob: root's view of d1's jobs changed during d0's "
+                f"takeover: {d1_jobs_before} -> {d1_jobs_after}")
+        if log is not None:
+            log.emit("district_failover", replica_id="fleet_load",
+                     district="d0", epoch=d0_after.get("epoch"),
+                     failovers=d0_after.get("failovers"))
+        for cli in clients.values():
+            cli.close()
+        rcli.close()
+    finally:
+        for cs in job_conns.values():
+            close_fleet(cs)
+        for srv in (d0_standby, d0, d1, root):
+            if srv is not None:
+                try:
+                    srv.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+    out["failures"] = failures
+    out["pass"] = not failures
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--sizes", type=int, nargs="+", default=None,
@@ -528,11 +861,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="run ONLY the warm-restart storm scenario at "
                         "N=256 (64 with --quick) and merge the result "
                         "into the existing report")
+    p.add_argument("--multijob", action="store_true",
+                   help="run ONLY the multi-job federation scenario "
+                        "(M jobs x N replicas, district->root topology, "
+                        "seeded churn storm + HA drill) and merge the "
+                        "result into the existing report")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="multijob: number of job namespaces "
+                        "(default 16, 4 with --quick)")
+    p.add_argument("--per-job", type=int, default=None,
+                   help="multijob: replicas per job namespace "
+                        "(default 4, 2 with --quick)")
+    p.add_argument("--seed", type=int, default=1234,
+                   help="multijob: churn-storm seed")
     p.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_FLEET.json"))
     args = p.parse_args(argv)
     sizes = args.sizes or ([64] if args.quick else [64, 256, 1024])
+
+    if args.multijob:
+        # Standalone scenario: merge into the existing BENCH_FLEET.json
+        # (the ladder results stay) and append to the ledger.
+        m = args.jobs if args.jobs is not None else (4 if args.quick else 16)
+        npj = (args.per_job if args.per_job is not None
+               else (2 if args.quick else 4))
+        print(f"[fleet_load] multijob: {m} jobs x {npj} replicas, "
+              f"district->root topology, seed={args.seed}", flush=True)
+        mj = multijob_scenario(m, npj, seed=args.seed)
+        try:
+            with open(args.out) as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            report = {"schema": 1, "fleets": {}}
+        report["multijob"] = mj
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        try:
+            import perf_ledger
+
+            perf_ledger.record_report(
+                "fleet", {"fleets": {}, "multijob": mj},
+                "tools/fleet_load.py (live)"
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"[fleet_load] ledger append skipped: {e}",
+                  file=sys.stderr)
+        print(f"[fleet_load] multijob: formation p95="
+              f"{mj['formation_p95_ms']}ms sibling hb p95="
+              f"{mj['sibling_heartbeat']['p95_us']}us "
+              f"violations={len(mj['isolation']['violations'])} "
+              f"-> {args.out}", flush=True)
+        for msg in mj["failures"]:
+            print(f"[fleet_load] MULTIJOB FAIL: {msg}", file=sys.stderr)
+        return 0 if mj["pass"] else 1
 
     if args.restart_lighthouse:
         # Standalone scenario: merge into the existing BENCH_FLEET.json
@@ -609,6 +992,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"N={n}: /fleet.json p95 "
                     f"{res['http']['fleet_json']['p95_us']}us > budget "
                     f"{budget['fleet_json_p95_us']}us")
+            if (res["quorum"]["formation_ms"]
+                    > budget["quorum_formation_ms"]):
+                failures.append(
+                    f"N={n}: quorum formation "
+                    f"{res['quorum']['formation_ms']}ms > budget "
+                    f"{budget['quorum_formation_ms']}ms")
 
     if not args.quick:
         # Before/after at the largest N: the same probe mix with the
@@ -639,6 +1028,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report["pass"] = not failures
     report["failures"] = failures
+    # The ladder rewrite keeps the standalone merge-in scenarios
+    # (--restart-lighthouse / --multijob) from the previous report.
+    try:
+        with open(args.out) as f:
+            prev = json.load(f)
+        for key in ("restart", "multijob"):
+            if key in prev and key not in report:
+                report[key] = prev[key]
+    except (OSError, ValueError):
+        pass
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
         f.write("\n")

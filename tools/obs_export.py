@@ -130,7 +130,12 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
     /metrics), per-replica series are emitted only for anomalous/straggler
     replicas — a 1024-replica fleet scrapes as aggregates plus the rows a
     pager rule would actually fire on, with a suppressed-count gauge naming
-    what was collapsed."""
+    what was collapsed.
+
+    Every fleet series carries a ``job`` label (the payload's namespace);
+    a composite payload additionally yields per-job rollup gauges — bounded
+    by ``TORCHFT_EXPORT_MAX_JOBS`` the same way replicas are — plus one
+    ``torchft_exporter_district_*`` series set per reporting district."""
     if max_replicas is None:
         max_replicas = knobs.get_int("TORCHFT_EXPORT_MAX_REPLICAS")
     lines = []
@@ -142,6 +147,8 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
     def esc(s: Any) -> str:
         return str(s).replace("\\", "\\\\").replace('"', '\\"')
 
+    job = fleet.get("job") or "default"
+    jl = f'job="{esc(job)}"'
     agg = fleet.get("agg") or {}
     all_replicas = fleet.get("replicas") or {}
     capped = len(all_replicas) > max_replicas
@@ -154,34 +161,35 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
         replicas = all_replicas
     header("torchft_exporter_fleet_replicas",
            "Replicas in the lighthouse fleet table.")
-    lines.append(f"torchft_exporter_fleet_replicas {int(agg.get('n', 0))}")
+    lines.append(f"torchft_exporter_fleet_replicas{{{jl}}} "
+                 f"{int(agg.get('n', 0))}")
     header("torchft_exporter_fleet_stragglers",
            "Replicas currently flagged as stragglers.")
-    lines.append("torchft_exporter_fleet_stragglers "
+    lines.append(f"torchft_exporter_fleet_stragglers{{{jl}}} "
                  f"{int(agg.get('stragglers', 0))}")
     header("torchft_exporter_fleet_anomalies_total",
            "Anomalies detected since lighthouse boot (rise edges).")
-    lines.append("torchft_exporter_fleet_anomalies_total "
+    lines.append(f"torchft_exporter_fleet_anomalies_total{{{jl}}} "
                  f"{int(fleet.get('anomaly_seq', 0))}")
     header("torchft_exporter_fleet_anomalies_dropped",
            "Anomaly records evicted from the lighthouse ring "
            "(feed incomplete when > 0).")
-    lines.append("torchft_exporter_fleet_anomalies_dropped "
+    lines.append(f"torchft_exporter_fleet_anomalies_dropped{{{jl}}} "
                  f"{int(agg.get('anomalies_dropped', 0))}")
     header("torchft_exporter_replicas_suppressed",
            "Healthy replicas collapsed into aggregates by the "
            "TORCHFT_EXPORT_MAX_REPLICAS cardinality bound.")
-    lines.append("torchft_exporter_replicas_suppressed "
+    lines.append(f"torchft_exporter_replicas_suppressed{{{jl}}} "
                  f"{len(all_replicas) - len(replicas)}")
     if agg.get("median_rate") is not None:
         header("torchft_exporter_fleet_median_step_rate",
                "Median committed-steps-per-second across digest replicas.")
-        lines.append("torchft_exporter_fleet_median_step_rate "
+        lines.append(f"torchft_exporter_fleet_median_step_rate{{{jl}}} "
                      f"{float(agg['median_rate']):.6g}")
     if agg.get("median_goodput") is not None:
         header("torchft_exporter_fleet_median_goodput",
                "Median rolling goodput fraction across digest replicas.")
-        lines.append("torchft_exporter_fleet_median_goodput "
+        lines.append(f"torchft_exporter_fleet_median_goodput{{{jl}}} "
                      f"{float(agg['median_goodput']):.6g}")
 
     header("torchft_exporter_replica_straggler",
@@ -189,38 +197,92 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
     for rid in sorted(replicas):
         flag = 1 if replicas[rid].get("straggler") else 0
         lines.append(
-            f'torchft_exporter_replica_straggler{{replica="{esc(rid)}"}} '
-            f"{flag}")
+            f'torchft_exporter_replica_straggler{{{jl},'
+            f'replica="{esc(rid)}"}} {flag}')
     header("torchft_exporter_replica_anomaly",
            "1 per active anomaly flag (kind label) on this replica.")
     for rid in sorted(replicas):
         for kind in sorted(replicas[rid].get("flags") or []):
             lines.append(
-                f'torchft_exporter_replica_anomaly{{replica="{esc(rid)}",'
-                f'kind="{esc(kind)}"}} 1')
+                f'torchft_exporter_replica_anomaly{{{jl},'
+                f'replica="{esc(rid)}",kind="{esc(kind)}"}} 1')
     header("torchft_exporter_replica_step_rate",
            "Committed steps per second from this replica's digest.")
     for rid in sorted(replicas):
         dg = replicas[rid].get("digest") or {}
         if "rate" in dg:
             lines.append(
-                f'torchft_exporter_replica_step_rate{{replica="{esc(rid)}"}} '
-                f"{float(dg['rate']):.6g}")
+                f'torchft_exporter_replica_step_rate{{{jl},'
+                f'replica="{esc(rid)}"}} {float(dg["rate"]):.6g}')
     header("torchft_exporter_replica_goodput",
            "Rolling goodput fraction from this replica's digest.")
     for rid in sorted(replicas):
         dg = replicas[rid].get("digest") or {}
         if "gp" in dg:
             lines.append(
-                f'torchft_exporter_replica_goodput{{replica="{esc(rid)}"}} '
-                f"{float(dg['gp']):.6g}")
+                f'torchft_exporter_replica_goodput{{{jl},'
+                f'replica="{esc(rid)}"}} {float(dg["gp"]):.6g}')
     header("torchft_exporter_replica_commit_failures",
            "Consecutive commit failures from this replica's digest.")
     for rid in sorted(replicas):
         dg = replicas[rid].get("digest") or {}
         lines.append(
-            f'torchft_exporter_replica_commit_failures{{'
+            f'torchft_exporter_replica_commit_failures{{{jl},'
             f'replica="{esc(rid)}"}} {int(dg.get("cf", 0))}')
+
+    # Namespace rollups (composite payload only): one small series set per
+    # job island. Bounded like replicas — above the cap only jobs a pager
+    # rule would fire on (stragglers or anomalies) keep their series.
+    all_jobs = fleet.get("jobs") or {}
+    if all_jobs:
+        max_jobs = knobs.get_int("TORCHFT_EXPORT_MAX_JOBS")
+        if len(all_jobs) > max_jobs:
+            jobs = {
+                name: ja for name, ja in all_jobs.items()
+                if (ja or {}).get("stragglers") or (ja or {}).get(
+                    "anomaly_seq")
+            }
+        else:
+            jobs = all_jobs
+        header("torchft_exporter_jobs_suppressed",
+               "Healthy job namespaces collapsed by the "
+               "TORCHFT_EXPORT_MAX_JOBS cardinality bound.")
+        lines.append("torchft_exporter_jobs_suppressed "
+                     f"{len(all_jobs) - len(jobs)}")
+        for name, key, help_ in (
+            ("torchft_exporter_job_replicas", "n",
+             "Replicas in this job namespace's fleet table."),
+            ("torchft_exporter_job_quorum_world", "quorum_world",
+             "This job's current quorum size."),
+            ("torchft_exporter_job_stragglers", "stragglers",
+             "Replicas this job currently flags as stragglers."),
+            ("torchft_exporter_job_anomalies_total", "anomaly_seq",
+             "Anomalies this job has raised since lighthouse boot."),
+        ):
+            header(name, help_)
+            for jname in sorted(jobs):
+                lines.append(
+                    f'{name}{{job="{esc(jname)}"}} '
+                    f"{int((jobs[jname] or {}).get(key, 0))}")
+
+    # Federation (root lighthouse only): district liveness + fencing.
+    districts = fleet.get("districts") or {}
+    if districts:
+        for name, key, help_ in (
+            ("torchft_exporter_district_lost", "lost",
+             "1 when no rollup arrived within the heartbeat timeout."),
+            ("torchft_exporter_district_epoch", "epoch",
+             "Max fencing epoch accepted from this district."),
+            ("torchft_exporter_district_failovers", "failovers",
+             "Epoch advances observed (district lighthouse failovers)."),
+            ("torchft_exporter_district_stale_dropped", "stale_dropped",
+             "Rollups fenced out as coming from a stale district primary."),
+        ):
+            header(name, help_)
+            for dname in sorted(districts):
+                lines.append(
+                    f'{name}{{district="{esc(dname)}"}} '
+                    f"{int((districts[dname] or {}).get(key, 0))}")
     return "\n".join(lines) + "\n"
 
 

@@ -34,6 +34,7 @@ import json
 import os
 import sys
 import time
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -48,9 +49,15 @@ ANSI_YELLOW = "\x1b[33m"
 ANSI_RESET = "\x1b[0m"
 
 
-def fetch_fleet(lighthouse: str, timeout: float = 5.0) -> Dict[str, Any]:
-    """GET http://<lighthouse>/fleet.json and decode it."""
+def fetch_fleet(lighthouse: str, timeout: float = 5.0,
+                job: str = "") -> Dict[str, Any]:
+    """GET http://<lighthouse>/fleet.json and decode it. ``job`` scopes
+    the payload to one namespace (``?job=<id>``); empty fetches the
+    default job's composite view, which carries the per-job rollup
+    summaries under ``jobs`` plus federation ``districts``."""
     url = f"http://{lighthouse}/fleet.json"
+    if job:
+        url += f"?job={urllib.parse.quote(job)}"
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return json.loads(resp.read().decode())
 
@@ -134,9 +141,13 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
     def paint(s: str, code: str) -> str:
         return f"{code}{s}{ANSI_RESET}" if color else s
 
+    # Non-default namespaces tag the header so two side-by-side panes
+    # watching different jobs are distinguishable at a glance.
+    job = fleet.get("job") or "default"
+    job_tag = f"job={job}  " if job != "default" else ""
     lines: List[str] = []
     lines.append(paint(
-        f"torchft fleet  replicas={int(agg.get('n', 0))} "
+        f"torchft fleet  {job_tag}replicas={int(agg.get('n', 0))} "
         # WORLD: current quorum size plus cumulative join/leave churn —
         # the elastic-membership counters the lighthouse folds across
         # quorum transitions (deliberate resizes and crash churn alike).
@@ -199,6 +210,50 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
         lines.append("  (no replicas heartbeating yet)")
     if hidden > 0:
         lines.append(f"  (+{hidden} more replicas below the --top cut)")
+    # Namespace rollup: the composite payload (no ?job= filter) carries a
+    # per-job summary map — one line per island so a multi-tenant operator
+    # sees every job's quorum world and anomaly count without N fetches.
+    jobs = fleet.get("jobs") or {}
+    if jobs:
+        lines.append("")
+        lines.append(paint("jobs:", ANSI_BOLD))
+        lines.append(paint(
+            f"  {'JOB':<16} {'N':>5} {'WORLD':>6} {'STRAG':>6} "
+            f"{'RATE/s':>8} {'ANOM':>6}", ANSI_BOLD))
+        for jname in sorted(jobs):
+            ja = jobs[jname] or {}
+            row = (
+                f"  {str(jname)[:16]:<16} {int(ja.get('n', 0)):>5} "
+                f"{int(ja.get('quorum_world', 0)):>6} "
+                f"{int(ja.get('stragglers', 0)):>6} "
+                f"{_fmt(ja.get('median_rate'), '{:.3f}'):>8} "
+                f"{int(ja.get('anomaly_seq', 0)):>6}"
+            )
+            if ja.get("stragglers"):
+                row = paint(row, ANSI_YELLOW)
+            lines.append(row)
+    # Federation view (root lighthouse only): one line per reporting
+    # district — LOST means no rollup within the heartbeat timeout, a
+    # failover count > 0 means a standby took over that district's epoch.
+    districts = fleet.get("districts") or {}
+    if districts:
+        lines.append("")
+        lines.append(paint("districts:", ANSI_BOLD))
+        for dname in sorted(districts):
+            d = districts[dname] or {}
+            lost = bool(d.get("lost"))
+            row = (
+                f"  {str(dname)[:16]:<16} "
+                f"{'LOST' if lost else 'up':<5} "
+                f"epoch={int(d.get('epoch', 0))} "
+                f"age_ms={int(d.get('age_ms', 0))} "
+                f"failovers={int(d.get('failovers', 0))} "
+                f"stale_dropped={int(d.get('stale_dropped', 0))} "
+                f"jobs={len(d.get('jobs') or {})}"
+            )
+            if lost:
+                row = paint(row, ANSI_RED)
+            lines.append(row)
     if anomalies:
         lines.append("")
         lines.append(paint("recent anomalies:", ANSI_BOLD))
@@ -272,6 +327,20 @@ def check_frame(fleet: Dict[str, Any], frame: str,
     if world not in head:
         problems.append("WORLD (quorum size + join/leave churn) missing "
                         "from header")
+    # Namespace rollup: every job island in the composite payload must
+    # render its summary line (n + world), and every district its
+    # up/LOST row — federation health must never be silently dropped.
+    for jname, ja in (fleet.get("jobs") or {}).items():
+        ja = ja or {}
+        want = f"{str(jname)[:16]:<16} {int(ja.get('n', 0)):>5}"
+        if not any(want in ln for ln in frame_lines):
+            problems.append(f"job {jname!r} rollup row missing from frame")
+    for dname, d in (fleet.get("districts") or {}).items():
+        state = "LOST" if (d or {}).get("lost") else "up"
+        if not any(str(dname)[:16] in ln and state in ln
+                   for ln in frame_lines):
+            problems.append(
+                f"district {dname!r} ({state}) row missing from frame")
     return problems
 
 
@@ -296,12 +365,16 @@ def main(argv: Optional[list] = None) -> int:
                    default=knobs.get_float("TORCHFT_TTR_BUDGET_S"),
                    help="flag replicas whose heal p95 exceeds this many "
                         "seconds (default: $TORCHFT_TTR_BUDGET_S)")
+    p.add_argument("--job", default="",
+                   help="scope the dashboard to one job namespace "
+                        "(?job=<id>); empty shows the default job plus "
+                        "the cross-job and district rollups")
     args = p.parse_args(argv)
     if not args.lighthouse:
         p.error("--lighthouse / $TORCHFT_LIGHTHOUSE is required")
 
     if args.once:
-        fleet = fetch_fleet(args.lighthouse)
+        fleet = fetch_fleet(args.lighthouse, job=args.job)
         frame = render(fleet, color=False, top=args.top,
                        ttr_budget_s=args.ttr_budget)
         sys.stdout.write(frame)
@@ -318,7 +391,7 @@ def main(argv: Optional[list] = None) -> int:
     try:
         while True:
             try:
-                fleet = fetch_fleet(args.lighthouse)
+                fleet = fetch_fleet(args.lighthouse, job=args.job)
                 frame = render(fleet, color=color, top=args.top,
                                ttr_budget_s=args.ttr_budget)
             except Exception as e:  # noqa: BLE001 - keep polling

@@ -303,12 +303,47 @@ def _fleet_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     for n, res in (doc.get("fleets") or {}).items():
         hb = (res.get("heartbeat") or {}).get("p95_us")
         fj = ((res.get("http") or {}).get("fleet_json") or {}).get("p95_us")
+        qr = res.get("quorum") or {}
         if hb is not None:
             out.append((f"fleet.hb_p95_us.n{n}", float(hb), "us", "lower",
                         "fleet", src, None))
         if fj is not None:
             out.append((f"fleet.fleet_json_p95_us.n{n}", float(fj), "us",
                         "lower", "fleet", src, None))
+        # Incremental-quorum headline: wall time from the first register
+        # to the broadcast, the number the delta-driven gate + shared
+        # broadcast payload cut from ~4 s to sub-second at N=1024.
+        if qr.get("formation_ms") is not None:
+            out.append((f"fleet.quorum_formation_ms.n{n}",
+                        float(qr["formation_ms"]), "ms", "lower", "fleet",
+                        src, {"rpc_p95_us": qr.get("p95_us")}))
+        if qr.get("p95_us") is not None:
+            out.append((f"fleet.quorum_rpc_p95_us.n{n}",
+                        float(qr["p95_us"]), "us", "lower", "fleet",
+                        src, None))
+    # --multijob scenario: M jobs x N replicas across a district->root
+    # federation with a seeded churn storm in one job. Pins the per-job
+    # formation tail, the sibling-job heartbeat tail DURING the storm
+    # (cross-job hot-path isolation), and the isolation violation count
+    # (bit-exact sibling control-plane state; must stay 0).
+    mj = doc.get("multijob") or {}
+    if mj:
+        mtag = f"m{mj.get('m_jobs')}x{mj.get('n_per_job')}"
+        extra = {"storm_job": mj.get("storm_job"), "seed": mj.get("seed")}
+        if mj.get("formation_p95_ms") is not None:
+            out.append((f"fleet.multijob_formation_p95_ms.{mtag}",
+                        float(mj["formation_p95_ms"]), "ms", "lower",
+                        "fleet", src, extra))
+        sib = (mj.get("sibling_heartbeat") or {}).get("p95_us")
+        if sib is not None:
+            out.append((f"fleet.multijob_sibling_hb_p95_us.{mtag}",
+                        float(sib), "us", "lower", "fleet", src, None))
+        viol = (mj.get("isolation") or {}).get("violations")
+        if viol is not None:
+            out.append((f"fleet.multijob_isolation_violations.{mtag}",
+                        float(len(viol)), "count", "lower", "fleet", src,
+                        {"siblings": (mj.get("isolation") or {}).get(
+                            "siblings")}))
     # --restart-lighthouse scenario: warm-restart re-register storm (time
     # for all N conns to heartbeat-ack against the restarted process) and
     # /fleet.json aggregate repopulation (agg.n back to N).

@@ -69,6 +69,14 @@
 #                                       # -> BENCH_WAN.json, then a same-seed
 #                                       # replay asserting the injection
 #                                       # multiset is identical
+#        bash tools/suite_gate.sh multijob # multi-tenant federation drill:
+#                                       # M jobs x N replicas across two
+#                                       # district lighthouses + a root,
+#                                       # seeded per-job churn storm, cross-
+#                                       # job isolation asserted bit-exact,
+#                                       # district failover fenced at the
+#                                       # root -> BENCH_FLEET.json multijob
+#                                       # section, then perf_gate --check
 #        bash tools/suite_gate.sh control # control-plane-loss drill: kill
 #                                       # the active lighthouse mid-run ->
 #                                       # warm-standby takeover (epoch+1),
@@ -156,6 +164,14 @@ if [ "${1:-}" = "control" ]; then
   timeout 120 env JAX_PLATFORMS=cpu python tools/lighthouse_drill.py \
     --replay || exit 1
   echo "== control gate: ledger head vs pinned failover budgets =="
+  exec timeout 120 python tools/perf_gate.py --check
+fi
+
+if [ "${1:-}" = "multijob" ]; then
+  echo "== multijob: M jobs x N replicas, district->root federation =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/fleet_load.py \
+    --multijob --quick --out BENCH_FLEET.json || exit 1
+  echo "== multijob gate: ledger head vs pinned formation/isolation pins =="
   exec timeout 120 python tools/perf_gate.py --check
 fi
 

@@ -16,7 +16,8 @@ static const char* kUsage =
     "usage: lighthouse --min-replicas N [--bind-host H] [--port P]\n"
     "                  [--join-timeout-ms N] [--quorum-tick-ms N]\n"
     "                  [--heartbeat-timeout-ms N] [--fleet-snap-ms N]\n"
-    "                  [--state-dir DIR] [--standby]\n";
+    "                  [--state-dir DIR] [--standby]\n"
+    "                  [--district NAME] [--root HOST:PORT]\n";
 
 int main(int argc, char** argv) {
   std::string bind_host = "0.0.0.0";
@@ -32,6 +33,12 @@ int main(int argc, char** argv) {
   // env knob, empty disables persistence (the pre-HA behavior).
   const char* sd_env = std::getenv("TORCHFT_LH_STATE_DIR");
   if (sd_env != nullptr && *sd_env != '\0') opts.state_dir = sd_env;
+  // Federation: district name + root lighthouse address. With both set, the
+  // active instance reports per-job rollups upward; flags win over env.
+  const char* di_env = std::getenv("TORCHFT_LH_DISTRICT");
+  if (di_env != nullptr && *di_env != '\0') opts.district = di_env;
+  const char* ro_env = std::getenv("TORCHFT_LH_ROOT");
+  if (ro_env != nullptr && *ro_env != '\0') opts.root_addr = ro_env;
   bool have_min = false;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -61,6 +68,10 @@ int main(int argc, char** argv) {
       opts.state_dir = next();
     } else if (a == "--standby") {
       opts.standby = true;
+    } else if (a == "--district") {
+      opts.district = next();
+    } else if (a == "--root") {
+      opts.root_addr = next();
     } else if (a == "--parent-pid") {
       tft::watch_parent(std::stoll(next()));
     } else {

@@ -17,7 +17,7 @@ static const char* kUsage =
     "         --store-address HOST:PORT --world-size N\n"
     "         [--advertise-host H] [--bind-host H] [--port P]\n"
     "         [--heartbeat-interval-ms N] [--connect-timeout-ms N]\n"
-    "         [--quorum-retries N] [--lh-lease-ms N]\n";
+    "         [--quorum-retries N] [--lh-lease-ms N] [--job NAME]\n";
 
 int main(int argc, char** argv) {
   tft::ManagerOpts opts;
@@ -26,6 +26,10 @@ int main(int argc, char** argv) {
   const char* lease_env = std::getenv("TORCHFT_LH_LEASE_MS");
   if (lease_env != nullptr && *lease_env != '\0')
     opts.lighthouse_lease_ms = std::stoll(lease_env);
+  // Job namespace this replica group belongs to (stamped on every frame to
+  // the lighthouse); the flag wins over the env knob.
+  const char* job_env = std::getenv("TORCHFT_JOB");
+  if (job_env != nullptr && *job_env != '\0') opts.job = job_env;
   int64_t parent_pid = 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -58,6 +62,8 @@ int main(int argc, char** argv) {
       opts.quorum_retries = std::stoll(next());
     } else if (a == "--lh-lease-ms") {
       opts.lighthouse_lease_ms = std::stoll(next());
+    } else if (a == "--job") {
+      opts.job = next();
     } else if (a == "--parent-pid") {
       parent_pid = std::stoll(next());
     } else {

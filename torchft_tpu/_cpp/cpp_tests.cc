@@ -1626,6 +1626,382 @@ static void test_fleet_snapshot_concurrent() {
   lh.stop();
 }
 
+static Json fleet_heartbeat_job(const std::string& addr, const std::string& job,
+                                const std::string& id, int64_t step,
+                                double rate) {
+  Json req = Json::object();
+  req["type"] = Json::of("heartbeat");
+  req["replica_id"] = Json::of(id);
+  req["job"] = Json::of(job);
+  // Generous advertised interval: these tests heartbeat once and move on;
+  // the hb_gap detector must not fire on its own mid-test.
+  req["hb_interval_ms"] = Json::of(int64_t(60000));
+  Json d = Json::object();
+  d["v"] = Json::of(int64_t(1));
+  d["step"] = Json::of(step);
+  d["rate"] = Json::of(rate);
+  d["gp"] = Json::of(0.9);
+  d["cf"] = Json::of(int64_t(0));
+  req["digest"] = d;
+  return lighthouse_call(addr, req, 3000);
+}
+
+static Json fleet_fetch_job(const std::string& addr, const std::string& job) {
+  Json req = Json::object();
+  req["type"] = Json::of("fleet");
+  req["job"] = Json::of(job);
+  return lighthouse_call(addr, req, 3000);
+}
+
+static Json quorum_req_job(const std::string& addr, const std::string& job,
+                           const std::string& id, int64_t step) {
+  Json req = Json::object();
+  req["type"] = Json::of("quorum");
+  req["timeout_ms"] = Json::of(int64_t(5000));
+  req["requester"] = mk_member(id, step).to_json();
+  if (!job.empty()) req["job"] = Json::of(job);
+  return lighthouse_call(addr, req, 6000);
+}
+
+static void test_job_namespace_isolation() {
+  // Two job islands on one lighthouse: churn + anomalies in job alpha must
+  // not bump job beta's quorum generation, quorum id, anomaly ring, or
+  // fleet generation — the hard-isolation contract of the namespace plane.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 5000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  // Form one quorum in each namespace.
+  Json qa = quorum_req_job(addr, "alpha", "a0", 1);
+  Json qb = quorum_req_job(addr, "beta", "b0", 1);
+  CHECK(qa.get("ok").as_bool());
+  CHECK(qb.get("ok").as_bool());
+  CHECK_EQ(qa.get("quorum").get("job").as_str(), std::string("alpha"));
+  CHECK_EQ(qb.get("quorum").get("job").as_str(), std::string("beta"));
+  // Ids are per-job: both namespaces start their numbering at 1.
+  CHECK_EQ(qa.get("quorum").get("quorum_id").as_int(), int64_t{1});
+  CHECK_EQ(qb.get("quorum").get("quorum_id").as_int(), int64_t{1});
+  CHECK(fleet_heartbeat_job(addr, "beta", "b0", 1, 1.0).get("ok").as_bool());
+
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s0 = lighthouse_call(addr, sreq, 3000).get("status");
+  Json b0 = s0.get("jobs").get("beta");
+  int64_t beta_gen0 = b0.get("quorum_generation").as_int(-1);
+  int64_t beta_qid0 = b0.get("quorum_id").as_int(-1);
+  int64_t beta_aseq0 = b0.get("fleet").get("anomaly_seq").as_int(-1);
+  CHECK(beta_gen0 >= 1);
+
+  // Churn storm in alpha: memberships come and go, a straggler digest
+  // raises an anomaly — all inside alpha's island.
+  for (int round = 0; round < 3; round++) {
+    Json q1 = quorum_req_job(addr, "alpha", "a0", round + 2);
+    std::string extra = "a_extra_" + std::to_string(round);
+    Json q2 = quorum_req_job(addr, "alpha", extra, 1);
+    CHECK(q1.get("ok").as_bool() || q2.get("ok").as_bool());
+    Json lv = Json::object();
+    lv["type"] = Json::of("leave");
+    lv["replica_id"] = Json::of(extra);
+    lv["job"] = Json::of("alpha");
+    CHECK(lighthouse_call(addr, lv, 3000).get("ok").as_bool());
+  }
+  // Anomaly in alpha: a commit-failure streak flags commit_stall.
+  {
+    Json req = Json::object();
+    req["type"] = Json::of("heartbeat");
+    req["replica_id"] = Json::of(std::string("a0"));
+    req["job"] = Json::of(std::string("alpha"));
+    req["hb_interval_ms"] = Json::of(int64_t(100));
+    Json d = Json::object();
+    d["v"] = Json::of(int64_t(1));
+    d["step"] = Json::of(int64_t(5));
+    d["rate"] = Json::of(1.0);
+    d["gp"] = Json::of(0.9);
+    d["cf"] = Json::of(int64_t(5));
+    req["digest"] = d;
+    CHECK(lighthouse_call(addr, req, 3000).get("ok").as_bool());
+  }
+
+  Json s1 = lighthouse_call(addr, sreq, 3000).get("status");
+  Json a1 = s1.get("jobs").get("alpha");
+  Json b1 = s1.get("jobs").get("beta");
+  // Alpha saw churn + an anomaly...
+  CHECK(a1.get("quorum_generation").as_int() > 1);
+  CHECK(a1.get("fleet").get("anomaly_seq").as_int() >= 1);
+  // ...beta is bit-exact untouched.
+  CHECK_EQ(b1.get("quorum_generation").as_int(), beta_gen0);
+  CHECK_EQ(b1.get("quorum_id").as_int(), beta_qid0);
+  CHECK_EQ(b1.get("fleet").get("anomaly_seq").as_int(), beta_aseq0);
+  // Per-job fleet tables: alpha's rows never leak into beta's payload.
+  Json fb = fleet_fetch_job(addr, "beta").get("fleet");
+  CHECK_EQ(fb.get("job").as_str(), std::string("beta"));
+  CHECK(fb.get("replicas").has("b0"));
+  CHECK(!fb.get("replicas").has("a0"));
+  // Anomaly records carry their job tag.
+  Json fa = fleet_fetch_job(addr, "alpha").get("fleet");
+  CHECK(fa.get("anomalies").arr.size() >= 1);
+  CHECK_EQ(fa.get("anomalies").arr[0].get("job").as_str(),
+           std::string("alpha"));
+  lh.stop();
+}
+
+static void test_job_wire_backcompat() {
+  // Pre-namespace client against a namespaced lighthouse: frames without a
+  // "job" key land in the default island, and the delivered quorum still
+  // parses for a client that ignores the new field. Both directions of the
+  // compat contract.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 20;
+  opt.heartbeat_timeout_ms = 5000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  // Old-style heartbeat + quorum (no job key anywhere).
+  Json hreq = Json::object();
+  hreq["type"] = Json::of("heartbeat");
+  hreq["replica_id"] = Json::of(std::string("legacy"));
+  CHECK(lighthouse_call(addr, hreq, 3000).get("ok").as_bool());
+  Json q = quorum_req_job(addr, "", "legacy", 1);
+  CHECK(q.get("ok").as_bool());
+  // The namespaced lighthouse stamps the default namespace on the quorum.
+  CHECK_EQ(q.get("quorum").get("job").as_str(), std::string("default"));
+  // The legacy replica lives in the default island (top-level status view).
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json s = lighthouse_call(addr, sreq, 3000).get("status");
+  CHECK(s.get("heartbeat_ages_ms").has("legacy"));
+  CHECK_EQ(s.get("jobs").get("default").get("members").as_int(), int64_t{1});
+  // Old framed fleet fetch (no job key) serves the composite payload with
+  // the pre-namespace top-level schema intact.
+  Json f = fleet_fetch(addr).get("fleet");
+  CHECK(f.get("replicas").has("legacy"));
+  CHECK(f.has("agg"));
+  CHECK(f.has("jobs"));
+  lh.stop();
+
+  // Other direction: a quorum dict from a PRE-namespace lighthouse (no job
+  // key) parses into the default namespace.
+  Json old_q = Json::object();
+  old_q["quorum_id"] = Json::of(int64_t(7));
+  old_q["created_ms"] = Json::of(int64_t(123));
+  old_q["participants"] = Json::array();
+  Quorum parsed = Quorum::from_json(old_q);
+  CHECK_EQ(parsed.job, std::string("default"));
+  CHECK_EQ(parsed.quorum_id, int64_t{7});
+  // And a namespaced quorum round-trips its job tag.
+  Quorum tagged;
+  tagged.quorum_id = 9;
+  tagged.job = "alpha";
+  Quorum back = Quorum::from_json(tagged.to_json());
+  CHECK_EQ(back.job, std::string("alpha"));
+}
+
+static void test_incremental_quorum_gate() {
+  // The registration path must form quorums WITHOUT the periodic tick: a
+  // huge quorum_tick_ms takes the timer out of the picture, so only the
+  // O(1) gate firing the inline quorum_compute can complete these rounds.
+  LighthouseOpts opt;
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 5000;
+  opt.quorum_tick_ms = 60000;  // timer effectively disabled
+  opt.heartbeat_timeout_ms = 5000;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  int64_t t0 = now_ms();
+  Json ra, rb;
+  std::thread ta([&] { ra = quorum_req_job(addr, "", "repA", 1); });
+  std::thread tb([&] { rb = quorum_req_job(addr, "", "repB", 1); });
+  ta.join();
+  tb.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK(rb.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("participants").arr.size(), size_t(2));
+  // Inline formation: far faster than the 60 s timer tick (allow wide CI
+  // slack; the point is the ORDER of magnitude).
+  CHECK(now_ms() - t0 < 5000);
+
+  // Fast-quorum path through the gate: the same members re-register and
+  // the previous-member counter completes the round inline again.
+  int64_t qid = ra.get("quorum").get("quorum_id").as_int();
+  int64_t t1 = now_ms();
+  std::thread tc([&] { ra = quorum_req_job(addr, "", "repA", 2); });
+  std::thread td([&] { rb = quorum_req_job(addr, "", "repB", 2); });
+  tc.join();
+  td.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("quorum_id").as_int(), qid);  // no bump
+  CHECK(now_ms() - t1 < 5000);
+
+  // Gate correctness under a grown membership: a new replica heartbeats
+  // first (hb_not_joined goes up, holding the "all joined" condition open),
+  // then registers; when the previous members return, the prev-member fast
+  // path fires inline and the formed quorum includes all three.
+  Json hreq = Json::object();
+  hreq["type"] = Json::of("heartbeat");
+  hreq["replica_id"] = Json::of(std::string("laggard"));
+  CHECK(lighthouse_call(addr, hreq, 3000).get("ok").as_bool());
+  int64_t t2 = now_ms();
+  Json rl;
+  std::thread tg([&] { rl = quorum_req_job(addr, "", "laggard", 1); });
+  sleep_ms(300);  // laggard is registered before the prev members return
+  std::thread te([&] { ra = quorum_req_job(addr, "", "repA", 3); });
+  std::thread tf([&] { rb = quorum_req_job(addr, "", "repB", 3); });
+  te.join();
+  tf.join();
+  tg.join();
+  CHECK(ra.get("ok").as_bool());
+  CHECK(rb.get("ok").as_bool());
+  CHECK(rl.get("ok").as_bool());
+  CHECK_EQ(ra.get("quorum").get("participants").arr.size(), size_t(3));
+  CHECK(now_ms() - t2 < 10000);
+  lh.stop();
+}
+
+static void test_district_federation() {
+  // District -> root rollup over the heartbeat piggyback channel, with
+  // per-district epoch fencing at the root.
+  LighthouseOpts ropt;
+  ropt.min_replicas = 1;
+  ropt.join_timeout_ms = 100;
+  ropt.quorum_tick_ms = 50;
+  ropt.heartbeat_timeout_ms = 1500;
+  Lighthouse root("127.0.0.1", 0, ropt);
+  CHECK(root.start());
+
+  LighthouseOpts dopt = ropt;
+  dopt.district = "d1";
+  dopt.root_addr = root.address();
+  Lighthouse district("127.0.0.1", 0, dopt);
+  CHECK(district.start());
+
+  // Give the district a job's worth of state, then wait for a rollup.
+  CHECK(fleet_heartbeat_job(district.address(), "alpha", "a0", 3, 1.0)
+            .get("ok")
+            .as_bool());
+  Json sreq = Json::object();
+  sreq["type"] = Json::of("status");
+  Json d1;
+  for (int i = 0; i < 50; i++) {
+    Json s = lighthouse_call(root.address(), sreq, 3000).get("status");
+    if (s.get("districts").has("d1")) {
+      d1 = s.get("districts").get("d1");
+      if (d1.get("jobs").has("alpha")) break;
+    }
+    sleep_ms(100);
+  }
+  CHECK(d1.get("jobs").has("alpha"));
+  CHECK_EQ(d1.get("jobs").get("alpha").get("n").as_int(), int64_t{1});
+  CHECK(d1.get("epoch").as_int() >= 1);
+  CHECK(!d1.get("lost").as_bool());
+  // The district's rollup frames must NOT create replica/fleet rows at the
+  // root — they are control-plane metadata, not member liveness.
+  Json rs = lighthouse_call(root.address(), sreq, 3000).get("status");
+  CHECK(!rs.get("heartbeat_ages_ms").has("district:d1"));
+
+  // Epoch fence: a rollup stamped with a LOWER epoch (the fenced old
+  // primary after a failover) is dropped and counted; a HIGHER epoch is a
+  // failover and bumps the counter.
+  int64_t cur_epoch = d1.get("epoch").as_int();
+  Json stale = Json::object();
+  stale["type"] = Json::of("heartbeat");
+  stale["replica_id"] = Json::of(std::string("district:d1"));
+  stale["district"] = Json::of(std::string("d1"));
+  stale["epoch"] = Json::of(cur_epoch - 1);
+  Json rollup = Json::object();
+  rollup["jobs"] = Json::object();
+  stale["district_rollup"] = rollup;
+  Json sresp = lighthouse_call(root.address(), stale, 3000);
+  CHECK(!sresp.get("ok").as_bool());
+  Json fresh = stale;
+  fresh["epoch"] = Json::of(cur_epoch + 1);
+  CHECK(lighthouse_call(root.address(), fresh, 3000).get("ok").as_bool());
+  Json s2 = lighthouse_call(root.address(), sreq, 3000).get("status");
+  Json d2 = s2.get("districts").get("d1");
+  CHECK(d2.get("stale_dropped").as_int() >= 1);
+  CHECK(d2.get("failovers").as_int() >= 1);
+  CHECK_EQ(d2.get("epoch").as_int(), cur_epoch + 1);
+  // Sibling districts are untouched by d1's failover: a second district's
+  // row keeps its own epoch and counters.
+  Json d3hb = Json::object();
+  d3hb["type"] = Json::of("heartbeat");
+  d3hb["replica_id"] = Json::of(std::string("district:d2"));
+  d3hb["district"] = Json::of(std::string("d2"));
+  d3hb["epoch"] = Json::of(int64_t(1));
+  d3hb["district_rollup"] = rollup;
+  CHECK(lighthouse_call(root.address(), d3hb, 3000).get("ok").as_bool());
+  Json s3 = lighthouse_call(root.address(), sreq, 3000).get("status");
+  CHECK_EQ(s3.get("districts").get("d2").get("failovers").as_int(),
+           int64_t{0});
+  CHECK_EQ(s3.get("districts").get("d2").get("stale_dropped").as_int(),
+           int64_t{0});
+
+  district.stop();
+  // District loss: after the district stops reporting, the root marks it
+  // lost within the heartbeat timeout.
+  bool lost = false;
+  for (int i = 0; i < 50; i++) {
+    Json s = lighthouse_call(root.address(), sreq, 3000).get("status");
+    if (s.get("districts").get("d1").get("lost").as_bool()) {
+      lost = true;
+      break;
+    }
+    sleep_ms(100);
+  }
+  CHECK(lost);
+  root.stop();
+}
+
+static void test_fleet_snapshot_per_job_cache() {
+  // The snapshot cache is keyed per job: job B's churn must not invalidate
+  // job A's cached payload (no O(all-jobs) rebuilds), and job A must never
+  // be served job B's gen.
+  LighthouseOpts opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 50;
+  opt.heartbeat_timeout_ms = 5000;
+  opt.fleet_snap_ms = 300;
+  Lighthouse lh("127.0.0.1", 0, opt);
+  CHECK(lh.start());
+  std::string addr = lh.address();
+
+  CHECK(fleet_heartbeat_job(addr, "jobA", "a0", 1, 1.0).get("ok").as_bool());
+  CHECK(fleet_heartbeat_job(addr, "jobB", "b0", 1, 1.0).get("ok").as_bool());
+  Json fa1 = fleet_fetch_job(addr, "jobA").get("fleet");
+  Json fb1 = fleet_fetch_job(addr, "jobB").get("fleet");
+  CHECK_EQ(fa1.get("job").as_str(), std::string("jobA"));
+  CHECK_EQ(fb1.get("job").as_str(), std::string("jobB"));
+
+  // Churn B hard; A's cached snapshot stays bit-identical (same gen, same
+  // build stamp) while B's next post-TTL fetch advances.
+  for (int i = 1; i <= 5; i++)
+    CHECK(fleet_heartbeat_job(addr, "jobB", "b" + std::to_string(i), 2, 1.0)
+              .get("ok")
+              .as_bool());
+  Json fa2 = fleet_fetch_job(addr, "jobA").get("fleet");
+  CHECK_EQ(fa2.get("gen").as_int(-2), fa1.get("gen").as_int(-1));
+  CHECK_EQ(fa2.get("ts_ms").as_int(), fa1.get("ts_ms").as_int());
+  sleep_ms(350);
+  Json fb2 = fleet_fetch_job(addr, "jobB").get("fleet");
+  CHECK(fb2.get("gen").as_int(-1) > fb1.get("gen").as_int(-1));
+  CHECK_EQ(fb2.get("agg").get("n").as_int(), int64_t{6});
+  // A rebuilt after its own TTL still reports ITS table only.
+  Json fa3 = fleet_fetch_job(addr, "jobA").get("fleet");
+  CHECK_EQ(fa3.get("agg").get("n").as_int(), int64_t{1});
+  CHECK(!fa3.get("replicas").has("b0"));
+  lh.stop();
+}
+
 int main() {
   test_split_host_port();
   test_json();
@@ -1642,6 +2018,11 @@ int main() {
   test_median_tracker();
   test_fleet_snapshot_cache();
   test_fleet_snapshot_concurrent();
+  test_job_namespace_isolation();
+  test_job_wire_backcompat();
+  test_incremental_quorum_gate();
+  test_district_federation();
+  test_fleet_snapshot_per_job_cache();
   test_lighthouse_e2e();
   test_lighthouse_leave();
   test_lh_durable_state();

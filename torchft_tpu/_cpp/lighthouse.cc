@@ -26,6 +26,13 @@ int64_t now_us_steady() {
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
+// Wire back-compat: pre-namespace clients send no "job" field; an absent or
+// empty value maps to the default namespace on every frame type.
+std::string job_of(const Json& req) {
+  std::string j = req.get("job").as_str();
+  return j.empty() ? "default" : j;
+}
 }  // namespace
 
 Lighthouse::Lighthouse(const std::string& bind_host, int port,
@@ -47,16 +54,54 @@ Lighthouse::~Lighthouse() { stop(); }
 // the last fsync to keep (epoch, generation) strictly monotone.
 static constexpr int64_t kGenReserve = 1 << 20;
 
-void Lighthouse::persist_locked() {
+void Lighthouse::persist_locked(int64_t job_qid, int64_t job_gen) {
   if (opts_.state_dir.empty()) return;
+  // The durable snapshot stores the MAX ids across every job island: a warm
+  // restart (or takeover) must resume each job's numbering strictly above
+  // anything any job ever published, and a single fsync'd file is the
+  // cheapest shape that guarantees it.
+  if (job_qid > dur_quorum_id_) dur_quorum_id_ = job_qid;
+  if (job_gen > dur_gen_) dur_gen_ = job_gen;
   LighthouseDurable d;
-  d.epoch = epoch_;
-  d.quorum_id = state_.quorum_id;
-  d.generation = quorum_gen_ + kGenReserve;
+  d.epoch = epoch_.load();
+  d.quorum_id = dur_quorum_id_;
+  d.generation = dur_gen_ + kGenReserve;
   if (!lh_state_save(opts_.state_dir, d)) {
     fprintf(stderr, "[lighthouse] WARNING: failed to persist state to %s\n",
             opts_.state_dir.c_str());
   }
+}
+
+void Lighthouse::persist(int64_t job_qid, int64_t job_gen) {
+  std::lock_guard<std::mutex> lk(persist_mu_);
+  persist_locked(job_qid, job_gen);
+}
+
+Lighthouse::JobState& Lighthouse::job_state(const std::string& job) {
+  std::lock_guard<std::mutex> lk(jobs_mu_);
+  auto it = jobs_.find(job);
+  if (it == jobs_.end()) {
+    it = jobs_.try_emplace(job).first;
+    JobState& js = it->second;
+    js.name = job;
+    // Seed from the restored durable maxima so a job island created after a
+    // warm restart (or a job first seen post-restart) continues its quorum
+    // numbering monotonically. restored_* are written once in start()
+    // before any thread runs, so the unlocked read is safe.
+    js.state.quorum_id = restored_quorum_id_;
+    js.quorum_gen = restored_gen_;
+  }
+  return it->second;
+}
+
+std::vector<Lighthouse::JobState*> Lighthouse::all_jobs() {
+  std::lock_guard<std::mutex> lk(jobs_mu_);
+  std::vector<JobState*> out;
+  out.reserve(jobs_.size());
+  // std::map nodes are stable and islands are never erased, so the pointers
+  // stay valid after jobs_mu_ is dropped.
+  for (auto& kv : jobs_) out.push_back(&kv.second);
+  return out;
 }
 
 bool Lighthouse::start() {
@@ -64,7 +109,7 @@ bool Lighthouse::start() {
   if (listen_fd_ < 0) return false;
   port_ = bound_port(listen_fd_);
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<std::mutex> lk(persist_mu_);
     active_ = !opts_.standby;
     LighthouseDurable d;
     if (!opts_.state_dir.empty() && lh_state_load(opts_.state_dir, &d)) {
@@ -73,34 +118,45 @@ bool Lighthouse::start() {
       // generations jump past the reserved headroom. Participant/fleet
       // tables rebuild from the live heartbeat stream.
       epoch_ = d.epoch;
-      state_.quorum_id = d.quorum_id;
-      quorum_gen_ = d.generation;
+      restored_quorum_id_ = dur_quorum_id_ = d.quorum_id;
+      restored_gen_ = dur_gen_ = d.generation;
       fprintf(stderr,
               "[lighthouse] warm restart from %s: epoch=%lld quorum_id=%lld "
               "gen=%lld%s\n",
-              opts_.state_dir.c_str(), static_cast<long long>(epoch_),
-              static_cast<long long>(state_.quorum_id),
-              static_cast<long long>(quorum_gen_),
+              opts_.state_dir.c_str(), static_cast<long long>(epoch_.load()),
+              static_cast<long long>(restored_quorum_id_),
+              static_cast<long long>(restored_gen_),
               active_ ? "" : " (standby)");
     }
     if (active_ && epoch_ == 0) epoch_ = 1;  // fresh active boot
-    if (active_) persist_locked();
+    if (active_) persist_locked(dur_quorum_id_, dur_gen_);
   }
+  // The default namespace island always exists (pre-namespace clients and
+  // the composite /fleet.json land there).
+  job_state("default");
   running_ = true;
   accept_thread_ = std::thread([this] { accept_loop(); });
   tick_thread_ = std::thread([this] { tick_loop(); });
+  // Federation sender: a lighthouse configured with a district name and a
+  // root address reports per-job rollups upward.
+  if (!opts_.root_addr.empty() && !opts_.district.empty())
+    district_thread_ = std::thread([this] { district_loop(); });
   return true;
 }
 
 void Lighthouse::stop() {
   if (!running_.exchange(false)) return;
-  cv_.notify_all();
+  for (JobState* js : all_jobs()) {
+    std::lock_guard<std::mutex> lk(js->mu);
+    js->cv.notify_all();
+  }
   conns_.shutdown_all();  // interrupt in-flight frames so handlers drain fast
   // shutdown() unblocks the accept loop; close() + reset must wait until
   // the thread is joined — accept_loop reads listen_fd_ until then.
   if (listen_fd_ >= 0) shutdown(listen_fd_, SHUT_RDWR);
   if (accept_thread_.joinable()) accept_thread_.join();
   if (tick_thread_.joinable()) tick_thread_.join();
+  if (district_thread_.joinable()) district_thread_.join();
   if (listen_fd_ >= 0) {
     close(listen_fd_);
     listen_fd_ = -1;
@@ -135,88 +191,66 @@ void Lighthouse::tick_loop() {
 }
 
 void Lighthouse::tick() {
-  std::unique_lock<std::mutex> lk(mu_);
-  // Time-based anomaly rules (open heartbeat gaps, digest staleness) ride
-  // the tick so a wedged replica is flagged while it is STILL wedged —
-  // before its step completes or its heartbeat resumes.
-  fleet_scan_locked(now_ms());
-  // A standby absorbs heartbeats (keeping fleet/participant tables warm)
-  // but must not form quorums — there is exactly one epoch owner, and it is
-  // not us until a manager fails over and its quorum request promotes us.
-  if (!active_) {
-    last_reason_ = "standby (not forming quorums)";
+  // The periodic tick is the time-driven fallback of the incremental gate:
+  // it catches everything only the clock can decide (heartbeat expiry,
+  // join-timeout straggler cutoff, open heartbeat gaps) plus any formation
+  // a conservative gate miss deferred. Jobs tick independently under their
+  // own locks — one job's slow scan never blocks another's heartbeats.
+  int64_t now = now_ms();
+  for (JobState* js : all_jobs()) {
+    std::lock_guard<std::mutex> lk(js->mu);
+    fleet_scan_locked(*js, now);
+    job_tick_locked(*js, now);
+  }
+  district_scan(now);
+}
+
+void Lighthouse::district_loop() {
+  // District -> root rollup sender, piggybacking on the heartbeat frame
+  // type. Only the ACTIVE instance reports: a standby stays silent, and
+  // after a takeover the new primary reports with its higher epoch — the
+  // root observes the epoch advance as a district failover while the fenced
+  // old primary's late rollups are dropped by the per-district fence.
+  int64_t interval = opts_.heartbeat_timeout_ms / 4;
+  if (interval < 250) interval = 250;
+  if (interval > 1000) interval = 1000;
+  std::string host;
+  int port = 0;
+  const bool addr_ok = split_host_port(opts_.root_addr, &host, &port);
+  if (!addr_ok) {
+    fprintf(stderr, "[lighthouse] bad root address '%s'; federation off\n",
+            opts_.root_addr.c_str());
     return;
   }
-  std::string reason;
-  int64_t q_t0 = now_us_steady();
-  auto members = quorum_compute(now_ms(), state_, opts_, &reason);
-  hist_quorum_.observe_us(now_us_steady() - q_t0);
-  if (!members) {
-    if (reason != last_reason_ && !state_.participants.empty()) {
-      fprintf(stderr, "[lighthouse] no quorum: %s\n", reason.c_str());
+  int fd = -1;
+  while (running_) {
+    if (active_.load()) {
+      Json jobs = Json::object();
+      int64_t now = now_ms();
+      for (JobState* js : all_jobs()) {
+        std::lock_guard<std::mutex> lk(js->mu);
+        jobs[js->name] = fleet_summary_locked(*js, now);
+      }
+      Json rollup = Json::object();
+      rollup["jobs"] = jobs;
+      Json req = Json::object();
+      req["type"] = Json::of(std::string("heartbeat"));
+      req["replica_id"] = Json::of("district:" + opts_.district);
+      req["district"] = Json::of(opts_.district);
+      req["epoch"] = Json::of(epoch_.load());
+      req["district_rollup"] = rollup;
+      if (fd < 0) fd = tcp_connect(host, port, 2000);
+      if (fd >= 0) {
+        Json resp;
+        if (!call_json(fd, req, &resp, 5000)) {
+          close(fd);
+          fd = -1;  // reconnect next round
+        }
+      }
     }
-    last_reason_ = reason;
-    return;
+    sleep_ms(interval);
   }
-  // Bump quorum_id only when membership changed or a member reported commit
-  // failures (lighthouse.rs:305-325) — a changed id forces process groups to
-  // reconfigure, so we avoid it when the world is stable.
-  bool bump = false;
-  if (!state_.prev_quorum) {
-    bump = true;
-  } else if (quorum_changed(state_.prev_quorum->participants, *members)) {
-    bump = true;
-  } else {
-    for (const auto& m : *members)
-      if (m.commit_failures > 0) bump = true;
-  }
-  if (bump) {
-    state_.quorum_id += 1;
-    // Fsync the new id BEFORE publishing the quorum: a crash between
-    // publish and persist could otherwise let a warm restart re-issue an id
-    // the fleet has already seen.
-    persist_locked();
-  }
-
-  // Participant churn across quorum transitions (surfaced via status +
-  // /metrics): a member present now but not in the previous quorum is a
-  // join; one gone is a leave. Covers crash, kill, and graceful drain
-  // uniformly at the granularity monitoring cares about.
-  {
-    std::set<std::string> prev_ids;
-    if (state_.prev_quorum)
-      for (const auto& m : state_.prev_quorum->participants)
-        prev_ids.insert(m.replica_id);
-    std::set<std::string> new_ids;
-    for (const auto& m : *members) new_ids.insert(m.replica_id);
-    for (const auto& id : new_ids)
-      if (!prev_ids.count(id)) joins_total_ += 1;
-    for (const auto& id : prev_ids)
-      if (!new_ids.count(id)) leaves_total_ += 1;
-  }
-
-  Quorum q;
-  q.quorum_id = state_.quorum_id;
-  q.participants = *members;
-  q.created_ms = now_ms();
-  q.epoch = epoch_;
-  q.generation = quorum_gen_ + 1;
-  state_.prev_quorum = q;
-  state_.participants.clear();  // next round starts fresh (lighthouse.rs:336)
-  last_quorum_ = q;
-  quorum_gen_ += 1;
-  last_reason_.clear();
-  fprintf(stderr, "[lighthouse] quorum %lld formed with %zu members\n",
-          static_cast<long long>(q.quorum_id), q.participants.size());
-  if (std::getenv("TORCHFT_LH_DEBUG") != nullptr) {
-    std::string ids;
-    for (const auto& m : q.participants) ids += m.replica_id + " ";
-    fprintf(stderr, "[lighthouse] +%lld formed gen=%lld members: %s\n",
-            static_cast<long long>(now_ms() % 1000000),
-            static_cast<long long>(quorum_gen_), ids.c_str());
-  }
-  lk.unlock();
-  cv_.notify_all();
+  if (fd >= 0) close(fd);
 }
 
 void Lighthouse::handle_conn(int fd) {
@@ -250,7 +284,15 @@ void Lighthouse::handle_conn(int fd) {
       // absorb it through its retry policy).
       if (!chaos::server_rpc(req.get("type").as_str())) break;
       int64_t timeout = req.get("timeout_ms").as_int(60000);
-      resp = handle_request(req, now_ms() + timeout);
+      std::shared_ptr<const std::string> raw;
+      resp = handle_request(req, now_ms() + timeout, &raw);
+      if (raw) {
+        // Prebuilt shared quorum broadcast: send the bytes as-is. (No
+        // trace echo on this path — the manager's quorum client reads only
+        // ok/quorum and stamps its own trace on the step events.)
+        if (!send_frame(fd, *raw, 30000)) break;
+        continue;
+      }
       // Echo the caller's trace id so both planes of a step share one id
       // (the Python Manager mints it; responses carry it for correlation).
       if (req.has("trace_id")) resp["trace_id"] = req.get("trace_id");
@@ -260,16 +302,23 @@ void Lighthouse::handle_conn(int fd) {
   close(fd);
 }
 
-Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
+Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms,
+                                std::shared_ptr<const std::string>* raw) {
   const std::string type = req.get("type").as_str();
   Json resp = Json::object();
   if (type == "heartbeat") {
+    // District rollups ride the heartbeat frame type (piggyback channel)
+    // but are control-plane metadata, not replica liveness: divert them
+    // BEFORE the job tables so a district never appears as a fleet row or
+    // quorum participant.
+    if (req.has("district_rollup")) return district_note(req);
     // Timed from before the lock: the histogram must show contention (the
     // wait behind a /fleet.json rebuild was exactly the bug), not just the
     // work done once inside.
     int64_t hb_t0 = now_us_steady();
+    JobState& js = job_state(job_of(req));
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<std::mutex> lk(js.mu);
       const std::string replica_id = req.get("replica_id").as_str();
       // Managers stamp the max quorum epoch they have accepted into every
       // heartbeat: this is how a standby (or a resurrected stale primary)
@@ -278,39 +327,50 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
       // been superseded by a takeover — it fences itself out (demotes to
       // standby) instead of competing for the fleet.
       int64_t hb_epoch = req.get("epoch").as_int(0);
-      if (hb_epoch > observed_epoch_) observed_epoch_ = hb_epoch;
-      // Max accepted quorum_id rides the same frames: a standby resumes
-      // numbering above it on takeover (strict monotonicity across
-      // failover, where no disk snapshot is available to restore from).
+      int64_t seen = observed_epoch_.load();
+      while (hb_epoch > seen &&
+             !observed_epoch_.compare_exchange_weak(seen, hb_epoch)) {
+      }
+      // Max accepted quorum_id rides the same frames, tracked PER JOB: a
+      // standby resumes each job's numbering above what that job's fleet
+      // accepted (a global max would inflate job B's ids from job A's).
       int64_t hb_qid = req.get("quorum_id").as_int(0);
-      if (hb_qid > observed_quorum_id_) observed_quorum_id_ = hb_qid;
-      if (active_ && observed_epoch_ > epoch_) {
-        active_ = false;
-        demotions_ += 1;
-        last_reason_ = "fenced: observed epoch " +
-                       std::to_string(observed_epoch_) + " > own epoch " +
-                       std::to_string(epoch_);
-        fprintf(stderr,
-                "[lighthouse] demoting to standby: fleet is on epoch %lld, "
-                "ours is %lld (stale primary fenced out)\n",
-                static_cast<long long>(observed_epoch_),
-                static_cast<long long>(epoch_));
+      if (hb_qid > js.observed_quorum_id) js.observed_quorum_id = hb_qid;
+      if (active_.load() && observed_epoch_.load() > epoch_.load()) {
+        std::lock_guard<std::mutex> plk(persist_mu_);
+        if (active_.load() && observed_epoch_.load() > epoch_.load()) {
+          active_ = false;
+          demotions_ += 1;
+          js.last_reason = "fenced: observed epoch " +
+                           std::to_string(observed_epoch_.load()) +
+                           " > own epoch " + std::to_string(epoch_.load());
+          fprintf(stderr,
+                  "[lighthouse] demoting to standby: fleet is on epoch %lld, "
+                  "ours is %lld (stale primary fenced out)\n",
+                  static_cast<long long>(observed_epoch_.load()),
+                  static_cast<long long>(epoch_.load()));
+        }
       }
       // A drained replica's manager may have one heartbeat in flight when
       // its leave lands; the tombstone keeps it from resurrecting the entry
       // (which would stall the survivors' next quorum until heartbeat
       // expiry).
-      if (!state_.left.count(replica_id)) {
+      if (!js.state.left.count(replica_id)) {
         int64_t now = now_ms();
-        state_.heartbeats[replica_id] = now;
+        // Gate counter: a replica heartbeating but not (yet) registered
+        // holds the "all healthy joined" condition open.
+        if (!js.state.heartbeats.count(replica_id) &&
+            !js.state.participants.count(replica_id))
+          js.hb_not_joined += 1;
+        js.state.heartbeats[replica_id] = now;
         // Heartbeats carry the manager address so drain_all can reach a
         // replica that heartbeats but never registered a quorum.
         const std::string addr = req.get("address").as_str();
-        if (!addr.empty()) state_.heartbeat_addrs[replica_id] = addr;
+        if (!addr.empty()) js.state.heartbeat_addrs[replica_id] = addr;
         // Live fleet plane: fold the optional digest + declared cadence into
         // the fleet table and run the digest-driven anomaly rules. Old
         // clients send neither field; the row simply stays digest-less.
-        fleet_note_heartbeat(replica_id, req, now);
+        fleet_note_heartbeat(js, replica_id, req, now);
       }
     }
     resp["ok"] = Json::of(true);
@@ -319,8 +379,9 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   }
   if (type == "fleet") {
     // Served from the generation-tagged cached snapshot — the framed twin
-    // of GET /fleet.json no longer rebuilds O(N) JSON under mu_.
-    auto snap = fleet_snapshot(now_ms());
+    // of GET /fleet.json no longer rebuilds O(N) JSON under the job lock.
+    // No/empty job = the composite (default + cross-job summary) view.
+    auto snap = fleet_snapshot(req.get("job").as_str(), now_ms());
     resp["ok"] = Json::of(true);
     resp["fleet"] = snap->json;
     return resp;
@@ -328,30 +389,36 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   if (type == "leave") {
     // Graceful drain (no reference analog; the reference only has Kill →
     // exit(1), so survivors always pay the heartbeat-expiry stall). Removing
-    // the member's heartbeat + registration lets the very next tick form the
-    // shrunken quorum: ~quorum_tick_ms of stall instead of
+    // the member's heartbeat + registration lets the very next evaluation
+    // form the shrunken quorum: ~quorum_tick_ms of stall instead of
     // ~heartbeat_timeout_ms.
     const std::string replica_id = req.get("replica_id").as_str();
+    JobState& js = job_state(job_of(req));
     {
-      std::lock_guard<std::mutex> lk(mu_);
-      state_.heartbeats.erase(replica_id);
-      state_.heartbeat_addrs.erase(replica_id);
-      state_.participants.erase(replica_id);
-      state_.left.insert(replica_id);
+      std::lock_guard<std::mutex> lk(js.mu);
+      bool was_part = js.state.participants.count(replica_id) > 0;
+      bool was_hb = js.state.heartbeats.count(replica_id) > 0;
+      js.state.heartbeats.erase(replica_id);
+      js.state.heartbeat_addrs.erase(replica_id);
+      js.state.participants.erase(replica_id);
+      js.state.left.insert(replica_id);
+      if (was_hb && !was_part) js.hb_not_joined -= 1;
+      if (was_part && js.prev_ids.count(replica_id)) js.prev_present -= 1;
       // A drained replica must not linger in the fleet table looking like
       // a straggler whose heartbeats stopped.
-      fleet_erase(replica_id);
+      fleet_erase(js, replica_id);
+      // Proactive evaluation for THIS job only: survivors already blocked
+      // in a quorum RPC see the shrunken membership now, not at the next
+      // timer tick — and sibling jobs are untouched.
+      job_tick_locked(js, now_ms());
     }
-    fprintf(stderr, "[lighthouse] replica %s left gracefully\n",
-            replica_id.c_str());
-    // Proactive tick: survivors already blocked in a quorum RPC see the
-    // shrunken membership now, not at the next timer tick.
-    tick();
+    fprintf(stderr, "[lighthouse] replica %s left gracefully (job %s)\n",
+            replica_id.c_str(), js.name.c_str());
     resp["ok"] = Json::of(true);
     return resp;
   }
   if (type == "quorum") {
-    return quorum_rpc(req, deadline_ms);
+    return quorum_rpc(req, deadline_ms, raw);
   }
   if (type == "status") {
     resp["ok"] = Json::of(true);
@@ -361,16 +428,18 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   if (type == "kill" || type == "drain") {
     // Forward to the member's manager address (kill: lighthouse.rs:454-479;
     // drain: no reference analog — asks the trainer to leave gracefully at
-    // its next step boundary instead of exit(1)).
+    // its next step boundary instead of exit(1)). Lookup is scoped to the
+    // frame's job namespace.
     std::string replica_id = req.get("replica_id").as_str();
+    JobState& js = job_state(job_of(req));
     std::string addr;
     {
-      std::lock_guard<std::mutex> lk(mu_);
-      if (state_.prev_quorum) {
-        for (const auto& m : state_.prev_quorum->participants)
+      std::lock_guard<std::mutex> lk(js.mu);
+      if (js.state.prev_quorum) {
+        for (const auto& m : js.state.prev_quorum->participants)
           if (m.replica_id == replica_id) addr = m.address;
       }
-      for (const auto& kv : state_.participants)
+      for (const auto& kv : js.state.participants)
         if (kv.first == replica_id) addr = kv.second.first.address;
     }
     if (addr.empty()) {
@@ -394,38 +463,37 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
     return resp;
   }
   if (type == "drain_all") {
-    // Operator-initiated FULL-job drain: forward request_drain to every
+    // Operator-initiated FULL drain: forward request_drain to every
     // registered member's manager. Each trainer drains at its own safe
     // boundary (with --durable-dir that includes a final durable
-    // snapshot), so the whole job can be stopped cleanly and relaunched
+    // snapshot), so a whole job can be stopped cleanly and relaunched
     // later — the operator-triggered twin of a whole-pod preemption.
-    // No reference analog (the reference's only job-wide stop is
-    // killing each replica). The flag rides the next quorum response
-    // per member (manager_server.cc request_drain), so for sync-quorum
-    // trainers every group learns it at the SAME sync — no group can
-    // drain a boundary ahead and strand the others' quorum.
+    // A frame with a "job" drains that namespace only; without one it
+    // drains EVERY namespace (the pre-namespace whole-instance semantics).
     // Union of the last formed quorum and any currently-registering
-    // members (same lookup the single-replica drain uses: registration
-    // empties into prev_quorum when a quorum forms, and a drain must
-    // reach members in either place). Live registrations overwrite
-    // stale prev_quorum addresses; tombstoned (already-left) members
-    // are excluded.
+    // members per job (registration empties into prev_quorum when a quorum
+    // forms, and a drain must reach members in either place). Live
+    // registrations overwrite stale prev_quorum addresses; tombstoned
+    // (already-left) members are excluded; heartbeat-only replicas are
+    // reached through their heartbeat-carried addresses.
+    std::vector<JobState*> targets;
+    if (req.has("job") && !req.get("job").as_str().empty()) {
+      targets.push_back(&job_state(job_of(req)));
+    } else {
+      targets = all_jobs();
+    }
     std::map<std::string, std::string> members;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      if (state_.prev_quorum) {
-        for (const auto& m : state_.prev_quorum->participants)
-          if (!state_.left.count(m.replica_id))
+    for (JobState* jsp : targets) {
+      std::lock_guard<std::mutex> lk(jsp->mu);
+      if (jsp->state.prev_quorum) {
+        for (const auto& m : jsp->state.prev_quorum->participants)
+          if (!jsp->state.left.count(m.replica_id))
             members[m.replica_id] = m.address;
       }
-      for (const auto& kv : state_.participants)
+      for (const auto& kv : jsp->state.participants)
         members[kv.first] = kv.second.first.address;
-      // Heartbeat-only replicas (heartbeating but never registered a
-      // quorum) were a drain_all blind spot: they appear in neither
-      // prev_quorum nor participants. Their heartbeat-carried addresses
-      // close it; registered addresses win when both exist.
-      for (const auto& kv : state_.heartbeat_addrs)
-        if (!members.count(kv.first) && !state_.left.count(kv.first))
+      for (const auto& kv : jsp->state.heartbeat_addrs)
+        if (!members.count(kv.first) && !jsp->state.left.count(kv.first))
           members[kv.first] = kv.second;
     }
     Json sent = Json::object();
@@ -461,7 +529,148 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms) {
   return resp;
 }
 
-Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
+void Lighthouse::register_participant_locked(JobState& js,
+                                             const QuorumMember& me) {
+  // Joining is an implicit heartbeat (lighthouse.rs:502-512) and clears any
+  // graceful-leave tombstone (a drained replica relaunching to rejoin).
+  int64_t now = now_ms();
+  js.state.left.erase(me.replica_id);
+  const bool was_part = js.state.participants.count(me.replica_id) > 0;
+  const bool was_hb = js.state.heartbeats.count(me.replica_id) > 0;
+  js.state.heartbeats[me.replica_id] = now;
+  js.state.participants[me.replica_id] = {me, now};
+  if (!was_part) {
+    if (was_hb) js.hb_not_joined -= 1;
+    if (js.prev_ids.count(me.replica_id)) js.prev_present += 1;
+  }
+}
+
+bool Lighthouse::quorum_gate_locked(const JobState& js) const {
+  // O(1) decision: can a quorum POSSIBLY form right now? The gate is
+  // deliberately one-sided — a pass pays the full quorum_compute (which
+  // remains the single source of truth and can still say no); a miss defers
+  // to the periodic tick. A counter bug can therefore only delay a
+  // formation by one tick, never form a wrong quorum.
+  if (!active_.load()) return false;
+  if (js.state.participants.empty()) return false;
+  // Fast-quorum certain: every member of the previous quorum has
+  // re-registered (their registration doubled as a fresh heartbeat).
+  if (js.state.prev_quorum && !js.prev_ids.empty() &&
+      js.prev_present == static_cast<int64_t>(js.prev_ids.size()))
+    return true;
+  // Everyone heartbeating has registered and the floor is met: no straggler
+  // the join-timeout wait would hold the door for.
+  if (static_cast<int64_t>(js.state.participants.size()) >=
+          opts_.min_replicas &&
+      js.hb_not_joined == 0)
+    return true;
+  return false;
+}
+
+void Lighthouse::job_tick_locked(JobState& js, int64_t now) {
+  // A standby absorbs heartbeats (keeping fleet/participant tables warm)
+  // but must not form quorums — there is exactly one epoch owner, and it is
+  // not us until a manager fails over and its quorum request promotes us.
+  if (!active_.load()) {
+    js.last_reason = "standby (not forming quorums)";
+    return;
+  }
+  std::string reason;
+  int64_t q_t0 = now_us_steady();
+  auto members = quorum_compute(now, js.state, opts_, &reason);
+  hist_quorum_.observe_us(now_us_steady() - q_t0);
+  if (!members) {
+    if (reason != js.last_reason && !js.state.participants.empty()) {
+      fprintf(stderr, "[lighthouse] no quorum (job %s): %s\n",
+              js.name.c_str(), reason.c_str());
+    }
+    js.last_reason = reason;
+    return;
+  }
+  // Bump quorum_id only when membership changed or a member reported commit
+  // failures (lighthouse.rs:305-325) — a changed id forces process groups to
+  // reconfigure, so we avoid it when the world is stable.
+  bool bump = false;
+  if (!js.state.prev_quorum) {
+    bump = true;
+  } else if (quorum_changed(js.state.prev_quorum->participants, *members)) {
+    bump = true;
+  } else {
+    for (const auto& m : *members)
+      if (m.commit_failures > 0) bump = true;
+  }
+  if (bump) {
+    // Resume numbering above anything this job's fleet already accepted
+    // (relevant on a takeover or a stateless warm restart).
+    if (js.observed_quorum_id > js.state.quorum_id)
+      js.state.quorum_id = js.observed_quorum_id;
+    js.state.quorum_id += 1;
+    // Fsync the new id BEFORE publishing the quorum: a crash between
+    // publish and persist could otherwise let a warm restart re-issue an id
+    // the fleet has already seen.
+    persist(js.state.quorum_id, js.quorum_gen);
+  }
+
+  // Participant churn across quorum transitions (surfaced via status +
+  // /metrics): a member present now but not in the previous quorum is a
+  // join; one gone is a leave. Covers crash, kill, and graceful drain
+  // uniformly at the granularity monitoring cares about.
+  std::set<std::string> new_ids;
+  for (const auto& m : *members) new_ids.insert(m.replica_id);
+  {
+    std::set<std::string> old_ids;
+    if (js.state.prev_quorum)
+      for (const auto& m : js.state.prev_quorum->participants)
+        old_ids.insert(m.replica_id);
+    for (const auto& id : new_ids)
+      if (!old_ids.count(id)) js.joins_total += 1;
+    for (const auto& id : old_ids)
+      if (!new_ids.count(id)) js.leaves_total += 1;
+  }
+
+  Quorum q;
+  q.quorum_id = js.state.quorum_id;
+  q.participants = *members;
+  q.created_ms = now;
+  q.epoch = epoch_.load();
+  q.generation = js.quorum_gen + 1;
+  q.job = js.name;
+  js.state.prev_quorum = q;
+  js.state.participants.clear();  // next round starts fresh (lighthouse.rs:336)
+  // Reset the gate counters for the next round: nobody from the new quorum
+  // has re-registered yet, and with participants cleared every heartbeating
+  // replica is momentarily unregistered.
+  js.prev_ids = new_ids;
+  js.prev_present = 0;
+  js.hb_not_joined = static_cast<int64_t>(js.state.heartbeats.size());
+  js.last_quorum = q;
+  // Serialize the broadcast ONCE: every in-quorum waiter (and its
+  // connection loop) sends these exact bytes, turning the O(N^2)
+  // per-waiter to_json+dump fan-out into a single O(N) build.
+  {
+    Json bresp = Json::object();
+    bresp["ok"] = Json::of(true);
+    bresp["quorum"] = q.to_json();
+    js.quorum_payload = std::make_shared<const std::string>(bresp.dump());
+  }
+  js.quorum_gen += 1;
+  js.last_reason.clear();
+  fprintf(stderr, "[lighthouse] quorum %lld formed with %zu members (job %s)\n",
+          static_cast<long long>(q.quorum_id), q.participants.size(),
+          js.name.c_str());
+  if (std::getenv("TORCHFT_LH_DEBUG") != nullptr) {
+    std::string ids;
+    for (const auto& m : q.participants) ids += m.replica_id + " ";
+    fprintf(stderr, "[lighthouse] +%lld formed gen=%lld job=%s members: %s\n",
+            static_cast<long long>(now_ms() % 1000000),
+            static_cast<long long>(js.quorum_gen), js.name.c_str(),
+            ids.c_str());
+  }
+  js.cv.notify_all();
+}
+
+Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms,
+                            std::shared_ptr<const std::string>* raw) {
   QuorumMember me = QuorumMember::from_json(req.get("requester"));
   Json resp = Json::object();
   if (me.replica_id.empty()) {
@@ -470,48 +679,54 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
     return resp;
   }
   const bool debug = std::getenv("TORCHFT_LH_DEBUG") != nullptr;
-  std::unique_lock<std::mutex> lk(mu_);
+  JobState& js = job_state(job_of(req));
+  std::unique_lock<std::mutex> lk(js.mu);
   // Warm-standby takeover: managers only send quorum RPCs to their active
   // target, so a quorum request arriving at a standby means the fleet's
   // lease on the old primary lapsed and failover chose us. Claim the reign
   // with a strictly higher epoch than anything observed (fencing out the
   // old primary) and persist it before serving a single quorum.
-  if (!active_) {
-    epoch_ = std::max(epoch_, observed_epoch_) + 1;
-    // Resume quorum numbering above anything the fleet accepted from the
-    // old primary: each quorum_id must have exactly one (epoch) owner.
-    state_.quorum_id = std::max(state_.quorum_id, observed_quorum_id_);
-    active_ = true;
-    takeovers_ += 1;
-    persist_locked();
-    fprintf(stderr,
-            "[lighthouse] standby takeover: now active with epoch %lld "
-            "(first quorum request from %s)\n",
-            static_cast<long long>(epoch_), me.replica_id.c_str());
+  if (!active_.load()) {
+    std::lock_guard<std::mutex> plk(persist_mu_);
+    if (!active_.load()) {
+      epoch_ = std::max(epoch_.load(), observed_epoch_.load()) + 1;
+      // Resume this job's quorum numbering above anything its fleet
+      // accepted from the old primary: each quorum_id must have exactly
+      // one (epoch) owner.
+      js.state.quorum_id =
+          std::max(js.state.quorum_id, js.observed_quorum_id);
+      active_ = true;
+      takeovers_ += 1;
+      persist_locked(js.state.quorum_id, js.quorum_gen);
+      fprintf(stderr,
+              "[lighthouse] standby takeover: now active with epoch %lld "
+              "(first quorum request from %s, job %s)\n",
+              static_cast<long long>(epoch_.load()), me.replica_id.c_str(),
+              js.name.c_str());
+    }
   }
-  // Joining is an implicit heartbeat (lighthouse.rs:502-512) and clears any
-  // graceful-leave tombstone (a drained replica relaunching to rejoin).
-  state_.left.erase(me.replica_id);
-  state_.heartbeats[me.replica_id] = now_ms();
-  state_.participants[me.replica_id] = {me, now_ms()};
-  int64_t my_gen = quorum_gen_;
+  register_participant_locked(js, me);
+  int64_t my_gen = js.quorum_gen;
   if (debug) {
-    fprintf(stderr, "[lighthouse] +%lld register %s step=%lld gen=%lld pool=%zu\n",
-            static_cast<long long>(now_ms() % 1000000),
-            me.replica_id.c_str(), static_cast<long long>(me.step),
-            static_cast<long long>(my_gen), state_.participants.size());
+    fprintf(stderr,
+            "[lighthouse] +%lld register %s job=%s step=%lld gen=%lld "
+            "pool=%zu\n",
+            static_cast<long long>(now_ms() % 1000000), me.replica_id.c_str(),
+            js.name.c_str(), static_cast<long long>(me.step),
+            static_cast<long long>(my_gen), js.state.participants.size());
   }
-  lk.unlock();
-  // Proactive tick so a completing quorum doesn't wait for the next timer
-  // tick (lighthouse.rs:516-518).
-  tick();
-  lk.lock();
+  // Incremental quorum: the O(1) gate decides whether this registration
+  // could complete a quorum; only then does the full quorum_compute run —
+  // inline, still under the job lock, replacing the per-registration
+  // unconditional full tick (the O(N^2) storm behind the 4 s formations at
+  // N=1024). A gate miss is covered by the periodic tick.
+  if (quorum_gate_locked(js)) job_tick_locked(js, now_ms());
 
   while (running_) {
     // Wait for a fresh quorum broadcast.
-    while (running_ && quorum_gen_ == my_gen) {
-      if (cv_.wait_until(lk, std::chrono::system_clock::time_point(
-                                 std::chrono::milliseconds(deadline_ms))) ==
+    while (running_ && js.quorum_gen == my_gen) {
+      if (js.cv.wait_until(lk, std::chrono::system_clock::time_point(
+                                   std::chrono::milliseconds(deadline_ms))) ==
           std::cv_status::timeout) {
         if (now_ms() >= deadline_ms) {
           resp["ok"] = Json::of(false);
@@ -522,21 +737,25 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
       }
     }
     if (!running_) break;
-    my_gen = quorum_gen_;
-    if (last_quorum_) {
-      bool in_quorum = false;
-      for (const auto& m : last_quorum_->participants)
-        if (m.replica_id == me.replica_id) in_quorum = true;
-      if (in_quorum) {
+    my_gen = js.quorum_gen;
+    if (js.last_quorum) {
+      // prev_ids is exactly the broadcast quorum's member set (assigned
+      // together with last_quorum at formation): O(log N) membership
+      // instead of a per-waiter linear scan.
+      if (js.prev_ids.count(me.replica_id)) {
+        if (raw && js.quorum_payload) {
+          *raw = js.quorum_payload;  // shared prebuilt bytes, no re-dump
+          return resp;
+        }
         resp["ok"] = Json::of(true);
-        resp["quorum"] = last_quorum_->to_json();
+        resp["quorum"] = js.last_quorum->to_json();
         return resp;
       }
       // Delivered quorum doesn't include us (we joined too late): rejoin and
       // wait for the next one (lighthouse.rs:523-544).
-      state_.left.erase(me.replica_id);
-      state_.heartbeats[me.replica_id] = now_ms();
-      state_.participants[me.replica_id] = {me, now_ms()};
+      register_participant_locked(js, me);
+      if (quorum_gate_locked(js)) job_tick_locked(js, now_ms());
+      if (js.quorum_gen != my_gen) continue;  // formed inline; re-check
     }
   }
   resp["ok"] = Json::of(false);
@@ -545,36 +764,66 @@ Json Lighthouse::quorum_rpc(const Json& req, int64_t deadline_ms) {
 }
 
 Json Lighthouse::status_json() {
-  std::lock_guard<std::mutex> lk(mu_);
-  Json s = Json::object();
-  s["quorum_id"] = Json::of(state_.quorum_id);
-  s["quorum_generation"] = Json::of(quorum_gen_);
-  s["joins_total"] = Json::of(joins_total_);
-  s["leaves_total"] = Json::of(leaves_total_);
-  s["epoch"] = Json::of(epoch_);
-  s["observed_epoch"] = Json::of(observed_epoch_);
-  s["observed_quorum_id"] = Json::of(observed_quorum_id_);
-  s["role"] = Json::of(std::string(active_ ? "active" : "standby"));
-  s["takeovers"] = Json::of(takeovers_);
-  s["demotions"] = Json::of(demotions_);
   int64_t now = now_ms();
-  Json hb = Json::object();
-  for (const auto& kv : state_.heartbeats)
-    hb[kv.first] = Json::of(now - kv.second);
-  s["heartbeat_ages_ms"] = hb;
-  Json parts = Json::array();
-  for (const auto& kv : state_.participants)
-    parts.push(kv.second.first.to_json());
-  s["participants"] = parts;
-  s["prev_quorum"] =
-      state_.prev_quorum ? state_.prev_quorum->to_json() : Json::null();
-  Json left = Json::array();
-  for (const auto& id : state_.left) left.push(Json::of(id));
-  s["left"] = left;
-  s["reason"] = Json::of(last_reason_);
-  // Live-plane summary rides along so a status poller sees fleet health
-  // without a second RPC; the full table stays on /fleet.json.
-  s["fleet"] = fleet_summary_locked(now);
+  Json s = Json::object();
+  // Top-level keys keep the pre-namespace schema, reporting the DEFAULT
+  // job's island (what old dashboards and tests read); the per-job map
+  // below carries every namespace including default.
+  {
+    JobState& js = job_state("default");
+    std::lock_guard<std::mutex> lk(js.mu);
+    s["quorum_id"] = Json::of(js.state.quorum_id);
+    s["quorum_generation"] = Json::of(js.quorum_gen);
+    s["joins_total"] = Json::of(js.joins_total);
+    s["leaves_total"] = Json::of(js.leaves_total);
+    s["epoch"] = Json::of(epoch_.load());
+    s["observed_epoch"] = Json::of(observed_epoch_.load());
+    s["observed_quorum_id"] = Json::of(js.observed_quorum_id);
+    s["role"] = Json::of(std::string(active_.load() ? "active" : "standby"));
+    s["takeovers"] = Json::of(takeovers_.load());
+    s["demotions"] = Json::of(demotions_.load());
+    Json hb = Json::object();
+    for (const auto& kv : js.state.heartbeats)
+      hb[kv.first] = Json::of(now - kv.second);
+    s["heartbeat_ages_ms"] = hb;
+    Json parts = Json::array();
+    for (const auto& kv : js.state.participants)
+      parts.push(kv.second.first.to_json());
+    s["participants"] = parts;
+    s["prev_quorum"] =
+        js.state.prev_quorum ? js.state.prev_quorum->to_json() : Json::null();
+    Json left = Json::array();
+    for (const auto& id : js.state.left) left.push(Json::of(id));
+    s["left"] = left;
+    s["reason"] = Json::of(js.last_reason);
+    // Live-plane summary rides along so a status poller sees fleet health
+    // without a second RPC; the full table stays on /fleet.json.
+    s["fleet"] = fleet_summary_locked(js, now);
+  }
+  // Per-job sections: one summary per namespace island, gathered by
+  // locking each island one at a time (never two job locks at once).
+  Json jobs = Json::object();
+  for (JobState* jsp : all_jobs()) {
+    std::lock_guard<std::mutex> lk(jsp->mu);
+    Json j = Json::object();
+    j["quorum_id"] = Json::of(jsp->state.quorum_id);
+    j["quorum_generation"] = Json::of(jsp->quorum_gen);
+    j["participants"] =
+        Json::of(static_cast<int64_t>(jsp->state.participants.size()));
+    j["members"] = Json::of(
+        jsp->state.prev_quorum
+            ? static_cast<int64_t>(jsp->state.prev_quorum->participants.size())
+            : int64_t{0});
+    j["heartbeats"] =
+        Json::of(static_cast<int64_t>(jsp->state.heartbeats.size()));
+    j["joins_total"] = Json::of(jsp->joins_total);
+    j["leaves_total"] = Json::of(jsp->leaves_total);
+    j["reason"] = Json::of(jsp->last_reason);
+    j["fleet"] = fleet_summary_locked(*jsp, now);
+    jobs[jsp->name] = j;
+  }
+  s["jobs"] = jobs;
+  s["districts"] = districts_json(now);
   // Hot-path latency histograms (p50/p95/p99 in microseconds, upper-bound
   // estimates from the log buckets — same semantics as telemetry
   // span_percentiles on the Python side).
@@ -607,7 +856,82 @@ Json Lighthouse::hist_json() const {
 }
 
 // ---------------------------------------------------------------------------
-// Live fleet health plane
+// Federation: root-side district table
+// ---------------------------------------------------------------------------
+
+Json Lighthouse::district_note(const Json& req) {
+  const std::string name = req.get("district").as_str();
+  const int64_t ep = req.get("epoch").as_int(0);
+  Json resp = Json::object();
+  if (name.empty()) {
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of("district rollup missing district name");
+    return resp;
+  }
+  std::lock_guard<std::mutex> lk(districts_mu_);
+  DistrictEntry& e = districts_[name];
+  // Per-district fence: a rollup stamped with an epoch below the highest
+  // this district has reported is the fenced old primary still talking
+  // after a failover — drop it so the root's view can't flap backwards.
+  if (ep < e.epoch) {
+    e.stale_dropped += 1;
+    resp["ok"] = Json::of(false);
+    resp["error"] = Json::of("stale district epoch");
+    return resp;
+  }
+  if (ep > e.epoch && e.hb_count > 0) {
+    // Epoch advance from a district we already knew = its lighthouse
+    // failed over (standby takeover bumps the epoch). Only this district's
+    // row changes; siblings and other jobs' tables are untouched.
+    e.failovers += 1;
+    fprintf(stderr,
+            "[lighthouse] district %s failed over: epoch %lld -> %lld\n",
+            name.c_str(), static_cast<long long>(e.epoch),
+            static_cast<long long>(ep));
+  }
+  e.epoch = ep;
+  e.last_hb_ms = now_ms();
+  e.hb_count += 1;
+  e.lost = false;
+  e.rollup = req.get("district_rollup");
+  resp["ok"] = Json::of(true);
+  return resp;
+}
+
+void Lighthouse::district_scan(int64_t now) {
+  std::lock_guard<std::mutex> lk(districts_mu_);
+  for (auto& kv : districts_) {
+    DistrictEntry& e = kv.second;
+    if (!e.lost && now - e.last_hb_ms > opts_.heartbeat_timeout_ms) {
+      e.lost = true;
+      district_losses_ += 1;
+      fprintf(stderr,
+              "[lighthouse] district %s lost: no rollup for %lld ms\n",
+              kv.first.c_str(), static_cast<long long>(now - e.last_hb_ms));
+    }
+  }
+}
+
+Json Lighthouse::districts_json(int64_t now) {
+  std::lock_guard<std::mutex> lk(districts_mu_);
+  Json out = Json::object();
+  for (const auto& kv : districts_) {
+    const DistrictEntry& e = kv.second;
+    Json d = Json::object();
+    d["age_ms"] = Json::of(now - e.last_hb_ms);
+    d["epoch"] = Json::of(e.epoch);
+    d["hb_count"] = Json::of(e.hb_count);
+    d["failovers"] = Json::of(e.failovers);
+    d["stale_dropped"] = Json::of(e.stale_dropped);
+    d["lost"] = Json::of(e.lost);
+    d["jobs"] = e.rollup.get("jobs");
+    out[kv.first] = d;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Live fleet health plane (per job)
 // ---------------------------------------------------------------------------
 
 namespace {
@@ -633,77 +957,80 @@ int64_t Lighthouse::fleet_jitter_budget_ms(const FleetEntry& e) const {
   return base < kFleetJitterFloorMs ? kFleetJitterFloorMs : base;
 }
 
-void Lighthouse::fleet_set_flag(const std::string& replica_id, FleetEntry& e,
-                                const std::string& kind, int64_t now,
-                                Json detail) {
+void Lighthouse::fleet_set_flag(JobState& js, const std::string& replica_id,
+                                FleetEntry& e, const std::string& kind,
+                                int64_t now, Json detail) {
   e.straggler_until_ms = now + kFleetStickyMs;
-  fleet_gen_ += 1;  // sticky-window extension alone changes the table view
+  js.fleet_gen += 1;  // sticky-window extension alone changes the table view
   if (e.flags.count(kind)) return;  // only the RISE edge is an anomaly
-  if (e.flags.empty()) flagged_ += 1;
+  if (e.flags.empty()) js.flagged += 1;
   e.flags.insert(kind);
-  anomaly_seq_ += 1;
+  js.anomaly_seq += 1;
   Json a = Json::object();
-  a["seq"] = Json::of(anomaly_seq_);
+  a["seq"] = Json::of(js.anomaly_seq);
   a["ts_ms"] = Json::of(now);
   a["replica_id"] = Json::of(replica_id);
   a["kind"] = Json::of(kind);
+  a["job"] = Json::of(js.name);
   a["detail"] = detail;
-  anomalies_.push_back(a);
-  while (anomalies_.size() > kFleetAnomalyRing) {
+  js.anomalies.push_back(a);
+  while (js.anomalies.size() > kFleetAnomalyRing) {
     // At fleet scale the ring overflows routinely; a silent pop would make
     // the anomaly feed look complete when it is not. The drop count rides
     // /fleet.json + /metrics, and obs_export journals the rise edge.
-    anomalies_.pop_front();
-    anomalies_dropped_ += 1;
+    js.anomalies.pop_front();
+    js.anomalies_dropped += 1;
   }
-  fprintf(stderr, "[lighthouse] anomaly #%lld: %s on %s %s\n",
-          static_cast<long long>(anomaly_seq_), kind.c_str(),
-          replica_id.c_str(), detail.dump().c_str());
+  fprintf(stderr, "[lighthouse] anomaly #%lld: %s on %s (job %s) %s\n",
+          static_cast<long long>(js.anomaly_seq), kind.c_str(),
+          replica_id.c_str(), js.name.c_str(), detail.dump().c_str());
 }
 
-void Lighthouse::fleet_clear_flag(FleetEntry& e, const std::string& kind) {
+void Lighthouse::fleet_clear_flag(JobState& js, FleetEntry& e,
+                                  const std::string& kind) {
   if (e.flags.erase(kind) == 0) return;
-  if (e.flags.empty()) flagged_ -= 1;
-  fleet_gen_ += 1;
+  if (e.flags.empty()) js.flagged -= 1;
+  js.fleet_gen += 1;
 }
 
 // Retire / fold one entry's digest contributions. Together these keep the
 // running aggregates exactly equal to a full-table recompute: every digest
 // row contributes its step and goodput, its rate only when > 0 (matching
 // the old scan's filter), and its commit-failure streak to the max-tracker.
-void Lighthouse::fleet_agg_remove(const FleetEntry& e) {
+void Lighthouse::fleet_agg_remove(JobState& js, const FleetEntry& e) {
   if (!e.has_digest) return;
   double r = e.digest.get("rate").as_double(0.0);
-  if (r > 0.0) agg_rates_.erase(r);
-  agg_steps_.erase(static_cast<double>(e.digest.get("step").as_int(0)));
-  agg_gps_.erase(e.digest.get("gp").as_double(0.0));
-  auto it = agg_cfs_.find(e.digest.get("cf").as_int(0));
-  if (it != agg_cfs_.end()) agg_cfs_.erase(it);
-  n_digest_ -= 1;
+  if (r > 0.0) js.agg_rates.erase(r);
+  js.agg_steps.erase(static_cast<double>(e.digest.get("step").as_int(0)));
+  js.agg_gps.erase(e.digest.get("gp").as_double(0.0));
+  auto it = js.agg_cfs.find(e.digest.get("cf").as_int(0));
+  if (it != js.agg_cfs.end()) js.agg_cfs.erase(it);
+  js.n_digest -= 1;
 }
 
-void Lighthouse::fleet_agg_insert(const FleetEntry& e) {
+void Lighthouse::fleet_agg_insert(JobState& js, const FleetEntry& e) {
   if (!e.has_digest) return;
   double r = e.digest.get("rate").as_double(0.0);
-  if (r > 0.0) agg_rates_.insert(r);
-  agg_steps_.insert(static_cast<double>(e.digest.get("step").as_int(0)));
-  agg_gps_.insert(e.digest.get("gp").as_double(0.0));
-  agg_cfs_.insert(e.digest.get("cf").as_int(0));
-  n_digest_ += 1;
+  if (r > 0.0) js.agg_rates.insert(r);
+  js.agg_steps.insert(static_cast<double>(e.digest.get("step").as_int(0)));
+  js.agg_gps.insert(e.digest.get("gp").as_double(0.0));
+  js.agg_cfs.insert(e.digest.get("cf").as_int(0));
+  js.n_digest += 1;
 }
 
-void Lighthouse::fleet_erase(const std::string& replica_id) {
-  auto it = fleet_.find(replica_id);
-  if (it == fleet_.end()) return;
-  fleet_agg_remove(it->second);
-  if (!it->second.flags.empty()) flagged_ -= 1;
-  fleet_.erase(it);
-  fleet_gen_ += 1;
+void Lighthouse::fleet_erase(JobState& js, const std::string& replica_id) {
+  auto it = js.fleet.find(replica_id);
+  if (it == js.fleet.end()) return;
+  fleet_agg_remove(js, it->second);
+  if (!it->second.flags.empty()) js.flagged -= 1;
+  js.fleet.erase(it);
+  js.fleet_gen += 1;
 }
 
-void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
+void Lighthouse::fleet_note_heartbeat(JobState& js,
+                                      const std::string& replica_id,
                                       const Json& req, int64_t now) {
-  FleetEntry& e = fleet_[replica_id];
+  FleetEntry& e = js.fleet[replica_id];
   if (e.hb_count > 0) {
     int64_t gap = now - e.last_hb_ms;
     // Judge the gap against the budget BEFORE folding it into the EWMA —
@@ -714,7 +1041,7 @@ void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
       Json d = Json::object();
       d["gap_ms"] = Json::of(gap);
       d["budget_ms"] = Json::of(fleet_jitter_budget_ms(e));
-      fleet_set_flag(replica_id, e, "hb_jitter", now, d);
+      fleet_set_flag(js, replica_id, e, "hb_jitter", now, d);
       e.last_jitter_ms = now;
     }
     e.hb_gap_ewma_ms = e.hb_gap_ewma_ms == 0.0
@@ -723,65 +1050,66 @@ void Lighthouse::fleet_note_heartbeat(const std::string& replica_id,
   }
   e.last_hb_ms = now;
   e.hb_count += 1;
-  fleet_gen_ += 1;
+  js.fleet_gen += 1;
   int64_t declared = req.get("hb_interval_ms").as_int(0);
   if (declared > 0) e.hb_interval_ms = declared;
   if (!req.has("digest") || !req.get("digest").is_object()) return;
 
-  // Digest-driven rules run at ARRIVAL, against the fleet table as of this
-  // heartbeat: given the same global digest sequence the flag/anomaly
-  // sequence is identical, so a chaos replay reproduces its alerts.
+  // Digest-driven rules run at ARRIVAL, against the job's fleet table as of
+  // this heartbeat: given the same per-job digest sequence the flag/anomaly
+  // sequence is identical, so a chaos replay reproduces its alerts — and a
+  // sibling job's digests can never perturb it.
   // Bounded-cost contract: everything below is O(log N) — the medians the
   // rules compare against come from the running trackers, never from a
   // full-table rescan (tests/test_fleet.py pins tracker == recompute).
   int64_t an_t0 = now_us_steady();
-  fleet_agg_remove(e);  // retire the previous digest's contributions
+  fleet_agg_remove(js, e);  // retire the previous digest's contributions
   e.digest = req.get("digest");
   e.has_digest = true;
   e.digest_ms = now;
-  fleet_agg_insert(e);
+  fleet_agg_insert(js, e);
 
   int64_t cf = e.digest.get("cf").as_int(0);
   if (cf >= kFleetCommitStall) {
     Json d = Json::object();
     d["cf"] = Json::of(cf);
-    fleet_set_flag(replica_id, e, "commit_stall", now, d);
+    fleet_set_flag(js, replica_id, e, "commit_stall", now, d);
   } else {
-    fleet_clear_flag(e, "commit_stall");
+    fleet_clear_flag(js, e, "commit_stall");
   }
 
   double own_rate = e.digest.get("rate").as_double(0.0);
-  if (agg_rates_.size() >= 2) {
-    double med = agg_rates_.median();
+  if (js.agg_rates.size() >= 2) {
+    double med = js.agg_rates.median();
     if (own_rate < kFleetSlowRateFrac * med) {
       Json d = Json::object();
       d["rate"] = Json::of(own_rate);
       d["median_rate"] = Json::of(med);
-      fleet_set_flag(replica_id, e, "slow_rate", now, d);
+      fleet_set_flag(js, replica_id, e, "slow_rate", now, d);
     } else {
-      fleet_clear_flag(e, "slow_rate");
+      fleet_clear_flag(js, e, "slow_rate");
     }
   }
   int64_t own_step = e.digest.get("step").as_int(0);
-  if (agg_steps_.size() >= 2) {
-    int64_t med = static_cast<int64_t>(agg_steps_.median());
+  if (js.agg_steps.size() >= 2) {
+    int64_t med = static_cast<int64_t>(js.agg_steps.median());
     if (own_step < med - kFleetStepLag) {
       Json d = Json::object();
       d["step"] = Json::of(own_step);
       d["median_step"] = Json::of(med);
-      fleet_set_flag(replica_id, e, "step_lag", now, d);
+      fleet_set_flag(js, replica_id, e, "step_lag", now, d);
     } else {
-      fleet_clear_flag(e, "step_lag");
+      fleet_clear_flag(js, e, "step_lag");
     }
   }
   hist_anomaly_.observe_us(now_us_steady() - an_t0);
 }
 
-void Lighthouse::fleet_scan_locked(int64_t now) {
+void Lighthouse::fleet_scan_locked(JobState& js, int64_t now) {
   // Time-based rules only: an OPEN heartbeat gap (the replica is wedged
   // RIGHT NOW — arrival-side checks can't see it because nothing arrives)
   // plus expiry of a jitter flag whose evidence has aged out.
-  for (auto& kv : fleet_) {
+  for (auto& kv : js.fleet) {
     FleetEntry& e = kv.second;
     bool budget_valid =
         e.hb_interval_ms > 0 || e.hb_count >= kFleetEwmaWarmup;
@@ -791,11 +1119,11 @@ void Lighthouse::fleet_scan_locked(int64_t now) {
       d["gap_ms"] = Json::of(open_gap);
       d["budget_ms"] = Json::of(fleet_jitter_budget_ms(e));
       d["open"] = Json::of(true);
-      fleet_set_flag(kv.first, e, "hb_jitter", now, d);
+      fleet_set_flag(js, kv.first, e, "hb_jitter", now, d);
       e.last_jitter_ms = now;
     } else if (e.flags.count("hb_jitter") &&
                now - e.last_jitter_ms > kFleetStickyMs) {
-      fleet_clear_flag(e, "hb_jitter");
+      fleet_clear_flag(js, e, "hb_jitter");
     }
   }
 }
@@ -804,66 +1132,74 @@ void Lighthouse::fleet_scan_locked(int64_t now) {
 // one allocation-free pass for the time-dependent straggler count. This is
 // the "agg" the property tests compare against a full recompute from the
 // row dicts in the same payload.
-Json Lighthouse::fleet_agg_locked(int64_t now) {
+Json Lighthouse::fleet_agg_locked(JobState& js, int64_t now) {
   int64_t n_straggler = 0;
-  for (const auto& kv : fleet_)
+  for (const auto& kv : js.fleet)
     if (!kv.second.flags.empty() || now < kv.second.straggler_until_ms)
       n_straggler += 1;
   Json agg = Json::object();
-  agg["n"] = Json::of(static_cast<int64_t>(fleet_.size()));
-  agg["n_digest"] = Json::of(n_digest_);
+  agg["n"] = Json::of(static_cast<int64_t>(js.fleet.size()));
+  agg["n_digest"] = Json::of(js.n_digest);
   agg["stragglers"] = Json::of(n_straggler);
-  agg["median_rate"] = agg_rates_.size() == 0
+  agg["median_rate"] = js.agg_rates.size() == 0
                            ? Json::null()
-                           : Json::of(agg_rates_.median());
+                           : Json::of(js.agg_rates.median());
   agg["median_step"] =
-      agg_steps_.size() == 0
+      js.agg_steps.size() == 0
           ? Json::null()
-          : Json::of(static_cast<int64_t>(agg_steps_.median()));
+          : Json::of(static_cast<int64_t>(js.agg_steps.median()));
   agg["median_goodput"] =
-      agg_gps_.size() == 0 ? Json::null() : Json::of(agg_gps_.median());
+      js.agg_gps.size() == 0 ? Json::null() : Json::of(js.agg_gps.median());
   agg["max_commit_failures"] =
-      Json::of(agg_cfs_.empty() ? int64_t{0} : *agg_cfs_.rbegin());
-  agg["anomalies_dropped"] = Json::of(anomalies_dropped_);
+      Json::of(js.agg_cfs.empty() ? int64_t{0} : *js.agg_cfs.rbegin());
+  agg["anomalies_dropped"] = Json::of(js.anomalies_dropped);
   // Elastic-membership view: current quorum size plus cumulative
   // join/leave churn, so obs_top's WORLD column tracks capacity changes
   // (deliberate scale-up/down AND crash churn) from the same counters
   // /metrics exports.
   agg["quorum_world"] = Json::of(
-      last_quorum_ ? static_cast<int64_t>(last_quorum_->participants.size())
-                   : int64_t{0});
-  agg["joins_total"] = Json::of(joins_total_);
-  agg["leaves_total"] = Json::of(leaves_total_);
+      js.last_quorum ? static_cast<int64_t>(js.last_quorum->participants.size())
+                     : int64_t{0});
+  agg["joins_total"] = Json::of(js.joins_total);
+  agg["leaves_total"] = Json::of(js.leaves_total);
   // Control-plane ownership view: the fencing epoch this instance stamps on
   // quorums (obs_top's EPOCH column). A jump means a standby takeover; a
   // reader comparing two lighthouses can tell owner from fenced stale
   // primary by it.
-  agg["epoch"] = Json::of(epoch_);
+  agg["epoch"] = Json::of(epoch_.load());
   return agg;
 }
 
 std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
-    int64_t now) {
+    const std::string& job, int64_t now) {
+  // Empty job = the composite view: served FROM the default island's cache
+  // slot (its payload extended with the cross-job summary + districts), so
+  // pre-namespace consumers keep the old top-level schema while each job's
+  // full table stays per-job. Keyed per island: one job's content change
+  // never rebuilds, or serves a stale gen to, another job.
+  const std::string jname = job.empty() ? "default" : job;
+  const bool composite = jname == "default";
+  JobState& js = job_state(jname);
   // Bounded staleness: any cached payload younger than fleet_snap_ms is
   // served as-is (fleet_snap_ms == 0 disables caching — the "before" mode
   // the fleet_load harness benchmarks against).
   if (opts_.fleet_snap_ms > 0) {
-    std::lock_guard<std::mutex> lk(snap_mu_);
-    if (snap_ && now >= snap_->built_ms &&
-        now - snap_->built_ms <= opts_.fleet_snap_ms)
-      return snap_;
+    std::lock_guard<std::mutex> lk(js.snap_mu);
+    if (js.snap && now >= js.snap->built_ms &&
+        now - js.snap->built_ms <= opts_.fleet_snap_ms)
+      return js.snap;
   }
   // Single-flight rebuild: concurrent readers that all see a stale (or
   // absent) snapshot would otherwise each pay the O(N) rebuild at once —
   // a thundering herd that turns the cache off exactly when load peaks.
   // One caller rebuilds; the rest block here, then re-check and serve the
   // winner's result.
-  std::lock_guard<std::mutex> rebuild_lk(rebuild_mu_);
+  std::lock_guard<std::mutex> rebuild_lk(js.rebuild_mu);
   if (opts_.fleet_snap_ms > 0) {
-    std::lock_guard<std::mutex> lk(snap_mu_);
-    if (snap_ && now >= snap_->built_ms &&
-        now - snap_->built_ms <= opts_.fleet_snap_ms)
-      return snap_;
+    std::lock_guard<std::mutex> lk(js.snap_mu);
+    if (js.snap && now >= js.snap->built_ms &&
+        now - js.snap->built_ms <= opts_.fleet_snap_ms)
+      return js.snap;
   }
   int64_t t0 = now_us_steady();
   // Copy raw state under the hot lock; build + dump the JSON off it. The
@@ -874,12 +1210,12 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   Json agg;
   int64_t gen, aseq;
   {
-    std::lock_guard<std::mutex> lk(mu_);
-    rows.assign(fleet_.begin(), fleet_.end());
-    anomalies = anomalies_;
-    agg = fleet_agg_locked(now);
-    gen = fleet_gen_;
-    aseq = anomaly_seq_;
+    std::lock_guard<std::mutex> lk(js.mu);
+    rows.assign(js.fleet.begin(), js.fleet.end());
+    anomalies = js.anomalies;
+    agg = fleet_agg_locked(js, now);
+    gen = js.fleet_gen;
+    aseq = js.anomaly_seq;
   }
   auto snap = std::make_shared<FleetSnapshot>();
   snap->gen = gen;
@@ -888,6 +1224,7 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   f["ts_ms"] = Json::of(now);
   f["gen"] = Json::of(gen);
   f["snap_ms"] = Json::of(opts_.fleet_snap_ms);
+  f["job"] = Json::of(jname);
   Json reps = Json::object();
   for (const auto& kv : rows) {
     const FleetEntry& e = kv.second;
@@ -914,18 +1251,31 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   for (const auto& a : anomalies) an.push(a);
   f["anomalies"] = an;
   f["anomaly_seq"] = Json::of(aseq);
+  if (composite) {
+    // Cross-job summary map + district table ride the composite payload
+    // only — SUMMARIES, not full tables, so the default payload stays O(N
+    // of default) + O(jobs) and per-job readers use ?job=<id>. Each
+    // sibling island is locked one at a time, off this island's hot path.
+    Json jobs = Json::object();
+    for (JobState* oj : all_jobs()) {
+      std::lock_guard<std::mutex> olk(oj->mu);
+      jobs[oj->name] = fleet_summary_locked(*oj, now);
+    }
+    f["jobs"] = jobs;
+    f["districts"] = districts_json(now);
+  }
   snap->json = f;
   snap->body = f.dump();
   hist_snapshot_.observe_us(now_us_steady() - t0);
-  std::lock_guard<std::mutex> lk(snap_mu_);
-  snap_ = snap;
-  return snap_;
+  std::lock_guard<std::mutex> lk(js.snap_mu);
+  js.snap = snap;
+  return js.snap;
 }
 
-Json Lighthouse::fleet_summary_locked(int64_t now) {
-  Json s = fleet_agg_locked(now);
-  s["anomaly_seq"] = Json::of(anomaly_seq_);
-  s["gen"] = Json::of(fleet_gen_);
+Json Lighthouse::fleet_summary_locked(JobState& js, int64_t now) {
+  Json s = fleet_agg_locked(js, now);
+  s["anomaly_seq"] = Json::of(js.anomaly_seq);
+  s["gen"] = Json::of(js.fleet_gen);
   return s;
 }
 
@@ -950,6 +1300,30 @@ std::string Lighthouse::render_status_html() {
   html << "</table><p><form method=post action=\"/drain_all\" "
           "style=\"display:inline\"><button>drain ALL (stop job "
           "cleanly)</button></form></p>";
+  // Namespace overview: one row per job island (quorum + fleet summary).
+  html << "<h2>jobs</h2><table><tr><th>job</th><th>quorum_id</th>"
+       << "<th>members</th><th>participants</th><th>heartbeats</th></tr>";
+  for (const auto& kv : s.get("jobs").obj) {
+    html << "<tr><td>" << kv.first << "</td><td>"
+         << kv.second.get("quorum_id").as_int() << "</td><td>"
+         << kv.second.get("members").as_int() << "</td><td>"
+         << kv.second.get("participants").as_int() << "</td><td>"
+         << kv.second.get("heartbeats").as_int() << "</td></tr>";
+  }
+  html << "</table>";
+  if (!s.get("districts").obj.empty()) {
+    html << "<h2>districts</h2><table><tr><th>district</th><th>epoch</th>"
+         << "<th>age (ms)</th><th>failovers</th><th>lost</th></tr>";
+    for (const auto& kv : s.get("districts").obj) {
+      html << "<tr><td>" << kv.first << "</td><td>"
+           << kv.second.get("epoch").as_int() << "</td><td>"
+           << kv.second.get("age_ms").as_int() << "</td><td>"
+           << kv.second.get("failovers").as_int() << "</td><td>"
+           << (kv.second.get("lost").as_bool() ? "LOST" : "up")
+           << "</td></tr>";
+    }
+    html << "</table>";
+  }
   html << "<h2>previous quorum</h2><table><tr><th>replica</th>"
        << "<th>address</th><th>step</th><th>world</th></tr>";
   if (s.get("prev_quorum").is_object()) {
@@ -984,8 +1358,11 @@ static std::string prom_escape(const std::string& s) {
 std::string Lighthouse::render_metrics() {
   // Prometheus text exposition (the reference lighthouse has only an HTML
   // dashboard; a scrapeable endpoint is what production monitoring needs).
-  // Scalars and minimal per-replica tuples are copied under mu_; all string
-  // formatting happens off the hot lock, so a scrape never stalls the
+  // Unlabeled gauges keep the pre-namespace series names and report the
+  // DEFAULT job (existing alert rules keep firing); job-labeled gauges
+  // cover every namespace. Scalars and minimal per-replica tuples are
+  // copied under each job's lock one island at a time; all string
+  // formatting happens off the hot locks, so a scrape never stalls the
   // heartbeat path behind O(N) text building.
   struct FleetRow {
     std::string id;
@@ -993,55 +1370,89 @@ std::string Lighthouse::render_metrics() {
     bool has_rate = false;
     double rate = 0.0;
   };
-  int64_t now, quorum_id, quorum_gen, joins, leaves, aseq, adropped, gen;
-  int64_t epoch, takeovers, demotions;
-  bool is_active;
-  size_t n_participants, n_members;
+  struct JobRow {
+    std::string name;
+    int64_t quorum_id = 0, quorum_gen = 0, joins = 0, leaves = 0;
+    int64_t aseq = 0, adropped = 0, gen = 0;
+    size_t n_participants = 0, n_members = 0, n_fleet = 0;
+    int64_t n_straggler = 0;
+  };
+  int64_t now = now_ms();
+  const int64_t epoch = epoch_.load();
+  const int64_t takeovers = takeovers_.load();
+  const int64_t demotions = demotions_.load();
+  const bool is_active = active_.load();
   std::vector<std::pair<std::string, int64_t>> hb_ages;
   std::vector<std::pair<std::string, int64_t>> member_steps;
   std::vector<FleetRow> rows;
-  int64_t n_straggler = 0;
   bool have_median = false;
   double median_rate = 0.0;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    now = now_ms();
-    quorum_id = state_.quorum_id;
-    quorum_gen = quorum_gen_;
-    joins = joins_total_;
-    leaves = leaves_total_;
-    epoch = epoch_;
-    takeovers = takeovers_;
-    demotions = demotions_;
-    is_active = active_;
-    aseq = anomaly_seq_;
-    adropped = anomalies_dropped_;
-    gen = fleet_gen_;
-    n_participants = state_.participants.size();
-    n_members =
-        state_.prev_quorum ? state_.prev_quorum->participants.size() : 0;
-    hb_ages.reserve(state_.heartbeats.size());
-    for (const auto& kv : state_.heartbeats)
-      hb_ages.emplace_back(kv.first, now - kv.second);
-    if (state_.prev_quorum)
-      for (const auto& mem : state_.prev_quorum->participants)
-        member_steps.emplace_back(mem.replica_id, mem.step);
-    rows.reserve(fleet_.size());
-    for (const auto& kv : fleet_) {
-      FleetRow r;
-      r.id = kv.first;
-      r.straggler =
-          !kv.second.flags.empty() || now < kv.second.straggler_until_ms;
-      if (r.straggler) n_straggler += 1;
-      if (kv.second.has_digest) {
-        r.rate = kv.second.digest.get("rate").as_double(0.0);
-        r.has_rate = true;
+  std::vector<JobRow> job_rows;
+  JobRow def;
+  for (JobState* jsp : all_jobs()) {
+    std::lock_guard<std::mutex> lk(jsp->mu);
+    JobRow j;
+    j.name = jsp->name;
+    j.quorum_id = jsp->state.quorum_id;
+    j.quorum_gen = jsp->quorum_gen;
+    j.joins = jsp->joins_total;
+    j.leaves = jsp->leaves_total;
+    j.aseq = jsp->anomaly_seq;
+    j.adropped = jsp->anomalies_dropped;
+    j.gen = jsp->fleet_gen;
+    j.n_participants = jsp->state.participants.size();
+    j.n_members = jsp->state.prev_quorum
+                      ? jsp->state.prev_quorum->participants.size()
+                      : 0;
+    j.n_fleet = jsp->fleet.size();
+    for (const auto& kv : jsp->fleet)
+      if (!kv.second.flags.empty() || now < kv.second.straggler_until_ms)
+        j.n_straggler += 1;
+    if (jsp->name == "default") {
+      def = j;
+      hb_ages.reserve(jsp->state.heartbeats.size());
+      for (const auto& kv : jsp->state.heartbeats)
+        hb_ages.emplace_back(kv.first, now - kv.second);
+      if (jsp->state.prev_quorum)
+        for (const auto& mem : jsp->state.prev_quorum->participants)
+          member_steps.emplace_back(mem.replica_id, mem.step);
+      rows.reserve(jsp->fleet.size());
+      for (const auto& kv : jsp->fleet) {
+        FleetRow r;
+        r.id = kv.first;
+        r.straggler =
+            !kv.second.flags.empty() || now < kv.second.straggler_until_ms;
+        if (kv.second.has_digest) {
+          r.rate = kv.second.digest.get("rate").as_double(0.0);
+          r.has_rate = true;
+        }
+        rows.push_back(std::move(r));
       }
-      rows.push_back(std::move(r));
+      if (jsp->agg_rates.size() > 0) {
+        have_median = true;
+        median_rate = jsp->agg_rates.median();
+      }
     }
-    if (agg_rates_.size() > 0) {
-      have_median = true;
-      median_rate = agg_rates_.median();
+    job_rows.push_back(std::move(j));
+  }
+  struct DistrictRow {
+    std::string name;
+    int64_t epoch = 0, failovers = 0, stale_dropped = 0;
+    bool lost = false;
+  };
+  std::vector<DistrictRow> dist_rows;
+  int64_t district_losses;
+  {
+    std::lock_guard<std::mutex> lk(districts_mu_);
+    district_losses = district_losses_;
+    for (const auto& kv : districts_) {
+      DistrictRow d;
+      d.name = kv.first;
+      d.epoch = kv.second.epoch;
+      d.failovers = kv.second.failovers;
+      d.stale_dropped = kv.second.stale_dropped;
+      d.lost = kv.second.lost;
+      dist_rows.push_back(std::move(d));
     }
   }
   // Label-cardinality bound (TORCHFT_EXPORT_MAX_REPLICAS, shared with
@@ -1054,11 +1465,11 @@ std::string Lighthouse::render_metrics() {
   std::ostringstream m;
   m << "# HELP torchft_lighthouse_quorum_id Current quorum id.\n"
     << "# TYPE torchft_lighthouse_quorum_id gauge\n"
-    << "torchft_lighthouse_quorum_id " << quorum_id << "\n";
+    << "torchft_lighthouse_quorum_id " << def.quorum_id << "\n";
   m << "# HELP torchft_lighthouse_quorum_generation Quorum broadcasts since "
        "boot.\n"
     << "# TYPE torchft_lighthouse_quorum_generation counter\n"
-    << "torchft_lighthouse_quorum_generation " << quorum_gen << "\n";
+    << "torchft_lighthouse_quorum_generation " << def.quorum_gen << "\n";
   m << "# HELP torchft_lighthouse_epoch Fencing epoch stamped on quorums.\n"
     << "# TYPE torchft_lighthouse_epoch gauge\n"
     << "torchft_lighthouse_epoch " << epoch << "\n";
@@ -1077,19 +1488,19 @@ std::string Lighthouse::render_metrics() {
   m << "# HELP torchft_lighthouse_joins_total Members added across quorum "
        "transitions.\n"
     << "# TYPE torchft_lighthouse_joins_total counter\n"
-    << "torchft_lighthouse_joins_total " << joins << "\n";
+    << "torchft_lighthouse_joins_total " << def.joins << "\n";
   m << "# HELP torchft_lighthouse_leaves_total Members gone across quorum "
        "transitions.\n"
     << "# TYPE torchft_lighthouse_leaves_total counter\n"
-    << "torchft_lighthouse_leaves_total " << leaves << "\n";
+    << "torchft_lighthouse_leaves_total " << def.leaves << "\n";
   m << "# HELP torchft_lighthouse_participants Replicas currently waiting in "
        "the next quorum.\n"
     << "# TYPE torchft_lighthouse_participants gauge\n"
-    << "torchft_lighthouse_participants " << n_participants << "\n";
+    << "torchft_lighthouse_participants " << def.n_participants << "\n";
   m << "# HELP torchft_lighthouse_quorum_members Members of the last "
        "delivered quorum.\n"
     << "# TYPE torchft_lighthouse_quorum_members gauge\n"
-    << "torchft_lighthouse_quorum_members " << n_members << "\n";
+    << "torchft_lighthouse_quorum_members " << def.n_members << "\n";
   int64_t max_hb_age = 0;
   for (const auto& kv : hb_ages)
     if (kv.second > max_hb_age) max_hb_age = kv.second;
@@ -1119,15 +1530,15 @@ std::string Lighthouse::render_metrics() {
   m << "# HELP torchft_lighthouse_anomalies_total Anomaly rise-edges "
        "detected since boot.\n"
     << "# TYPE torchft_lighthouse_anomalies_total counter\n"
-    << "torchft_lighthouse_anomalies_total " << aseq << "\n";
+    << "torchft_lighthouse_anomalies_total " << def.aseq << "\n";
   m << "# HELP torchft_lighthouse_anomalies_dropped Anomaly records evicted "
        "from the bounded ring (feed incomplete when > 0).\n"
     << "# TYPE torchft_lighthouse_anomalies_dropped counter\n"
-    << "torchft_lighthouse_anomalies_dropped " << adropped << "\n";
+    << "torchft_lighthouse_anomalies_dropped " << def.adropped << "\n";
   m << "# HELP torchft_lighthouse_fleet_gen Fleet-table content generation "
        "(bumped on every mutation; tags /fleet.json snapshots).\n"
     << "# TYPE torchft_lighthouse_fleet_gen counter\n"
-    << "torchft_lighthouse_fleet_gen " << gen << "\n";
+    << "torchft_lighthouse_fleet_gen " << def.gen << "\n";
   m << "# HELP torchft_lighthouse_fleet_replicas Replicas in the fleet "
        "table.\n"
     << "# TYPE torchft_lighthouse_fleet_replicas gauge\n"
@@ -1135,7 +1546,7 @@ std::string Lighthouse::render_metrics() {
   m << "# HELP torchft_lighthouse_fleet_stragglers Replicas currently "
        "flagged or inside the sticky straggler window.\n"
     << "# TYPE torchft_lighthouse_fleet_stragglers gauge\n"
-    << "torchft_lighthouse_fleet_stragglers " << n_straggler << "\n";
+    << "torchft_lighthouse_fleet_stragglers " << def.n_straggler << "\n";
   if (!rows.empty()) {
     std::ostringstream strag, per_replica;
     for (const auto& r : rows) {
@@ -1176,6 +1587,79 @@ std::string Lighthouse::render_metrics() {
        "(TORCHFT_EXPORT_MAX_REPLICAS).\n"
     << "# TYPE torchft_lighthouse_replicas_suppressed gauge\n"
     << "torchft_lighthouse_replicas_suppressed " << suppressed << "\n";
+  // Per-job series: every namespace island, keyed by the job label. The
+  // cardinality here is O(jobs), not O(replicas) — bounded by how many
+  // jobs the fleet actually runs.
+  m << "# HELP torchft_lighthouse_job_quorum_id Current quorum id per job "
+       "namespace.\n"
+    << "# TYPE torchft_lighthouse_job_quorum_id gauge\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_quorum_id{job=\"" << prom_escape(j.name)
+      << "\"} " << j.quorum_id << "\n";
+  m << "# HELP torchft_lighthouse_job_quorum_generation Quorum broadcasts "
+       "per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_quorum_generation counter\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_quorum_generation{job=\""
+      << prom_escape(j.name) << "\"} " << j.quorum_gen << "\n";
+  m << "# HELP torchft_lighthouse_job_participants Replicas waiting in the "
+       "next quorum per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_participants gauge\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_participants{job=\"" << prom_escape(j.name)
+      << "\"} " << j.n_participants << "\n";
+  m << "# HELP torchft_lighthouse_job_fleet_replicas Fleet-table rows per "
+       "job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_fleet_replicas gauge\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_fleet_replicas{job=\"" << prom_escape(j.name)
+      << "\"} " << j.n_fleet << "\n";
+  m << "# HELP torchft_lighthouse_job_stragglers Flagged/sticky replicas "
+       "per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_stragglers gauge\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_stragglers{job=\"" << prom_escape(j.name)
+      << "\"} " << j.n_straggler << "\n";
+  m << "# HELP torchft_lighthouse_job_anomalies_total Anomaly rise-edges "
+       "per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_anomalies_total counter\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_anomalies_total{job=\"" << prom_escape(j.name)
+      << "\"} " << j.aseq << "\n";
+  // District (federation) series, present on a root lighthouse.
+  m << "# HELP torchft_lighthouse_districts Districts reporting rollups.\n"
+    << "# TYPE torchft_lighthouse_districts gauge\n"
+    << "torchft_lighthouse_districts " << dist_rows.size() << "\n";
+  m << "# HELP torchft_lighthouse_district_losses_total Districts that "
+       "went silent past the heartbeat timeout (cumulative).\n"
+    << "# TYPE torchft_lighthouse_district_losses_total counter\n"
+    << "torchft_lighthouse_district_losses_total " << district_losses << "\n";
+  if (!dist_rows.empty()) {
+    m << "# HELP torchft_lighthouse_district_up District currently "
+         "reporting (1) or lost (0).\n"
+      << "# TYPE torchft_lighthouse_district_up gauge\n";
+    for (const auto& d : dist_rows)
+      m << "torchft_lighthouse_district_up{district=\"" << prom_escape(d.name)
+        << "\"} " << (d.lost ? 0 : 1) << "\n";
+    m << "# HELP torchft_lighthouse_district_epoch Max fencing epoch seen "
+         "from each district.\n"
+      << "# TYPE torchft_lighthouse_district_epoch gauge\n";
+    for (const auto& d : dist_rows)
+      m << "torchft_lighthouse_district_epoch{district=\""
+        << prom_escape(d.name) << "\"} " << d.epoch << "\n";
+    m << "# HELP torchft_lighthouse_district_failovers_total Epoch advances "
+         "observed per district (its lighthouse failed over).\n"
+      << "# TYPE torchft_lighthouse_district_failovers_total counter\n";
+    for (const auto& d : dist_rows)
+      m << "torchft_lighthouse_district_failovers_total{district=\""
+        << prom_escape(d.name) << "\"} " << d.failovers << "\n";
+    m << "# HELP torchft_lighthouse_district_stale_dropped_total Rollups "
+         "fenced out per district (old primary after failover).\n"
+      << "# TYPE torchft_lighthouse_district_stale_dropped_total counter\n";
+    for (const auto& d : dist_rows)
+      m << "torchft_lighthouse_district_stale_dropped_total{district=\""
+        << prom_escape(d.name) << "\"} " << d.stale_dropped << "\n";
+  }
   // Hot-path latency histograms: upper-bound percentile gauges per path
   // (log buckets, telemetry._hist_percentile semantics).
   struct Named {
@@ -1219,6 +1703,28 @@ void Lighthouse::handle_http(int fd) {
       path = req.substr(sp1 + 1, sp2 - sp1 - 1);
     }
   }
+  // Query-string split: /fleet.json?job=<id> selects one namespace island
+  // (only the "job" key is recognized; anything else is ignored).
+  std::string query;
+  {
+    size_t qpos = path.find('?');
+    if (qpos != std::string::npos) {
+      query = path.substr(qpos + 1);
+      path = path.substr(0, qpos);
+    }
+  }
+  std::string q_job;
+  {
+    size_t pos = 0;
+    while (pos < query.size()) {
+      size_t amp = query.find('&', pos);
+      std::string kv = query.substr(
+          pos, amp == std::string::npos ? std::string::npos : amp - pos);
+      if (kv.rfind("job=", 0) == 0) q_job = kv.substr(4);
+      if (amp == std::string::npos) break;
+      pos = amp + 1;
+    }
+  }
   // Side-effecting endpoints (kill / drain / drain_all) are POST-only:
   // a GET must never stop a replica — browsers prefetch URLs and
   // monitoring scrapers walk dashboard paths. The dashboard forms
@@ -1246,8 +1752,9 @@ void Lighthouse::handle_http(int fd) {
     ctype = "application/json";
   } else if (path == "/fleet.json") {
     // Pre-dumped cached snapshot: serving is a string copy, not an O(N)
-    // JSON build under mu_ (the contention the fleet_load harness measures).
-    body = fleet_snapshot(now_ms())->body;
+    // JSON build under the job lock (the contention the fleet_load harness
+    // measures). ?job=<id> selects that namespace; bare = composite.
+    body = fleet_snapshot(q_job, now_ms())->body;
     ctype = "application/json";
   } else if (path == "/metrics") {
     body = render_metrics();
@@ -1261,6 +1768,7 @@ void Lighthouse::handle_http(int fd) {
     Json kreq = Json::object();
     kreq["type"] = Json::of(is_kill ? "kill" : "drain");
     kreq["replica_id"] = Json::of(replica_id);
+    if (!q_job.empty()) kreq["job"] = Json::of(q_job);
     Json kresp = handle_request(kreq, now_ms() + 5000);
     body = kresp.dump();
     ctype = "application/json";
@@ -1268,6 +1776,7 @@ void Lighthouse::handle_http(int fd) {
   } else if (path == "/drain_all") {
     Json dreq = Json::object();
     dreq["type"] = Json::of("drain_all");
+    if (!q_job.empty()) dreq["job"] = Json::of(q_job);
     Json dresp = handle_request(dreq, now_ms() + 15000);
     body = dresp.dump();
     ctype = "application/json";

@@ -8,24 +8,49 @@
 // forwards a Kill message to a member's manager address.
 //
 // Wire protocol: length-prefixed JSON frames (see net.hpp). Requests:
-//   {"type":"heartbeat","replica_id":...[,"digest":{...},"hb_interval_ms":N]}
-//   {"type":"quorum","timeout_ms":N,"requester":{QuorumMember}}
+//   {"type":"heartbeat","replica_id":...[,"job":J,"digest":{...},
+//       "hb_interval_ms":N]}
+//   {"type":"quorum","timeout_ms":N,"requester":{QuorumMember}[,"job":J]}
 //   {"type":"status"}
-//   {"type":"fleet"}   (live fleet-health table, the framed twin of
+//   {"type":"fleet"[,"job":J]}   (live fleet-health table, the framed twin of
 //       GET /fleet.json: per-replica digest rows + aggregates + anomalies)
-//   {"type":"kill","replica_id":...}
-// HTTP: GET / or /status (dashboard), GET /fleet.json (live health table),
-// GET/POST /replica/<id>/kill.
+//   {"type":"kill","replica_id":...[,"job":J]}
+// HTTP: GET / or /status (dashboard), GET /fleet.json[?job=J] (live health
+// table), GET/POST /replica/<id>/kill.
+//
+// Multi-tenant namespaces: every frame may carry a "job" id; an absent or
+// empty field maps to "default" (wire back-compat with pre-namespace
+// clients). Each job owns a fully isolated control-plane island — its own
+// participant/heartbeat/quorum tables, fleet-health table, anomaly detectors
+// and ring, aggregate trackers, and /fleet.json snapshot cache — under its
+// OWN mutex, so one job's churn or quorum storm cannot stall another job's
+// heartbeat/quorum hot path or bump its quorum generation.
+//
+// Incremental quorum compute: registrations no longer trigger a full
+// O(N log N) quorum_compute each (the O(N^2) registration storm that put
+// quorum formation at ~4 s for N=1024). Each join/leave maintains O(1) gate
+// counters (previous members re-registered; heartbeating replicas not yet
+// registered); the full quorum_compute — still the single source of truth —
+// only runs when the gate says a quorum CAN form, plus on the periodic tick
+// as the time-driven (heartbeat expiry, join timeout) fallback. A gate bug
+// can therefore only delay a formation by one tick, never form a wrong one.
+//
+// Federation: a lighthouse started with a root address periodically reports
+// a per-job rollup upward over the SAME heartbeat frame type (piggyback
+// channel), tagged with its district name and fencing epoch. The root keeps
+// a per-district table with per-district epoch fencing — after a district
+// failover the old primary's rollups are dropped, and a district's loss or
+// failover never perturbs sibling districts or other jobs' tables.
 //
 // Live fleet plane: heartbeats optionally carry a StepDigest (compact
 // per-replica health summary built by telemetry.StepDigest). The lighthouse
-// keeps a rolling per-replica fleet table, runs an online straggler/anomaly
-// detector (relative step-rate slowdown vs the fleet median, heartbeat-gap
-// jitter against the sender-declared cadence, commit-failure streaks), and
-// serves it all at /fleet.json. Digest-driven rules evaluate at heartbeat
-// ARRIVAL (same digest sequence => same anomaly sequence, so chaos replays
-// reproduce alerts); only the time-based rules (open heartbeat gaps,
-// staleness) live in the tick scan.
+// keeps a rolling per-replica fleet table PER JOB, runs an online
+// straggler/anomaly detector (relative step-rate slowdown vs the job median,
+// heartbeat-gap jitter against the sender-declared cadence, commit-failure
+// streaks), and serves it all at /fleet.json. Digest-driven rules evaluate
+// at heartbeat ARRIVAL (same digest sequence => same anomaly sequence, so
+// chaos replays reproduce alerts); only the time-based rules (open heartbeat
+// gaps, staleness) live in the tick scan.
 #pragma once
 
 #include <atomic>
@@ -172,22 +197,11 @@ class Lighthouse {
   int port() const { return port_; }
   std::string address() const;
 
-  // Exposed for tests: runs one tick synchronously.
+  // Exposed for tests: runs one tick synchronously (all jobs).
   void tick();
 
  private:
-  void accept_loop();
-  void tick_loop();
-  void handle_conn(int fd);
-  void handle_frame_conn(int fd, const std::string& first_payload);
-  void handle_http(int fd);
-  Json handle_request(const Json& req, int64_t deadline_ms);
-  Json quorum_rpc(const Json& req, int64_t deadline_ms);
-  std::string render_status_html();
-  std::string render_metrics();
-  Json status_json();
-
-  // ---- live fleet health plane ----
+  // ---- live fleet health plane (per job) ----
   struct FleetEntry {
     Json digest;                     // last StepDigest wire dict
     bool has_digest = false;
@@ -200,57 +214,154 @@ class Lighthouse {
     std::set<std::string> flags;     // active anomaly flags
     int64_t straggler_until_ms = 0;  // sticky display flag
   };
-  // All fleet_* helpers run with mu_ held by the caller.
-  void fleet_note_heartbeat(const std::string& replica_id, const Json& req,
-                            int64_t now);
-  void fleet_scan_locked(int64_t now);  // time-based rules (gaps, staleness)
-  void fleet_set_flag(const std::string& replica_id, FleetEntry& e,
-                      const std::string& kind, int64_t now, Json detail);
-  void fleet_clear_flag(FleetEntry& e, const std::string& kind);
-  void fleet_erase(const std::string& replica_id);
-  void fleet_agg_remove(const FleetEntry& e);  // retire e.digest from aggs
-  void fleet_agg_insert(const FleetEntry& e);  // fold e.digest into aggs
-  int64_t fleet_jitter_budget_ms(const FleetEntry& e) const;
-  Json fleet_summary_locked(int64_t now);  // the slice merged into status.json
-  Json fleet_agg_locked(int64_t now);      // O(1)-ish agg dict from trackers
-  Json hist_json() const;                  // hot-path histograms for status
 
-  // Generation-tagged cached fleet snapshot. The full /fleet.json payload is
-  // only O(N)-rebuilt when the cached copy is older than fleet_snap_ms; the
-  // rebuild copies raw rows under mu_ (cheap) and does the JSON build + dump
-  // OFF the hot lock, so heartbeats never wait behind serialization.
+  // Generation-tagged cached fleet snapshot (per job). The full /fleet.json
+  // payload is only O(N)-rebuilt when the cached copy is older than
+  // fleet_snap_ms; the rebuild copies raw rows under the job's hot lock
+  // (cheap) and does the JSON build + dump OFF it, so heartbeats never wait
+  // behind serialization. Keyed per job: one job's content change never
+  // forces a rebuild of (or serves a stale gen to) another job.
   struct FleetSnapshot {
-    int64_t gen = -1;       // fleet_gen_ at build
+    int64_t gen = -1;       // job fleet_gen at build
     int64_t built_ms = 0;   // wall time at build (== payload ts_ms)
     Json json;              // the /fleet.json object
     std::string body;       // pre-dumped body served verbatim over HTTP
   };
-  std::shared_ptr<const FleetSnapshot> fleet_snapshot(int64_t now);
 
-  std::map<std::string, FleetEntry> fleet_;
-  std::deque<Json> anomalies_;  // rise-edge anomaly ring (capped)
-  int64_t anomaly_seq_ = 0;     // total anomalies ever (ring drops old ones)
-  int64_t anomalies_dropped_ = 0;  // rise-edges evicted from the ring
-  int64_t fleet_gen_ = 0;  // bumped on every fleet-table mutation (mu_)
-  int64_t flagged_ = 0;    // entries with a non-empty flag set (mu_)
-  int64_t n_digest_ = 0;   // entries with a digest (mu_)
-  // Incremental O(log N) aggregate state, updated at digest arrival/leave —
-  // replaces the full-table rescans that made /fleet.json and the anomaly
-  // rules O(N) per heartbeat (all guarded by mu_).
-  MedianTracker agg_rates_;       // digest rates > 0
-  MedianTracker agg_steps_;       // digest steps (as double, like the sort)
-  MedianTracker agg_gps_;         // digest goodputs
-  std::multiset<int64_t> agg_cfs_;  // digest commit-failure streaks
+  // One fully isolated control-plane island per job namespace. Everything
+  // here is guarded by the island's OWN mu (snap by snap_mu, rebuilds by
+  // rebuild_mu — same ordering discipline as the old instance-wide locks:
+  // rebuild_mu strictly outside snap_mu and mu; snap_mu never held with
+  // mu; never two jobs' mu held at once).
+  struct JobState {
+    std::string name;
+    std::mutex mu;
+    std::condition_variable cv;
 
-  std::mutex snap_mu_;  // guards snap_ only; never held together with mu_
-  // Serializes snapshot rebuilds (single-flight); ordered strictly outside
-  // snap_mu_ and mu_, never acquired while either is held.
-  std::mutex rebuild_mu_;
-  std::shared_ptr<const FleetSnapshot> snap_;
+    // ---- quorum plane ----
+    LighthouseState state;
+    std::optional<Quorum> last_quorum;  // most recently broadcast quorum
+    int64_t quorum_gen = 0;             // bumped on every broadcast
+    // Serialized {"ok":true,"quorum":...} built ONCE per formation and
+    // shared by every waiter: with N waiters each dumping an O(N)
+    // participant list the broadcast is O(N^2) — at N=1024 that was ~3.7 s
+    // of lighthouse CPU per formation.
+    std::shared_ptr<const std::string> quorum_payload;
+    int64_t joins_total = 0;   // members added across quorum transitions
+    int64_t leaves_total = 0;  // members gone across quorum transitions
+    std::string last_reason;   // why no quorum yet (for status page)
+    // Max quorum_id seen in this job's manager heartbeats. A takeover
+    // standby resumes the job's numbering above it (strict monotonicity
+    // across failover without a lighthouse-to-lighthouse channel).
+    int64_t observed_quorum_id = 0;
+
+    // ---- incremental-quorum gate counters (see quorum_gate_locked) ----
+    std::set<std::string> prev_ids;  // ids of prev_quorum members
+    int64_t prev_present = 0;        // prev_ids currently registered
+    int64_t hb_not_joined = 0;       // heartbeating ids not registered
+
+    // ---- fleet plane ----
+    std::map<std::string, FleetEntry> fleet;
+    std::deque<Json> anomalies;   // rise-edge anomaly ring (capped)
+    int64_t anomaly_seq = 0;      // total anomalies ever (ring drops old)
+    int64_t anomalies_dropped = 0;  // rise-edges evicted from the ring
+    int64_t fleet_gen = 0;  // bumped on every fleet-table mutation
+    int64_t flagged = 0;    // entries with a non-empty flag set
+    int64_t n_digest = 0;   // entries with a digest
+    // Incremental O(log N) aggregate state, updated at digest arrival/leave.
+    MedianTracker agg_rates;        // digest rates > 0
+    MedianTracker agg_steps;        // digest steps (as double, like the sort)
+    MedianTracker agg_gps;          // digest goodputs
+    std::multiset<int64_t> agg_cfs;  // digest commit-failure streaks
+
+    // ---- per-job snapshot cache ----
+    std::mutex snap_mu;     // guards snap only
+    std::mutex rebuild_mu;  // single-flight rebuild
+    std::shared_ptr<const FleetSnapshot> snap;
+  };
+
+  // District table kept by a ROOT lighthouse: one row per reporting district
+  // lighthouse, fed by rollup-tagged heartbeat frames. Guarded by
+  // districts_mu_ (never held together with a job mu).
+  struct DistrictEntry {
+    int64_t last_hb_ms = 0;
+    int64_t epoch = 0;          // max fencing epoch seen (per-district fence)
+    int64_t hb_count = 0;
+    int64_t failovers = 0;      // epoch advances observed (district failover)
+    int64_t stale_dropped = 0;  // rollups fenced out (old primary)
+    bool lost = false;          // no rollup within heartbeat_timeout_ms
+    Json rollup;                // last accepted per-job rollup
+  };
+
+  void accept_loop();
+  void tick_loop();
+  void district_loop();  // district -> root rollup sender
+  void handle_conn(int fd);
+  void handle_http(int fd);
+  // `raw` (when non-null) lets the quorum path hand back the prebuilt
+  // shared response bytes instead of a Json tree the caller would re-dump
+  // per connection; when *raw is set the returned Json is meaningless.
+  Json handle_request(const Json& req, int64_t deadline_ms,
+                      std::shared_ptr<const std::string>* raw = nullptr);
+  Json quorum_rpc(const Json& req, int64_t deadline_ms,
+                  std::shared_ptr<const std::string>* raw = nullptr);
+  std::string render_status_html();
+  std::string render_metrics();
+  Json status_json();
+
+  // Job-island resolution: creates the island on first use (seeded from the
+  // durable snapshot so quorum ids stay monotone across warm restarts).
+  JobState& job_state(const std::string& job);
+  std::vector<JobState*> all_jobs();
+
+  // Runs one quorum evaluation for ONE job with js.mu held by the caller;
+  // broadcasts (and notifies js.cv) when a quorum forms.
+  void job_tick_locked(JobState& js, int64_t now);
+  // O(1) gate: can a quorum POSSIBLY form for this job right now? Only a
+  // pass pays the full quorum_compute; a miss defers to the periodic tick.
+  bool quorum_gate_locked(const JobState& js) const;
+  // Join/implicit-heartbeat bookkeeping shared by register + re-register,
+  // maintaining the gate counters (js.mu held).
+  void register_participant_locked(JobState& js, const QuorumMember& me);
+
+  // All fleet_* helpers run with js.mu held by the caller.
+  void fleet_note_heartbeat(JobState& js, const std::string& replica_id,
+                            const Json& req, int64_t now);
+  void fleet_scan_locked(JobState& js, int64_t now);  // time-based rules
+  void fleet_set_flag(JobState& js, const std::string& replica_id,
+                      FleetEntry& e, const std::string& kind, int64_t now,
+                      Json detail);
+  void fleet_clear_flag(JobState& js, FleetEntry& e, const std::string& kind);
+  void fleet_erase(JobState& js, const std::string& replica_id);
+  void fleet_agg_remove(JobState& js, const FleetEntry& e);
+  void fleet_agg_insert(JobState& js, const FleetEntry& e);
+  int64_t fleet_jitter_budget_ms(const FleetEntry& e) const;
+  Json fleet_summary_locked(JobState& js, int64_t now);  // status.json slice
+  Json fleet_agg_locked(JobState& js, int64_t now);      // O(1)-ish agg dict
+  Json hist_json() const;  // hot-path histograms for status
+
+  // Per-job cached snapshot; empty job = the composite view (the default
+  // job's payload extended with the cross-job summary + district table, so
+  // pre-namespace consumers keep their top-level schema).
+  std::shared_ptr<const FleetSnapshot> fleet_snapshot(const std::string& job,
+                                                      int64_t now);
+
+  // ---- federation (root side) ----
+  Json district_note(const Json& req);     // absorb one rollup frame
+  void district_scan(int64_t now);         // time-based district-loss rule
+  Json districts_json(int64_t now);
+
+  std::mutex jobs_mu_;  // guards the jobs_ map only (lookup/insert); job
+                        // islands are never erased, so JobState* stay valid
+  std::map<std::string, JobState> jobs_;
+
+  std::mutex districts_mu_;
+  std::map<std::string, DistrictEntry> districts_;
+  int64_t district_losses_ = 0;  // districts that went silent (cumulative)
 
   // Hot-path latency histograms (lock-free, exported on /metrics and
   // status.json["hist"]).
-  LatencyHist hist_heartbeat_;   // heartbeat RPC branch incl. mu_ wait
+  LatencyHist hist_heartbeat_;   // heartbeat RPC branch incl. lock wait
   LatencyHist hist_quorum_;      // quorum_compute inside tick
   LatencyHist hist_anomaly_;     // digest fold + anomaly rules per heartbeat
   LatencyHist hist_http_;        // whole HTTP request service
@@ -262,39 +373,36 @@ class Lighthouse {
   int port_;
   LighthouseOpts opts_;
 
-  std::mutex mu_;
-  std::condition_variable cv_;
-  LighthouseState state_;
-  std::optional<Quorum> last_quorum_;  // most recently broadcast quorum
-  int64_t quorum_gen_ = 0;             // bumped on every broadcast
-  int64_t joins_total_ = 0;   // members added across quorum transitions
-  int64_t leaves_total_ = 0;  // members gone across quorum transitions
-  std::string last_reason_;            // why no quorum yet (for status page)
-
-  // ---- HA / fencing state (guarded by mu_ unless noted) ----
+  // ---- HA / fencing state (instance-global: there is ONE epoch owner per
+  // lighthouse identity, shared by every job it serves) ----
   // Fencing epoch this instance stamps on quorums while active. Restored
   // from the durable snapshot on warm restart; bumped past observed_epoch_
   // on standby takeover. 0 only before a fresh active boot assigns 1.
-  int64_t epoch_ = 0;
+  std::atomic<int64_t> epoch_{0};
   // Max epoch seen in manager heartbeats — the fleet's view of the current
   // owner. A standby uses it to fence its takeover epoch; an active
   // instance that observes a higher value has been superseded and demotes.
-  int64_t observed_epoch_ = 0;
-  // Max quorum_id seen in manager heartbeats. A standby resumes numbering
-  // above it on takeover so quorum ids stay strictly monotone across
-  // failover (a standby has no disk state from the old primary to restore).
-  int64_t observed_quorum_id_ = 0;
-  bool active_ = true;        // false = standby: absorb heartbeats only
-  int64_t takeovers_ = 0;     // standby -> active transitions
-  int64_t demotions_ = 0;     // active -> standby (fenced by higher epoch)
-  // Persist {epoch_, state_.quorum_id, quorum_gen_} with mu_ held; called
-  // before a new quorum is published so ids stay monotone across crashes.
-  void persist_locked();
+  std::atomic<int64_t> observed_epoch_{0};
+  std::atomic<bool> active_{true};  // false = standby: absorb heartbeats only
+  std::atomic<int64_t> takeovers_{0};   // standby -> active transitions
+  std::atomic<int64_t> demotions_{0};   // active -> standby (fenced)
+  // Serializes role transitions + durable saves; ordered strictly inside any
+  // job mu (job mu -> persist_mu_, never the reverse).
+  std::mutex persist_mu_;
+  int64_t dur_quorum_id_ = 0;  // max quorum_id across jobs (persist_mu_)
+  int64_t dur_gen_ = 0;        // max quorum_gen across jobs (persist_mu_)
+  int64_t restored_quorum_id_ = 0;  // seeds for job islands created later
+  int64_t restored_gen_ = 0;
+  // Fold one job's freshly bumped ids into the durable maxima and fsync the
+  // snapshot BEFORE the quorum publishes (ids stay monotone across crashes).
+  void persist(int64_t job_qid, int64_t job_gen);
+  void persist_locked(int64_t job_qid, int64_t job_gen);  // persist_mu_ held
 
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
   std::thread tick_thread_;
+  std::thread district_thread_;
   ConnTracker conns_;
 };
 

@@ -140,6 +140,9 @@ void ManagerServer::heartbeat_loop() {
         Json req = Json::object();
         req["type"] = Json::of("heartbeat");
         req["replica_id"] = Json::of(opts_.replica_id);
+        // Job namespace: routes this heartbeat to our job's isolated island
+        // on a namespaced lighthouse; an old lighthouse ignores the key.
+        req["job"] = Json::of(opts_.job);
         // Carry our address: lets the lighthouse drain_all reach us even if
         // we never managed to register a quorum (drain_all blind spot).
         req["address"] = Json::of(address());
@@ -325,6 +328,7 @@ Json ManagerServer::lh_info_json() const {
   lh["epoch"] = Json::of(lh_epoch_.load());
   lh["stale_rejected"] = Json::of(lh_stale_rejected_.load());
   lh["unreachable_retries"] = Json::of(lh_unreachable_retries_.load());
+  lh["job"] = Json::of(opts_.job);
   return lh;
 }
 
@@ -363,6 +367,7 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(
     } else {
       Json req = Json::object();
       req["type"] = Json::of("quorum");
+      req["job"] = Json::of(opts_.job);
       req["timeout_ms"] = Json::of(attempt_deadline - now_ms());
       req["requester"] = me.to_json();
       if (!trace_id.empty()) req["trace_id"] = Json::of(trace_id);
@@ -461,6 +466,7 @@ bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
       Json lv = Json::object();
       lv["type"] = Json::of("leave");
       lv["replica_id"] = Json::of(opts_.replica_id);
+      lv["job"] = Json::of(opts_.job);
       Json lresp;
       sent = call_json(fd, lv, &lresp, remaining) && lresp.get("ok").as_bool();
       close(fd);

@@ -61,6 +61,12 @@ struct ManagerOpts {
   // long => deterministically advance to the next address in the list
   // (TORCHFT_LH_LEASE_MS / --lh-lease-ms).
   int64_t lighthouse_lease_ms = 3000;
+  // Job namespace this replica group belongs to (TORCHFT_JOB / --job).
+  // Stamped on every heartbeat/quorum/leave frame; the lighthouse keeps a
+  // fully isolated control-plane island per job. "default" matches the
+  // pre-namespace wire behavior (the key is still sent; an old lighthouse
+  // ignores unknown keys).
+  std::string job = "default";
 };
 
 class ManagerServer {

@@ -44,6 +44,7 @@ Json Quorum::to_json() const {
   j["created_ms"] = Json::of(created_ms);
   j["epoch"] = Json::of(epoch);
   j["generation"] = Json::of(generation);
+  j["job"] = Json::of(job);
   Json parts = Json::array();
   for (const auto& p : participants) parts.push(p.to_json());
   j["participants"] = parts;
@@ -56,6 +57,10 @@ Quorum Quorum::from_json(const Json& j) {
   q.created_ms = j.get("created_ms").as_int();
   q.epoch = j.get("epoch").as_int(0);
   q.generation = j.get("generation").as_int(0);
+  // Wire back-compat: a quorum from a pre-namespace lighthouse carries no
+  // job field — it belongs to the default namespace.
+  q.job = j.get("job").as_str();
+  if (q.job.empty()) q.job = "default";
   for (const auto& p : j.get("participants").arr)
     q.participants.push_back(QuorumMember::from_json(p));
   return q;

@@ -49,6 +49,9 @@ struct Quorum {
   // via the durable snapshot). (epoch, generation) orders every quorum the
   // fleet has ever seen, even across lighthouse identities.
   int64_t generation = 0;
+  // Job namespace this quorum belongs to. Absent/empty on the wire maps to
+  // "default" (back-compat with pre-namespace lighthouses and clients).
+  std::string job = "default";
 
   Json to_json() const;
   static Quorum from_json(const Json& j);
@@ -76,6 +79,13 @@ struct LighthouseOpts {
   // target, so a request here means the fleet failed over to us and we take
   // over with epoch = max(observed) + 1.
   bool standby = false;
+  // Federation: this lighthouse's district name. With root_addr set, the
+  // ACTIVE instance periodically reports a per-job rollup to the root over
+  // the heartbeat piggyback channel, tagged with this name and its fencing
+  // epoch. Both empty = federation off (the default, standalone behavior).
+  std::string district;
+  // Root lighthouse address ("host:port") the district rollups go to.
+  std::string root_addr;
 };
 
 // Durable lighthouse snapshot: the only state that must survive a restart.

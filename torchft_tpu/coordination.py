@@ -147,6 +147,10 @@ class Quorum:
     # (persisted with reserve headroom). (epoch, generation) totally orders
     # every quorum the control plane ever delivered.
     generation: int = 0
+    # Job namespace this quorum was formed in. A pre-namespace lighthouse
+    # omits the key; it parses as "default", the island every untagged
+    # frame lands in (wire back-compat, both directions).
+    job: str = "default"
 
     @staticmethod
     def from_json(j: Dict[str, Any]) -> "Quorum":
@@ -158,6 +162,7 @@ class Quorum:
             created_ms=j.get("created_ms", 0),
             epoch=j.get("epoch", 0),
             generation=j.get("generation", 0),
+            job=j.get("job") or "default",
         )
 
 
@@ -515,6 +520,8 @@ class LighthouseServer:
         fleet_snap_ms: Optional[int] = None,
         state_dir: Optional[str] = None,
         standby: bool = False,
+        district: Optional[str] = None,
+        root_addr: Optional[str] = None,
     ) -> None:
         host, port = _split_bind(bind)
         argv = [
@@ -547,6 +554,14 @@ class LighthouseServer:
             # Warm standby: absorbs heartbeats read-only, takes over with a
             # bumped fencing epoch when the first quorum request arrives.
             argv += ["--standby"]
+        if district:
+            # Federation: this instance is the district lighthouse named
+            # `district`; with root_addr set, the active instance reports
+            # per-job fleet rollups upward on the heartbeat channel
+            # (TORCHFT_LH_DISTRICT / TORCHFT_LH_ROOT are the env twins).
+            argv += ["--district", str(district)]
+        if root_addr:
+            argv += ["--root", str(root_addr)]
         self._server = _ServerProcess(argv, "lighthouse")
 
     def address(self) -> str:
@@ -569,6 +584,7 @@ class LighthouseClient:
         digest: Optional[Dict[str, Any]] = None,
         hb_interval_ms: int = 0,
         epoch: int = 0,
+        job: str = "",
     ) -> None:
         """One heartbeat, optionally carrying a :class:`~torchft_tpu.
         telemetry.StepDigest` wire dict (``StepDigest.to_wire()``) plus
@@ -588,15 +604,23 @@ class LighthouseClient:
             req["hb_interval_ms"] = int(hb_interval_ms)
         if epoch > 0:
             req["epoch"] = int(epoch)
+        if job:
+            req["job"] = job
         self._client.call(req, timeout)
 
-    def fleet(self, timeout: float = 5.0) -> Dict[str, Any]:
+    def fleet(self, timeout: float = 5.0, job: str = "") -> Dict[str, Any]:
         """Live fleet-health table (the framed twin of ``GET
         /fleet.json``): per-replica digest rows, fleet aggregates, and
-        the anomaly ring. See docs/OBSERVABILITY.md "live plane"."""
-        return self._client.call(
-            {"type": "fleet", "timeout_ms": int(timeout * 1000)}, timeout
-        )["fleet"]
+        the anomaly ring. ``job`` scopes the payload to one namespace;
+        empty serves the default job's composite view (which carries
+        per-job summaries under ``jobs`` plus federation ``districts``).
+        See docs/OBSERVABILITY.md "live plane"."""
+        req: Dict[str, Any] = {
+            "type": "fleet", "timeout_ms": int(timeout * 1000),
+        }
+        if job:
+            req["job"] = job
+        return self._client.call(req, timeout)["fleet"]
 
     def quorum(
         self,
@@ -609,6 +633,7 @@ class LighthouseClient:
         shrink_only: bool = False,
         commit_failures: int = 0,
         data: Optional[Dict[str, Any]] = None,
+        job: str = "",
     ) -> Quorum:
         member = QuorumMember(
             replica_id=replica_id,
@@ -620,14 +645,14 @@ class LighthouseClient:
             commit_failures=commit_failures,
             data=data or {},
         )
-        resp = self._client.call(
-            {
-                "type": "quorum",
-                "timeout_ms": int(timeout * 1000),
-                "requester": member.to_json(),
-            },
-            timeout + 5.0,
-        )
+        req: Dict[str, Any] = {
+            "type": "quorum",
+            "timeout_ms": int(timeout * 1000),
+            "requester": member.to_json(),
+        }
+        if job:
+            req["job"] = job
+        resp = self._client.call(req, timeout + 5.0)
         return Quorum.from_json(resp["quorum"])
 
     def status(self, timeout: float = 5.0) -> Dict[str, Any]:
@@ -635,51 +660,69 @@ class LighthouseClient:
             {"type": "status", "timeout_ms": int(timeout * 1000)}, timeout
         )["status"]
 
-    def kill(self, replica_id: str, timeout: float = 5.0) -> None:
-        self._client.call(
-            {"type": "kill", "replica_id": replica_id,
-             "timeout_ms": int(timeout * 1000)},
-            timeout,
-        )
+    def kill(
+        self, replica_id: str, timeout: float = 5.0, job: str = ""
+    ) -> None:
+        req: Dict[str, Any] = {
+            "type": "kill", "replica_id": replica_id,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if job:
+            req["job"] = job
+        self._client.call(req, timeout)
 
-    def leave(self, replica_id: str, timeout: float = 5.0) -> None:
+    def leave(
+        self, replica_id: str, timeout: float = 5.0, job: str = ""
+    ) -> None:
         """Graceful drain: removes the replica from the lighthouse's
         heartbeat/participant maps immediately (with a tombstone against
         in-flight heartbeats), so the survivors' next quorum forms at tick
         speed instead of waiting out the heartbeat timeout. No reference
         analog — the reference only has Kill → exit(1)."""
-        self._client.call(
-            {"type": "leave", "replica_id": replica_id,
-             "timeout_ms": int(timeout * 1000)},
-            timeout,
-        )
+        req: Dict[str, Any] = {
+            "type": "leave", "replica_id": replica_id,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if job:
+            req["job"] = job
+        self._client.call(req, timeout)
 
-    def request_drain(self, replica_id: str, timeout: float = 5.0) -> None:
+    def request_drain(
+        self, replica_id: str, timeout: float = 5.0, job: str = ""
+    ) -> None:
         """Operator-initiated drain (the dashboard drain button's RPC):
         forwards a request_drain to the replica's manager; the trainer sees
         ``Manager.drain_requested()`` on its next quorum and drains at a
         step boundary it knows is safe. No reference analog — the
         reference dashboard only has a kill button."""
-        self._client.call(
-            {"type": "drain", "replica_id": replica_id,
-             "timeout_ms": int(timeout * 1000)},
-            timeout,
-        )
+        req: Dict[str, Any] = {
+            "type": "drain", "replica_id": replica_id,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if job:
+            req["job"] = job
+        self._client.call(req, timeout)
 
-    def drain_all(self, timeout: float = 15.0) -> Dict[str, Any]:
+    def drain_all(
+        self, timeout: float = 15.0, job: str = ""
+    ) -> Dict[str, Any]:
         """Operator-initiated FULL-job drain (the dashboard's
         ``drain ALL`` button / ``POST /drain_all``): forwards
         request_drain to every registered member's manager. Each trainer
         drains at its own safe boundary — with ``--durable-dir`` that
         includes a final durable snapshot, so the stopped job can later
         be relaunched and resume (the operator-triggered twin of a
-        whole-pod preemption; see tools/drills.py preempt-all). Returns
+        whole-pod preemption; see tools/drills.py preempt-all). ``job``
+        scopes the drain to one namespace; empty drains every namespace
+        (the pre-namespace whole-instance semantics). Returns
         ``{"sent": {replica_id: bool}, "n_sent": .., "n_members": ..}``.
         No reference analog."""
-        resp = self._client.call(
-            {"type": "drain_all", "timeout_ms": int(timeout * 1000)},
-            timeout,
-        )
+        req: Dict[str, Any] = {
+            "type": "drain_all", "timeout_ms": int(timeout * 1000),
+        }
+        if job:
+            req["job"] = job
+        resp = self._client.call(req, timeout)
         return {
             "sent": resp.get("sent", {}),
             "n_sent": resp.get("n_sent", 0),
@@ -711,6 +754,7 @@ class ManagerServer:
         connect_timeout_ms: int = 10000,
         quorum_retries: int = 0,
         lighthouse_lease_ms: Optional[int] = None,
+        job: Optional[str] = None,
     ) -> None:
         host, port = _split_bind(bind)
         self.replica_id = replica_id
@@ -742,6 +786,11 @@ class ManagerServer:
             # list in lighthouse_addr. None defers to the binary's default
             # (3000 ms, or TORCHFT_LH_LEASE_MS).
             argv += ["--lh-lease-ms", str(lighthouse_lease_ms)]
+        if job:
+            # Job namespace stamped on every frame to the lighthouse.
+            # None defers to the binary's default ("default", or
+            # TORCHFT_JOB).
+            argv += ["--job", str(job)]
         self._server = _ServerProcess(argv, f"manager[{replica_id}]")
 
     def address(self) -> str:

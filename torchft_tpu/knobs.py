@@ -185,6 +185,27 @@ _ALL = [
         scope="cpp",
     ),
     _k(
+        "TORCHFT_JOB",
+        "str",
+        "default",
+        "Job namespace stamped on every heartbeat/quorum/leave frame; the lighthouse keeps fully isolated per-job membership, quorum numbering, fleet tables, and anomaly rings. `default` matches the pre-namespace wire behavior.",
+        scope="both",
+    ),
+    _k(
+        "TORCHFT_LH_DISTRICT",
+        "str",
+        None,
+        "District name for a federated lighthouse; with TORCHFT_LH_ROOT set, the active instance piggybacks per-job fleet rollups upward on the heartbeat channel. The --district flag wins over the env.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_ROOT",
+        "str",
+        None,
+        "Root lighthouse address `host:port` a district lighthouse reports its per-job rollup digests to; unset = federation off. The --root flag wins over the env.",
+        scope="cpp",
+    ),
+    _k(
         "TORCHFT_TIMEOUT_SEC",
         "float",
         None,
@@ -339,6 +360,13 @@ _ALL = [
         "64",
         "Per-replica series cardinality cap shared by the lighthouse /metrics endpoint and tools/obs_export.py: above this many fleet replicas, only aggregates plus anomalous/straggler replicas get per-replica series.",
         scope="both",
+    ),
+    _k(
+        "TORCHFT_EXPORT_MAX_JOBS",
+        "int",
+        "64",
+        "Per-job series cardinality cap in tools/obs_export.py: above this many job namespaces in the composite fleet payload, only jobs with stragglers or anomalies get per-job rollup series (plus a suppressed-count gauge).",
+        scope="py",
     ),
     # -- C++-only ----------------------------------------------------------
     _k(

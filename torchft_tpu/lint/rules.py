@@ -775,6 +775,10 @@ def rule_artifact_hygiene(root: str) -> List[Finding]:
 FLEET_TOP_KEYS = {
     "ts_ms", "gen", "snap_ms", "replicas", "agg", "anomalies",
     "anomaly_seq",
+    # Namespace plane: every payload names its job island; the composite
+    # (unfiltered) payload adds per-job summary rollups and the root
+    # lighthouse's district table.
+    "job", "jobs", "districts",
 }
 FLEET_ROW_KEYS = {
     "last_hb_age_ms", "hb_interval_ms", "digest", "digest_age_ms",

@@ -272,6 +272,10 @@ class Manager:
                 world_size=self._group_world_size,
                 quorum_retries=quorum_retries,
                 heartbeat_interval_ms=heartbeat_interval_ms,
+                # Job namespace this training job's frames land in at the
+                # lighthouse; empty/unset stays on the binary's "default"
+                # island (pre-namespace behavior, bit-for-bit).
+                job=knobs.get_str("TORCHFT_JOB") or None,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager_server.address())
             self._store.set(REPLICA_ID_KEY, full_replica_id)

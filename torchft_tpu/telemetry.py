@@ -463,6 +463,13 @@ EVENT_KINDS: Dict[str, str] = {
                    "(active entry's heartbeat lease lapsed)",
     "lh_epoch": "a quorum carrying a new fencing epoch was accepted "
                 "(standby takeover observed; stale primaries now fenced)",
+    # -- multi-tenant / federation (tools/fleet_load.py) -----------------
+    "job_churn": "seeded churn burst applied inside one job namespace "
+                 "(kills/joins scoped to that island; siblings must stay "
+                 "bit-exact)",
+    "district_failover": "district lighthouse failed over; the root "
+                         "accepted a higher epoch for the district and "
+                         "fenced the stale primary's rollups",
 }
 
 
