@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Detection-latency attribution over event journals: ground truth ->
+first signal -> quorum re-form -> recovery start, tiled per injection.
+
+``recovery_report.py`` decomposes *recovery* (the healer's episode);
+this report decomposes *detection*: for every seeded ``chaos_inject``
+(the ground-truth timestamp the chaos plane journals at the moment of
+injection) it finds the first ``failure_signal`` the evidence bus
+raised for it, the first ``quorum_ready`` after that signal, and the
+first recovery activity (a heal attempt or relaunch) after that — and
+splits the injection-to-reaction window into three phases that tile it
+exactly by construction::
+
+    signal_s   injection        -> first failure_signal
+    quorum_s   first signal     -> first quorum_ready after it
+    react_s    quorum re-form   -> first heal/relaunch event
+
+Phases an injection never reached stay None (a detect-drill journal has
+signals but no quorum plane; a clean drain has neither), and the tiling
+identity is asserted over the phases that exist. Aggregation is per
+(fault kind x winning signal source) — the matrix FAULT_MODEL.md
+documents and ``BENCH_DETECT.json`` pins.
+
+Usage::
+
+    python tools/detect_report.py /tmp/journal/          # dir of *.jsonl
+    python tools/detect_report.py --from-bench BENCH_DETECT.json --check
+    python tools/detect_report.py journal/ --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import obs_report  # noqa: E402
+
+TILE_EPS_S = 1e-6
+
+# Events that mark the start of recovery work after a re-formed quorum.
+REACT_EVENTS = ("heal_attempt", "heal_start", "heal_recv_start",
+                "step_relaunch", "train_start")
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _first_after(events: List[Dict[str, Any]], t: float,
+                 names: tuple, subject: str = "") -> Optional[Dict[str, Any]]:
+    """Earliest event of one of ``names`` at/after ``t`` (events must be
+    ts-sorted). ``subject`` narrows failure_signal matches to signals
+    naming that replica."""
+    for ev in events:
+        ts = float(ev.get("ts", 0.0))
+        if ts < t:
+            continue
+        if ev.get("event") not in names:
+            continue
+        if subject and ev.get("event") == "failure_signal":
+            attrs = ev.get("attrs") or {}
+            if str(attrs.get("subject", "")) != subject:
+                continue
+        return ev
+    return None
+
+
+def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-injection attribution rows plus the (kind x source) matrix."""
+    evs = sorted(events, key=lambda e: float(e.get("ts", 0.0)))
+    rows: List[Dict[str, Any]] = []
+    for ev in evs:
+        if ev.get("event") != "chaos_inject":
+            continue
+        attrs = ev.get("attrs") or {}
+        t0 = float(ev.get("ts", 0.0))
+        kind = str(attrs.get("kind", ""))
+        victim = str(attrs.get("site", ""))
+        sig = _first_after(evs, t0, ("failure_signal",), subject=victim)
+        if sig is None:
+            # Any first signal at all (the drill journals only matching
+            # winners; real trainer journals signal whoever observed it).
+            sig = _first_after(evs, t0, ("failure_signal",))
+        row: Dict[str, Any] = {
+            "t_inject": t0,
+            "kind": kind,
+            "victim": victim,
+            "expected_source": attrs.get("expected_source"),
+            "source": None,
+            "signal_s": None,
+            "quorum_s": None,
+            "react_s": None,
+            "total_s": None,
+        }
+        if sig is not None:
+            sattrs = sig.get("attrs") or {}
+            t_sig = float(sig.get("ts", 0.0))
+            row["source"] = str(sattrs.get("source", ""))
+            row["site"] = str(sattrs.get("site", ""))
+            row["signal_s"] = round(t_sig - t0, 6)
+            q = _first_after(evs, t_sig, ("quorum_ready",))
+            if q is not None:
+                t_q = float(q.get("ts", 0.0))
+                row["quorum_s"] = round(t_q - t_sig, 6)
+                r = _first_after(evs, t_q, REACT_EVENTS)
+                if r is not None:
+                    t_r = float(r.get("ts", 0.0))
+                    row["react_s"] = round(t_r - t_q, 6)
+                    row["react_event"] = r.get("event")
+            # total spans exactly the phases that exist, so the tiling
+            # identity (total == sum of non-None phases) holds by
+            # construction and --check can assert it survived the math.
+            row["total_s"] = round(sum(
+                v for v in (row["signal_s"], row["quorum_s"],
+                            row["react_s"]) if v is not None
+            ), 6)
+        rows.append(row)
+
+    by_pair: Dict[str, List[float]] = {}
+    for row in rows:
+        if row["signal_s"] is None:
+            continue
+        by_pair.setdefault(
+            f"{row['kind']}.{row['source']}", []
+        ).append(row["signal_s"])
+    matrix = {
+        pair: {
+            "n": len(v),
+            "p50_s": round(_pct(v, 0.50), 6),
+            "p95_s": round(_pct(v, 0.95), 6),
+        }
+        for pair, v in sorted(by_pair.items())
+    }
+    detected = [r for r in rows if r["signal_s"] is not None]
+    sigs = [r["signal_s"] for r in detected]
+    return {
+        "rows": rows,
+        "summary": {
+            "num_injections": len(rows),
+            "num_detected": len(detected),
+            "signal_p50_s": _pct(sigs, 0.50),
+            "signal_p95_s": _pct(sigs, 0.95),
+            "matrix": matrix,
+        },
+    }
+
+
+def check(report: Dict[str, Any],
+          require_detected: bool = False) -> List[str]:
+    """Invariant violations (empty = pass): phase non-negativity, the
+    tiling identity over present phases, expected-source agreement when
+    the injection declared one, matrix consistency."""
+    errs: List[str] = []
+    for i, row in enumerate(report["rows"]):
+        tag = f"injection {i} ({row['kind']}@{row['victim']})"
+        phases = [row[k] for k in ("signal_s", "quorum_s", "react_s")]
+        for k, v in zip(("signal_s", "quorum_s", "react_s"), phases):
+            if v is not None and v < -TILE_EPS_S:
+                errs.append(f"{tag}: negative {k} ({v})")
+        present = [v for v in phases if v is not None]
+        if present:
+            if row["total_s"] is None:
+                errs.append(f"{tag}: phases present but no total")
+            elif abs(sum(present) - row["total_s"]) > TILE_EPS_S:
+                errs.append(
+                    f"{tag}: phases sum {sum(present):.6f}s != total "
+                    f"{row['total_s']:.6f}s")
+        # Later phases require the earlier one: quorum_s without a signal
+        # (or react_s without a quorum) would mean attribution skipped a
+        # stage of the evidence chain.
+        if row["quorum_s"] is not None and row["signal_s"] is None:
+            errs.append(f"{tag}: quorum phase without a signal phase")
+        if row["react_s"] is not None and row["quorum_s"] is None:
+            errs.append(f"{tag}: react phase without a quorum phase")
+        if require_detected and row["signal_s"] is None:
+            errs.append(f"{tag}: never detected")
+        exp = row.get("expected_source")
+        if exp and row["source"] and row["source"] != exp:
+            errs.append(
+                f"{tag}: first signal came from {row['source']!r}, "
+                f"expected {exp!r}")
+    n_mat = sum(d["n"] for d in report["summary"]["matrix"].values())
+    n_det = report["summary"]["num_detected"]
+    if n_mat != n_det:
+        errs.append(
+            f"matrix covers {n_mat} detection(s) but {n_det} detected "
+            f"injection(s) exist")
+    return errs
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    out: List[str] = []
+    s = report["summary"]
+    out.append(
+        f"{'KIND':<18} {'VICTIM':<12} {'SOURCE':<15} {'SIGNAL':>8} "
+        f"{'QUORUM':>8} {'REACT':>8} {'TOTAL':>8}"
+    )
+
+    def cell(v: Optional[float]) -> str:
+        return "-" if v is None else f"{v:.3f}"
+
+    for row in report["rows"]:
+        out.append(
+            f"{row['kind']:<18} {row['victim']:<12} "
+            f"{str(row['source'] or 'UNDETECTED'):<15} "
+            f"{cell(row['signal_s']):>8} {cell(row['quorum_s']):>8} "
+            f"{cell(row['react_s']):>8} {cell(row['total_s']):>8}"
+        )
+    out.append("")
+    out.append(
+        f"{s['num_injections']} injection(s), {s['num_detected']} "
+        f"detected"
+        + (
+            f", signal p50 {s['signal_p50_s']:.3f}s "
+            f"p95 {s['signal_p95_s']:.3f}s"
+            if s["signal_p50_s"] is not None else ""
+        )
+    )
+    for pair, d in s["matrix"].items():
+        out.append(
+            f"  {pair}: n={d['n']} p50 {d['p50_s']:.3f}s "
+            f"p95 {d['p95_s']:.3f}s"
+        )
+    return "\n".join(out)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*",
+                   help="journal files or directories of *.jsonl")
+    p.add_argument("--from-bench", metavar="FILE", default=None,
+                   help="read the journal dir from a BENCH_DETECT.json "
+                   "artifact (its journal_dir field)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON")
+    p.add_argument("--check", action="store_true",
+                   help="assert tiling/attribution invariants; exit 1 on "
+                   "violation")
+    p.add_argument("--require-detected", action="store_true",
+                   help="with --check: every injection must have a "
+                   "first signal")
+    p.add_argument("--min-injections", type=int, default=0,
+                   help="with --check: at least this many injections")
+    args = p.parse_args(argv)
+
+    paths = list(args.paths)
+    if args.from_bench:
+        with open(args.from_bench) as f:
+            doc = json.load(f)
+        jd = doc.get("journal_dir")
+        if not jd:
+            print(f"{args.from_bench} has no journal_dir", file=sys.stderr)
+            return 1
+        paths.append(jd)
+    if not paths:
+        p.error("give journal paths or --from-bench")
+
+    events = obs_report.load_events(paths)
+    if not events:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    report = analyze(events)
+
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(render_text(report))
+
+    if args.check:
+        errs = check(report, require_detected=args.require_detected)
+        if args.min_injections and (
+            report["summary"]["num_injections"] < args.min_injections
+        ):
+            errs.append(
+                f"{report['summary']['num_injections']} injection(s) < "
+                f"--min-injections {args.min_injections}"
+            )
+        if errs:
+            for e in errs:
+                print(f"CHECK FAIL: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"detect_report check OK: "
+            f"{report['summary']['num_injections']} injection(s), "
+            f"{report['summary']['num_detected']} detected, phases tile"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,6 +176,27 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
            "(feed incomplete when > 0).")
     lines.append(f"torchft_exporter_fleet_anomalies_dropped{{{jl}}} "
                  f"{int(agg.get('anomalies_dropped', 0))}")
+    header("torchft_exporter_fleet_signals_total",
+           "Failure-evidence signals ingested since lighthouse boot.")
+    lines.append(f"torchft_exporter_fleet_signals_total{{{jl}}} "
+                 f"{int(fleet.get('signal_seq', 0))}")
+    header("torchft_exporter_fleet_signals_dropped",
+           "Failure-evidence records evicted from the lighthouse signal "
+           "ring (evidence feed incomplete when > 0).")
+    lines.append(f"torchft_exporter_fleet_signals_dropped{{{jl}}} "
+                 f"{int(agg.get('signals_dropped', 0))}")
+    # Per-source signal counts: the source enum is closed (SIGNAL_SOURCES,
+    # six values) so this series set is cardinality-bounded by construction
+    # — unknown keys from a newer lighthouse still emit, but there can only
+    # be as many as the lighthouse's own enum admits.
+    sig_counts = fleet.get("signal_counts") or {}
+    if sig_counts:
+        header("torchft_exporter_fleet_signals_by_source",
+               "Failure-evidence signals ingested per signal source.")
+        for src in sorted(sig_counts):
+            lines.append(
+                f'torchft_exporter_fleet_signals_by_source{{{jl},'
+                f'source="{esc(src)}"}} {int(sig_counts[src])}')
     header("torchft_exporter_replicas_suppressed",
            "Healthy replicas collapsed into aggregates by the "
            "TORCHFT_EXPORT_MAX_REPLICAS cardinality bound.")
@@ -328,6 +349,58 @@ def journal_overflow(journal: Optional[EventLog],
         if journal is not None:
             journal.emit(
                 "anomaly_overflow",
+                dropped_total=dropped,
+                new_drops=dropped - last_dropped,
+            )
+        return dropped
+    return last_dropped
+
+
+def journal_signals(journal: Optional[EventLog],
+                    fleet: Optional[Dict[str, Any]],
+                    cursor: int) -> int:
+    """Emit every failure-evidence signal newer than ``cursor`` as a
+    ``failure_signal`` journal event; returns the new cursor. Signals carry
+    a lighthouse-assigned monotone ``seq`` like anomalies, so a restarting
+    exporter only replays what the ring still holds — and detection-latency
+    reports get the lighthouse's observation site and timestamp for every
+    signal even when the emitting trainer's own journal was lost with it."""
+    if fleet is None:
+        return cursor
+    for rec in fleet.get("signals") or []:
+        seq = int(rec.get("seq", 0))
+        if seq <= cursor:
+            continue
+        cursor = seq
+        if journal is not None:
+            journal.emit(
+                "failure_signal",
+                seq=seq,
+                source=str(rec.get("source", "")),
+                subject=str(rec.get("replica_id", "")),
+                site=str(rec.get("site", "")),
+                ts_ms=int(rec.get("ts_ms", 0)),
+                detail=rec.get("detail"),
+            )
+    return cursor
+
+
+def journal_signal_overflow(journal: Optional[EventLog],
+                            fleet: Optional[Dict[str, Any]],
+                            last_dropped: int) -> int:
+    """Journal a single ``signal_overflow`` event on the rise edge of the
+    lighthouse's signal-ring drop counter; returns the new high-water mark.
+    Same shape as ``anomaly_overflow``: one event per observed rise, with
+    the delta riding the event, so a churning ring can't flood the journal
+    — but a detection report knows its evidence feed has a hole."""
+    if fleet is None:
+        return last_dropped
+    agg = fleet.get("agg") or {}
+    dropped = int(agg.get("signals_dropped", 0))
+    if dropped > last_dropped:
+        if journal is not None:
+            journal.emit(
+                "signal_overflow",
                 dropped_total=dropped,
                 new_drops=dropped - last_dropped,
             )
@@ -528,6 +601,8 @@ def main(argv: Optional[list] = None) -> int:
             if fleet is not None:
                 journal_anomalies(journal, fleet, 0)
                 journal_overflow(journal, fleet, 0)
+                journal_signals(journal, fleet, 0)
+                journal_signal_overflow(journal, fleet, 0)
                 sys.stdout.write(render_fleet_prometheus(fleet))
         if args.journal:
             sys.stdout.write(
@@ -553,6 +628,8 @@ def main(argv: Optional[list] = None) -> int:
     scrapes = 0
     anomaly_cursor = 0
     overflow_mark = 0
+    signal_cursor = 0
+    signal_overflow_mark = 0
     try:
         while True:
             try:
@@ -566,6 +643,12 @@ def main(argv: Optional[list] = None) -> int:
                 )
                 overflow_mark = journal_overflow(
                     journal, fleet, overflow_mark
+                )
+                signal_cursor = journal_signals(
+                    journal, fleet, signal_cursor
+                )
+                signal_overflow_mark = journal_signal_overflow(
+                    journal, fleet, signal_overflow_mark
                 )
                 scrapes += 1
                 if args.max_scrapes and scrapes >= args.max_scrapes:

@@ -165,11 +165,18 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
         f"anomalies={int(fleet.get('anomaly_seq', 0))}"
         + (f" dropped={int(agg.get('anomalies_dropped', 0))}"
            if agg.get("anomalies_dropped") else "")
+        # SIGNALS: failure-evidence count since boot — the unified bus the
+        # lighthouse reacts on; sig_dropped > 0 means the evidence ring
+        # churned past a scrape and detection attribution has a hole.
+        + f" signals={int(fleet.get('signal_seq', 0))}"
+        + (f" sig_dropped={int(agg.get('signals_dropped', 0))}"
+           if agg.get("signals_dropped") else "")
         + (f" showing={len(order)}/{len(replicas)}" if hidden > 0 else ""),
         ANSI_BOLD))
     header = (f"{'REPLICA':<20} {'STEP':>7} {'RATE/s':>7} {'GOOD%':>6} "
               f"{'Q95ms':>7} {'H95ms':>7} {'C95ms':>7} {'A95ms':>7} "
-              f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7} {'HEAL':>9}  FLAGS")
+              f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7} {'HEAL':>9} "
+              f"{'SIGNAL':>14}  FLAGS")
     lines.append(paint(header, ANSI_BOLD))
     for rid in order:
         r = replicas[rid]
@@ -185,6 +192,10 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
             tag = (tag + " TTR_BUDGET").strip()
         heal_cell = ("-" if heal_s is None
                      else f"{heal_s:.1f}/{ttr_budget_s:.0f}")
+        # SIGNAL: the most recent failure-evidence source naming this
+        # replica as its subject (proc_death, hb_lapse, ...) — what the
+        # evidence plane last learned about it, straight from the ring.
+        signal_cell = str(r.get("signal") or "-")[:14]
         gp = dg.get("gp")
         row = (
             f"{str(rid)[:20]:<20} "
@@ -198,7 +209,8 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
             f"{_fmt(_phase_ms(dg, 'm'), '{:.1f}'):>7} "
             f"{_bw_summary(dg):>6} "
             f"{_fmt(r.get('last_hb_age_ms'), '{:.0f}'):>7} "
-            f"{heal_cell:>9}  "
+            f"{heal_cell:>9} "
+            f"{signal_cell:>14}  "
             f"{tag}"
         )
         if straggler or over_budget:
@@ -263,6 +275,19 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
                 f"replica={rec.get('replica_id')} "
                 f"detail={json.dumps(rec.get('detail'))}"
             )
+    # Failure-evidence tail: newest entries of the lighthouse signal ring,
+    # with the observation site — where in the system the evidence came
+    # from (runner.monitor vs lighthouse.leave vs a manager's hb loop).
+    signals = fleet.get("signals") or []
+    if signals:
+        lines.append("")
+        lines.append(paint("recent signals:", ANSI_BOLD))
+        for rec in signals[-8:]:
+            lines.append(
+                f"  #{rec.get('seq')} {rec.get('source')} "
+                f"subject={rec.get('replica_id')} "
+                f"site={rec.get('site')}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -314,6 +339,13 @@ def check_frame(fleet: Dict[str, Any], frame: str,
             if f"{heal_s:.1f}/" not in row:
                 problems.append(
                     f"replica {rid!r} heal cell not rendered")
+        sig = replicas[rid].get("signal")
+        if sig:
+            row = next(ln for ln in frame_lines if ln.startswith(shown))
+            if str(sig)[:14] not in row:
+                problems.append(
+                    f"replica {rid!r} failure-evidence signal {sig!r} "
+                    f"not rendered in its SIGNAL column")
     head = frame_lines[0] if frame_lines else ""
     if f"replicas={int(agg.get('n', 0))}" not in head:
         problems.append("aggregate replica count missing from header")
@@ -327,6 +359,15 @@ def check_frame(fleet: Dict[str, Any], frame: str,
     if world not in head:
         problems.append("WORLD (quorum size + join/leave churn) missing "
                         "from header")
+    if f"signals={int(fleet.get('signal_seq', 0))}" not in head:
+        problems.append("failure-evidence signal count missing from header")
+    for rec in (fleet.get("signals") or [])[-8:]:
+        want = f"#{rec.get('seq')} {rec.get('source')}"
+        if not any(want in ln for ln in frame_lines):
+            problems.append(
+                f"signal seq {rec.get('seq')} "
+                f"({rec.get('source')!r}) missing from the recent-signals "
+                f"tail")
     # Namespace rollup: every job island in the composite payload must
     # render its summary line (n + world), and every district its
     # up/LOST row — federation health must never be silently dropped.

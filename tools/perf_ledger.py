@@ -152,8 +152,8 @@ def record_report(
     """Append a live tool report's headline metrics, reusing the same
     extractors as the legacy-artifact importer so live runs extend the
     backfilled trajectories under identical metric names. ``kind`` is
-    one of bench|pg|fleet|wan|recovery|elastic|control. Returns the
-    number of records
+    one of bench|pg|fleet|wan|recovery|elastic|control|detect. Returns
+    the number of records
     appended;
     never raises into the calling bench."""
     try:
@@ -440,6 +440,29 @@ def _recovery_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _detect_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """BENCH_DETECT.json (tools/detect_drill.py): detection latency of
+    the failure-evidence bus, overall and per (fault kind x first signal
+    source) — the numbers the detect gate pins with absolute budgets."""
+    src = f"tools/detect_drill.py ({os.path.basename(fn)})"
+    summ = doc.get("summary") or {}
+    out = []
+    n_f = summ.get("num_faults")
+    extra = {"faults": n_f} if n_f is not None else None
+    if summ.get("detect_p50_s") is not None:
+        out.append(("detect.p50_s", float(summ["detect_p50_s"]), "s",
+                    "lower", "detect", src, extra))
+    if summ.get("detect_p95_s") is not None:
+        out.append(("detect.p95_s", float(summ["detect_p95_s"]), "s",
+                    "lower", "detect", src, extra))
+    for pair, row in (summ.get("detect") or {}).items():
+        if isinstance(row, dict) and row.get("p95_s") is not None:
+            out.append((f"detect.{pair}.p95_s", float(row["p95_s"]), "s",
+                        "lower", "detect", src,
+                        {"n": row.get("n"), "budget_s": row.get("budget_s")}))
+    return out
+
+
 def _control_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     """BENCH_CONTROL.json (tools/lighthouse_drill.py): control-plane TTR
     after killing the active lighthouse — failover detection latency,
@@ -480,6 +503,7 @@ _REPORT_EXTRACTORS = {
     "recovery": _recovery_records,
     "elastic": _elastic_records,
     "control": _control_records,
+    "detect": _detect_records,
 }
 
 

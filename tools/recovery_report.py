@@ -66,9 +66,46 @@ def _percentile(vals: List[float], pct: float) -> Optional[float]:
     return vs[lo] + (vs[hi] - vs[lo]) * (k - lo)
 
 
+def attribute_detect(events: List[Dict[str, Any]],
+                     episodes: List[Dict[str, Any]],
+                     lookback_s: float = 10.0) -> None:
+    """Annotate each episode with its *winning* failure-evidence signal:
+    the earliest ``failure_signal`` journal event correlated with the
+    episode window (within ``lookback_s`` before it — evidence like a
+    runner's proc_death line or the lighthouse ring can predate the first
+    latch — or inside it). Sets ``episode["detect_signal"]`` to the
+    winning signal's source/subject/site plus its lead over the episode
+    start, or ``None`` when the episode ran without the evidence plane.
+    Pure annotation: the phase tiling is untouched, so ``--check``'s
+    invariant is unaffected."""
+    signals = sorted(
+        (ev for ev in events if ev.get("event") == "failure_signal"),
+        key=lambda ev: float(ev.get("ts", 0.0)),
+    )
+    for e in episodes:
+        win = None
+        for ev in signals:
+            ts = float(ev.get("ts", 0.0))
+            if ts > float(e["t_end"]):
+                break
+            if ts < float(e["t_start"]) - lookback_s:
+                continue
+            attrs = ev.get("attrs") or {}
+            win = {
+                "source": str(attrs.get("source", "")),
+                "subject": str(attrs.get("subject", "")),
+                "site": str(attrs.get("site", "")),
+                "ts": ts,
+                "lead_s": round(float(e["t_start"]) - ts, 6),
+            }
+            break
+        e["detect_signal"] = win
+
+
 def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Full report dict from a merged event list."""
     episodes = telemetry.detect_episodes(events)
+    attribute_detect(events, episodes)
     closed = [e for e in episodes if not e["open"]]
     ttrs = [e["ttr_s"] for e in closed]
     phases: Dict[str, Dict[str, Any]] = {}
@@ -108,6 +145,23 @@ def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         causes[e["root_cause"]["kind"]] = (
             causes.get(e["root_cause"]["kind"], 0) + 1
         )
+    # Detect-phase split by winning signal source: which evidence path
+    # actually noticed each failure first, and how the detect phase
+    # distributes per path — the per-source view BENCH_DETECT budgets.
+    by_source: Dict[str, List[float]] = {}
+    for e in closed:
+        src = (e.get("detect_signal") or {}).get("source") or "none"
+        by_source.setdefault(src, []).append(
+            e["replicas"][e["primary"]]["phases"]["detect"]
+        )
+    detect_by_source = {
+        src: {
+            "n": len(v),
+            "p50_s": _percentile(v, 50),
+            "p95_s": _percentile(v, 95),
+        }
+        for src, v in sorted(by_source.items())
+    }
     return {
         "episodes": episodes,
         "summary": {
@@ -117,6 +171,7 @@ def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "ttr_p95_s": _percentile(ttrs, 95),
             "ttr_max_s": max(ttrs) if ttrs else None,
             "phases": phases,
+            "detect_by_source": detect_by_source,
             "heal_gib_s": heal_gib_s,
             "failed_attempts": sum(
                 r["failed_attempts"]
@@ -155,6 +210,17 @@ def check(report: Dict[str, Any]) -> List[str]:
                         f"{e['id']}/{rid}: failed attempt without a "
                         "latched cause"
                     )
+    # Detect attribution must partition the closed episodes: every closed
+    # episode lands in exactly one detect_by_source bucket ("none" when
+    # the run had no evidence plane), so the per-source ns sum back up.
+    by_source = report["summary"].get("detect_by_source") or {}
+    n_closed = sum(1 for e in report["episodes"] if not e["open"])
+    n_attr = sum(int(d.get("n", 0)) for d in by_source.values())
+    if n_attr != n_closed:
+        errs.append(
+            f"detect_by_source buckets cover {n_attr} episode(s) but "
+            f"{n_closed} closed episode(s) exist"
+        )
     return errs
 
 
@@ -180,6 +246,9 @@ def emit_episodes(report: Dict[str, Any], path: str) -> int:
                 catchup_ms=round(prim["phases"]["catchup"] * 1e3, 3),
                 root_cause=e["root_cause"]["kind"],
                 root_replica=e["root_cause"]["replica"],
+                detect_source=(
+                    (e.get("detect_signal") or {}).get("source") or "none"
+                ),
                 cascade=[c["to"] for c in e["cascade"]],
                 failed_attempts=sum(
                     r["failed_attempts"] for r in e["replicas"].values()
@@ -215,6 +284,12 @@ def render_text(report: Dict[str, Any]) -> str:
             f"replica {rc['replica']}{detail}, primary {e['primary']}"
             + (f", trace {e['trace']}" if e.get("trace") else "")
         )
+        ds = e.get("detect_signal")
+        if ds:
+            out.append(
+                f"  detected by {ds['source']} (subject {ds['subject']}, "
+                f"site {ds['site']}, lead {ds['lead_s']:+.3f}s)"
+            )
         for edge in e["cascade"]:
             out.append(
                 f"  cascade: {edge['from']} -> {edge['to']} "
@@ -267,6 +342,11 @@ def render_text(report: Dict[str, Any]) -> str:
         out.append(
             f"heal bandwidth [{t}]: p50 {g['p50']:.3f} GiB/s over "
             f"{g['n']} transfer(s), {g['bytes'] / (1 << 20):.2f} MiB"
+        )
+    for src, d in (s.get("detect_by_source") or {}).items():
+        out.append(
+            f"detect via [{src}]: {d['n']} episode(s), "
+            f"p50 {d['p50_s']:.3f}s p95 {d['p95_s']:.3f}s"
         )
     return "\n".join(out)
 

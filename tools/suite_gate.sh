@@ -77,6 +77,15 @@
 #                                       # district failover fenced at the
 #                                       # root -> BENCH_FLEET.json multijob
 #                                       # section, then perf_gate --check
+#        bash tools/suite_gate.sh detect # detection-latency drill: seeded
+#                                       # ground-truth faults (hb stop,
+#                                       # digest stall, dead leave, piggyback
+#                                       # abort) vs the failure-evidence bus
+#                                       # -> BENCH_DETECT.json, attribution
+#                                       # report --check (phases tile, first
+#                                       # source matches the fault kind),
+#                                       # same-seed replay, then perf_gate
+#                                       # --check vs pinned detection budgets
 #        bash tools/suite_gate.sh control # control-plane-loss drill: kill
 #                                       # the active lighthouse mid-run ->
 #                                       # warm-standby takeover (epoch+1),
@@ -153,6 +162,21 @@ if [ "${1:-}" = "recovery" ]; then
   timeout 120 env JAX_PLATFORMS=cpu python tools/recovery_report.py \
     --from-bench BENCH_RECOVERY.json --check --min-episodes 1 || exit 1
   echo "== recovery gate: ledger head vs pinned baselines =="
+  exec timeout 120 python tools/perf_gate.py --check
+fi
+
+if [ "${1:-}" = "detect" ]; then
+  echo "== detect drill: seeded faults vs the failure-evidence signal bus =="
+  timeout 600 env JAX_PLATFORMS=cpu python tools/detect_drill.py --quick \
+    || exit 1
+  echo "== detect report: injection -> signal -> quorum -> react must tile =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/detect_report.py \
+    --from-bench BENCH_DETECT.json --check --require-detected \
+    --min-injections 8 || exit 1
+  echo "== detect replay: same seed must reproduce the fault plan =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/detect_drill.py \
+    --replay || exit 1
+  echo "== detect gate: ledger head vs pinned detection budgets =="
   exec timeout 120 python tools/perf_gate.py --check
 fi
 

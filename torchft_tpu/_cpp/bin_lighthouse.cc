@@ -39,6 +39,17 @@ int main(int argc, char** argv) {
   if (di_env != nullptr && *di_env != '\0') opts.district = di_env;
   const char* ro_env = std::getenv("TORCHFT_LH_ROOT");
   if (ro_env != nullptr && *ro_env != '\0') opts.root_addr = ro_env;
+  // Failure-evidence plane: the reaction switch (signals are always
+  // collected) plus the cadence-aware hb-lapse eviction budget.
+  const char* ev_env = std::getenv("TORCHFT_LH_EVIDENCE");
+  if (ev_env != nullptr && *ev_env != '\0')
+    opts.evidence = std::stoll(ev_env) != 0;
+  const char* em_env = std::getenv("TORCHFT_LH_EVICT_MULT");
+  if (em_env != nullptr && *em_env != '\0')
+    opts.evict_mult = std::stoll(em_env);
+  const char* ef_env = std::getenv("TORCHFT_LH_EVICT_FLOOR_MS");
+  if (ef_env != nullptr && *ef_env != '\0')
+    opts.evict_floor_ms = std::stoll(ef_env);
   bool have_min = false;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];

@@ -17,7 +17,8 @@ static const char* kUsage =
     "         --store-address HOST:PORT --world-size N\n"
     "         [--advertise-host H] [--bind-host H] [--port P]\n"
     "         [--heartbeat-interval-ms N] [--connect-timeout-ms N]\n"
-    "         [--quorum-retries N] [--lh-lease-ms N] [--job NAME]\n";
+    "         [--quorum-retries N] [--lh-lease-ms N] [--job NAME]\n"
+    "         [--evidence-streak N]\n";
 
 int main(int argc, char** argv) {
   tft::ManagerOpts opts;
@@ -30,6 +31,10 @@ int main(int argc, char** argv) {
   // the lighthouse); the flag wins over the env knob.
   const char* job_env = std::getenv("TORCHFT_JOB");
   if (job_env != nullptr && *job_env != '\0') opts.job = job_env;
+  // Hard-evidence failover streak (0 = lease lapse only); flag wins.
+  const char* es_env = std::getenv("TORCHFT_MGR_EVIDENCE_STREAK");
+  if (es_env != nullptr && *es_env != '\0')
+    opts.evidence_streak = std::stoll(es_env);
   int64_t parent_pid = 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -62,6 +67,8 @@ int main(int argc, char** argv) {
       opts.quorum_retries = std::stoll(next());
     } else if (a == "--lh-lease-ms") {
       opts.lighthouse_lease_ms = std::stoll(next());
+    } else if (a == "--evidence-streak") {
+      opts.evidence_streak = std::stoll(next());
     } else if (a == "--job") {
       opts.job = next();
     } else if (a == "--parent-pid") {

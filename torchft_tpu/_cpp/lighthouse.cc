@@ -33,6 +33,19 @@ std::string job_of(const Json& req) {
   std::string j = req.get("job").as_str();
   return j.empty() ? "default" : j;
 }
+
+// Closed failure-evidence source enum — positionally mirrors
+// telemetry.SIGNAL_SOURCES on the Python side (lint rule signal-sources).
+const char* const kSignalSourceNames[] = {
+    "hb_lapse",       "lease_expiry", "digest_anomaly",
+    "rpc_error",      "native_abort", "proc_death",
+};
+
+bool known_signal_source(const std::string& s) {
+  for (const char* n : kSignalSourceNames)
+    if (s == n) return true;
+  return false;
+}
 }  // namespace
 
 Lighthouse::Lighthouse(const std::string& bind_host, int port,
@@ -372,6 +385,32 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms,
         // clients send neither field; the row simply stays digest-less.
         fleet_note_heartbeat(js, replica_id, req, now);
       }
+      // Failure-evidence ingest: manager-observed signals (rpc_error,
+      // native_abort, proc_death, lease_expiry) piggyback on the heartbeat
+      // frame. Old clients never send the key (wire back-compat); unknown
+      // sources are dropped rather than poisoning the closed enum.
+      if (req.has("signals") && req.get("signals").is_array()) {
+        int64_t now = now_ms();
+        bool ingested = false;
+        for (const auto& sg : req.get("signals").arr) {
+          const std::string src = sg.get("source").as_str();
+          if (!known_signal_source(src)) continue;
+          std::string subject = sg.get("replica_id").as_str();
+          if (subject.empty()) subject = replica_id;
+          std::string site = sg.get("site").as_str();
+          if (site.empty()) site = "manager:" + replica_id;
+          signal_note_locked(js, src, subject, site, sg.get("detail"), now);
+          ingested = true;
+        }
+        // Evidence tick: fresh evidence re-evaluates the quorum NOW (the
+        // periodic tick and vote-timeout landing stay as the fallback).
+        if (ingested && opts_.evidence) job_tick_locked(js, now_ms());
+      }
+      // The ACK carries the job's signal cursor + last signal so every
+      // manager's evidence_status view advances at heartbeat cadence with
+      // zero extra RPCs. Old managers ignore both keys.
+      resp["signal_seq"] = Json::of(js.signal_seq);
+      if (!js.signals.empty()) resp["signal"] = js.signals.back();
     }
     resp["ok"] = Json::of(true);
     hist_heartbeat_.observe_us(now_us_steady() - hb_t0);
@@ -393,11 +432,22 @@ Json Lighthouse::handle_request(const Json& req, int64_t deadline_ms,
     // form the shrunken quorum: ~quorum_tick_ms of stall instead of
     // ~heartbeat_timeout_ms.
     const std::string replica_id = req.get("replica_id").as_str();
+    const std::string reason = req.get("reason").as_str();
     JobState& js = job_state(job_of(req));
     {
       std::lock_guard<std::mutex> lk(js.mu);
       bool was_part = js.state.participants.count(replica_id) > 0;
       bool was_hb = js.state.heartbeats.count(replica_id) > 0;
+      // A leave on the DEAD replica's behalf (the manager binary's
+      // parent-death watchdog) is failure evidence, not a planned drain:
+      // signal proc_death so peers wedged mid-collective with the corpse
+      // abort at heartbeat speed instead of their collective timeout.
+      if ((was_part || was_hb) && reason == "trainer died") {
+        Json d = Json::object();
+        d["reason"] = Json::of(reason);
+        signal_note_locked(js, "proc_death", replica_id, "lighthouse.leave",
+                           std::move(d), now_ms());
+      }
       js.state.heartbeats.erase(replica_id);
       js.state.heartbeat_addrs.erase(replica_id);
       js.state.participants.erase(replica_id);
@@ -943,6 +993,7 @@ constexpr int64_t kFleetStepLag = 2;         // step < median-lag flags
 constexpr int64_t kFleetJitterMult = 8;      // budget = mult * cadence
 constexpr int64_t kFleetJitterFloorMs = 1000;
 constexpr int64_t kFleetEwmaWarmup = 5;      // gaps before EWMA budget counts
+constexpr size_t kFleetSignalRing = 64;      // failure signals kept
 // (The old full-sort fleet_median lived here; the MedianTracker members in
 // lighthouse.hpp maintain the identical upper median incrementally.)
 }  // namespace
@@ -984,6 +1035,16 @@ void Lighthouse::fleet_set_flag(JobState& js, const std::string& replica_id,
   fprintf(stderr, "[lighthouse] anomaly #%lld: %s on %s (job %s) %s\n",
           static_cast<long long>(js.anomaly_seq), kind.c_str(),
           replica_id.c_str(), js.name.c_str(), detail.dump().c_str());
+  // Digest-driven anomaly rise-edges double as failure evidence (the
+  // heartbeat-gap rules have their own cadence-aware hb_lapse source in
+  // the scan/eviction path, so they are excluded here).
+  if (kind != "hb_jitter") {
+    Json d = Json::object();
+    d["kind"] = Json::of(kind);
+    d["anomaly_seq"] = Json::of(js.anomaly_seq);
+    signal_note_locked(js, "digest_anomaly", replica_id, "lighthouse.digest",
+                       d, now);
+  }
 }
 
 void Lighthouse::fleet_clear_flag(JobState& js, FleetEntry& e,
@@ -991,6 +1052,62 @@ void Lighthouse::fleet_clear_flag(JobState& js, FleetEntry& e,
   if (e.flags.erase(kind) == 0) return;
   if (e.flags.empty()) js.flagged -= 1;
   js.fleet_gen += 1;
+}
+
+void Lighthouse::signal_note_locked(JobState& js, const std::string& source,
+                                    const std::string& replica_id,
+                                    const std::string& site, Json detail,
+                                    int64_t now) {
+  // One failure signal into the job's ring — same discipline as the anomaly
+  // ring: monotonic seq (the consumers' cursor), bounded ring, overflow pops
+  // the oldest and bumps the drop counter so the feed can't silently look
+  // complete.
+  js.signal_seq += 1;
+  js.signal_counts[source] += 1;
+  Json sgn = Json::object();
+  sgn["seq"] = Json::of(js.signal_seq);
+  sgn["ts_ms"] = Json::of(now);
+  sgn["replica_id"] = Json::of(replica_id);
+  sgn["source"] = Json::of(source);
+  sgn["site"] = Json::of(site);
+  sgn["job"] = Json::of(js.name);
+  sgn["detail"] = detail;
+  js.signals.push_back(sgn);
+  while (js.signals.size() > kFleetSignalRing) {
+    js.signals.pop_front();
+    js.signals_dropped += 1;
+  }
+  // Stamp the fleet row (never CREATE one: a signal about a replica the
+  // fleet never saw must not fabricate a liveness row).
+  auto it = js.fleet.find(replica_id);
+  if (it != js.fleet.end()) {
+    it->second.last_signal = source;
+    it->second.last_signal_ms = now;
+  }
+  js.fleet_gen += 1;
+  fprintf(stderr, "[lighthouse] signal #%lld: %s on %s via %s (job %s)\n",
+          static_cast<long long>(js.signal_seq), source.c_str(),
+          replica_id.c_str(), site.c_str(), js.name.c_str());
+}
+
+void Lighthouse::evidence_evict_locked(JobState& js,
+                                       const std::string& replica_id,
+                                       int64_t now) {
+  // Evidence says this replica is dead: drop it from the quorum tables NOW
+  // so the next evaluation forms the shrunken quorum, instead of waiting
+  // out heartbeat_timeout_ms. Same gate fixups as a graceful leave, but NO
+  // tombstone — evidence can be wrong, and the replica's next heartbeat or
+  // registration re-admits it with zero ceremony. The fleet row stays
+  // (flags, digest, last_signal intact) as detection forensics.
+  (void)now;
+  const bool was_part = js.state.participants.count(replica_id) > 0;
+  const bool was_hb = js.state.heartbeats.count(replica_id) > 0;
+  if (!was_part && !was_hb) return;
+  js.state.heartbeats.erase(replica_id);
+  js.state.heartbeat_addrs.erase(replica_id);
+  js.state.participants.erase(replica_id);
+  if (was_hb && !was_part) js.hb_not_joined -= 1;
+  if (was_part && js.prev_ids.count(replica_id)) js.prev_present -= 1;
 }
 
 // Retire / fold one entry's digest contributions. Together these keep the
@@ -1126,6 +1243,38 @@ void Lighthouse::fleet_scan_locked(JobState& js, int64_t now) {
       fleet_clear_flag(js, e, "hb_jitter");
     }
   }
+  // Evidence-driven hb-lapse eviction: a replica whose OPEN gap blew the
+  // cadence-aware budget is dead on evidence — signal it and drop it from
+  // the quorum tables immediately, so the shrunken quorum forms at tick
+  // speed instead of heartbeat_timeout_ms. Only replicas that DECLARED a
+  // cadence qualify (old clients keep the timeout path: wire back-compat),
+  // and only while they still hold a quorum-plane heartbeat entry — which
+  // also makes the signal naturally rise-edge-only.
+  if (opts_.evidence) {
+    std::vector<std::string> evict;
+    for (const auto& kv : js.fleet) {
+      const FleetEntry& e = kv.second;
+      if (e.hb_interval_ms <= 0) continue;
+      int64_t budget = e.hb_interval_ms * opts_.evict_mult;
+      if (budget < opts_.evict_floor_ms) budget = opts_.evict_floor_ms;
+      if (now - e.last_hb_ms <= budget) continue;
+      if (!js.state.heartbeats.count(kv.first)) continue;
+      evict.push_back(kv.first);
+    }
+    for (const auto& id : evict) {
+      const FleetEntry& e = js.fleet[id];
+      Json d = Json::object();
+      d["gap_ms"] = Json::of(now - e.last_hb_ms);
+      d["budget_ms"] =
+          Json::of(std::max(e.hb_interval_ms * opts_.evict_mult,
+                            opts_.evict_floor_ms));
+      signal_note_locked(js, "hb_lapse", id, "lighthouse.fleet_scan", d, now);
+      evidence_evict_locked(js, id, now);
+    }
+    // Evidence tick: fresh evidence re-evaluates the quorum NOW; the
+    // periodic tick and the timeout landing stay as the fallback.
+    if (!evict.empty()) job_tick_locked(js, now);
+  }
 }
 
 // Aggregate dict straight from the running trackers — O(1) medians/max plus
@@ -1153,6 +1302,7 @@ Json Lighthouse::fleet_agg_locked(JobState& js, int64_t now) {
   agg["max_commit_failures"] =
       Json::of(js.agg_cfs.empty() ? int64_t{0} : *js.agg_cfs.rbegin());
   agg["anomalies_dropped"] = Json::of(js.anomalies_dropped);
+  agg["signals_dropped"] = Json::of(js.signals_dropped);
   // Elastic-membership view: current quorum size plus cumulative
   // join/leave churn, so obs_top's WORLD column tracks capacity changes
   // (deliberate scale-up/down AND crash churn) from the same counters
@@ -1207,15 +1357,20 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   // string formatting that used to stall heartbeats happens unlocked.
   std::vector<std::pair<std::string, FleetEntry>> rows;
   std::deque<Json> anomalies;
+  std::deque<Json> signals;
+  std::map<std::string, int64_t> signal_counts;
   Json agg;
-  int64_t gen, aseq;
+  int64_t gen, aseq, sseq;
   {
     std::lock_guard<std::mutex> lk(js.mu);
     rows.assign(js.fleet.begin(), js.fleet.end());
     anomalies = js.anomalies;
+    signals = js.signals;
+    signal_counts = js.signal_counts;
     agg = fleet_agg_locked(js, now);
     gen = js.fleet_gen;
     aseq = js.anomaly_seq;
+    sseq = js.signal_seq;
   }
   auto snap = std::make_shared<FleetSnapshot>();
   snap->gen = gen;
@@ -1243,6 +1398,13 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
     r["flags"] = fl;
     r["straggler"] =
         Json::of(!e.flags.empty() || now < e.straggler_until_ms);
+    // Failure-evidence view: last signal source recorded about this
+    // replica and its age (null until any evidence arrives).
+    r["signal"] =
+        e.last_signal.empty() ? Json::null() : Json::of(e.last_signal);
+    r["signal_age_ms"] = e.last_signal.empty()
+                             ? Json::null()
+                             : Json::of(now - e.last_signal_ms);
     reps[kv.first] = r;
   }
   f["replicas"] = reps;
@@ -1251,6 +1413,13 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   for (const auto& a : anomalies) an.push(a);
   f["anomalies"] = an;
   f["anomaly_seq"] = Json::of(aseq);
+  Json sg = Json::array();
+  for (const auto& s : signals) sg.push(s);
+  f["signals"] = sg;
+  f["signal_seq"] = Json::of(sseq);
+  Json scnt = Json::object();
+  for (const auto& kv : signal_counts) scnt[kv.first] = Json::of(kv.second);
+  f["signal_counts"] = scnt;
   if (composite) {
     // Cross-job summary map + district table ride the composite payload
     // only — SUMMARIES, not full tables, so the default payload stays O(N
@@ -1275,6 +1444,7 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
 Json Lighthouse::fleet_summary_locked(JobState& js, int64_t now) {
   Json s = fleet_agg_locked(js, now);
   s["anomaly_seq"] = Json::of(js.anomaly_seq);
+  s["signal_seq"] = Json::of(js.signal_seq);
   s["gen"] = Json::of(js.fleet_gen);
   return s;
 }
@@ -1374,6 +1544,7 @@ std::string Lighthouse::render_metrics() {
     std::string name;
     int64_t quorum_id = 0, quorum_gen = 0, joins = 0, leaves = 0;
     int64_t aseq = 0, adropped = 0, gen = 0;
+    int64_t sseq = 0, sdropped = 0;
     size_t n_participants = 0, n_members = 0, n_fleet = 0;
     int64_t n_straggler = 0;
   };
@@ -1388,6 +1559,7 @@ std::string Lighthouse::render_metrics() {
   bool have_median = false;
   double median_rate = 0.0;
   std::vector<JobRow> job_rows;
+  std::map<std::string, int64_t> def_signal_counts;
   JobRow def;
   for (JobState* jsp : all_jobs()) {
     std::lock_guard<std::mutex> lk(jsp->mu);
@@ -1399,6 +1571,8 @@ std::string Lighthouse::render_metrics() {
     j.leaves = jsp->leaves_total;
     j.aseq = jsp->anomaly_seq;
     j.adropped = jsp->anomalies_dropped;
+    j.sseq = jsp->signal_seq;
+    j.sdropped = jsp->signals_dropped;
     j.gen = jsp->fleet_gen;
     j.n_participants = jsp->state.participants.size();
     j.n_members = jsp->state.prev_quorum
@@ -1432,6 +1606,7 @@ std::string Lighthouse::render_metrics() {
         have_median = true;
         median_rate = jsp->agg_rates.median();
       }
+      def_signal_counts = jsp->signal_counts;
     }
     job_rows.push_back(std::move(j));
   }
@@ -1535,6 +1710,20 @@ std::string Lighthouse::render_metrics() {
        "from the bounded ring (feed incomplete when > 0).\n"
     << "# TYPE torchft_lighthouse_anomalies_dropped counter\n"
     << "torchft_lighthouse_anomalies_dropped " << def.adropped << "\n";
+  // Failure-evidence counters: per-source totals (bounded: the source enum
+  // is closed at SIGNAL_SOURCES size, never per-replica) plus the ring-drop
+  // counter — the same incompleteness alarm the anomaly ring has.
+  m << "# HELP torchft_lighthouse_signals_total Failure signals recorded "
+       "since boot, by evidence source.\n"
+    << "# TYPE torchft_lighthouse_signals_total counter\n"
+    << "torchft_lighthouse_signals_total " << def.sseq << "\n";
+  for (const auto& kv : def_signal_counts)
+    m << "torchft_lighthouse_signals_total{source=\"" << prom_escape(kv.first)
+      << "\"} " << kv.second << "\n";
+  m << "# HELP torchft_lighthouse_signals_dropped Failure-signal records "
+       "evicted from the bounded ring (feed incomplete when > 0).\n"
+    << "# TYPE torchft_lighthouse_signals_dropped counter\n"
+    << "torchft_lighthouse_signals_dropped " << def.sdropped << "\n";
   m << "# HELP torchft_lighthouse_fleet_gen Fleet-table content generation "
        "(bumped on every mutation; tags /fleet.json snapshots).\n"
     << "# TYPE torchft_lighthouse_fleet_gen counter\n"

@@ -213,6 +213,8 @@ class Lighthouse {
     int64_t last_jitter_ms = 0;      // when a closed gap last blew the budget
     std::set<std::string> flags;     // active anomaly flags
     int64_t straggler_until_ms = 0;  // sticky display flag
+    std::string last_signal;         // last failure-signal source (evidence)
+    int64_t last_signal_ms = 0;      // when that signal was recorded
   };
 
   // Generation-tagged cached fleet snapshot (per job). The full /fleet.json
@@ -265,6 +267,16 @@ class Lighthouse {
     std::deque<Json> anomalies;   // rise-edge anomaly ring (capped)
     int64_t anomaly_seq = 0;      // total anomalies ever (ring drops old)
     int64_t anomalies_dropped = 0;  // rise-edges evicted from the ring
+
+    // ---- failure-evidence plane ----
+    // Ring of failure signals (same discipline as the anomaly ring: capped,
+    // overflow pops the oldest and bumps signals_dropped). Each entry:
+    // {seq, ts_ms, replica_id, source, site, job, detail}. signal_seq is
+    // the monotonic total ever recorded — consumers diff it as a cursor.
+    std::deque<Json> signals;
+    int64_t signal_seq = 0;
+    int64_t signals_dropped = 0;
+    std::map<std::string, int64_t> signal_counts;  // per-source totals
     int64_t fleet_gen = 0;  // bumped on every fleet-table mutation
     int64_t flagged = 0;    // entries with a non-empty flag set
     int64_t n_digest = 0;   // entries with a digest
@@ -331,6 +343,17 @@ class Lighthouse {
   void fleet_set_flag(JobState& js, const std::string& replica_id,
                       FleetEntry& e, const std::string& kind, int64_t now,
                       Json detail);
+  // Record one failure signal in the job's signal ring (js.mu held). The
+  // caller decides whether to follow up with an evidence-driven
+  // job_tick_locked; this only records + stamps the fleet row.
+  void signal_note_locked(JobState& js, const std::string& source,
+                          const std::string& replica_id,
+                          const std::string& site, Json detail, int64_t now);
+  // Evidence-driven hb-lapse eviction (js.mu held): drop `replica_id` from
+  // the quorum tables with leave-style gate fixups but NO tombstone (a
+  // relaunch rejoins normally) and keep the fleet row as forensics.
+  void evidence_evict_locked(JobState& js, const std::string& replica_id,
+                             int64_t now);
   void fleet_clear_flag(JobState& js, FleetEntry& e, const std::string& kind);
   void fleet_erase(JobState& js, const std::string& replica_id);
   void fleet_agg_remove(JobState& js, const FleetEntry& e);

@@ -106,6 +106,10 @@ void ManagerServer::heartbeat_loop() {
   std::vector<uint64_t> fail_streak(n, 0);
   int64_t last_active_ok_ms = now_ms();
   uint64_t failover_streak = 0;  // consecutive failovers without any ack
+  // Consecutive TRANSPORT failures (connect refused/reset — not a live
+  // lighthouse saying no) on the active entry: hard evidence the process is
+  // gone, consumed by the evidence failover below.
+  uint64_t active_fail_streak = 0;
   while (running_) {
     if (draining_) {
       // Graceful drain in progress: no more heartbeats (a fresh heartbeat
@@ -114,6 +118,37 @@ void ManagerServer::heartbeat_loop() {
       continue;
     }
     const int active = lh_active_.load() % static_cast<int>(n);
+    // Shared failover tail for both triggers (lease lapse / hard evidence):
+    // advance down the list, record detection attribution for lh_failover
+    // journaling, and queue a failure signal for the NEW active lighthouse.
+    auto fail_over = [&](int kind, const char* label, int64_t detect_ms) {
+      failover_streak += 1;
+      int next = (active + 1) % static_cast<int>(n);
+      lh_active_.store(next);
+      lh_failovers_.fetch_add(1);
+      lh_detect_ms_.store(detect_ms);
+      lh_failover_kind_.store(kind);
+      Json d = Json::object();
+      d["detect_ms"] = Json::of(detect_ms);
+      d["failed_addr"] = Json::of(lh_addrs_[active]);
+      d["next_addr"] = Json::of(lh_addrs_[next]);
+      queue_signal(kind == 2 ? "rpc_error" : "lease_expiry",
+                   "lighthouse:" + lh_addrs_[active],
+                   "manager:" + opts_.replica_id + ":hb_loop", std::move(d));
+      last_active_ok_ms = now_ms();
+      active_fail_streak = 0;
+      fprintf(stderr,
+              "[manager %s] lighthouse %s on %s (detect %lld ms): failing "
+              "over to %s (failover #%lld)\n",
+              opts_.replica_id.c_str(), label, lh_addrs_[active].c_str(),
+              static_cast<long long>(detect_ms), lh_addrs_[next].c_str(),
+              static_cast<long long>(lh_failovers_.load()));
+      // Seeded full-jitter pause (shared PR-7 backoff) so the whole fleet
+      // doesn't re-register against the standby in the same instant.
+      double unit = chaos::backoff_unit(opts_.replica_id + "|lh_failover",
+                                        failover_streak);
+      sleep_ms(static_cast<int64_t>(unit * 500.0));
+    };
     for (size_t i = 0; i < n && running_ && !draining_; i++) {
       if (ports[i] < 0) continue;
       const bool is_active = static_cast<int>(i) == active;
@@ -165,9 +200,43 @@ void ManagerServer::heartbeat_loop() {
           std::lock_guard<std::mutex> lk(digest_mu_);
           if (has_digest_) req["digest"] = digest_;
         }
+        // Piggyback queued failure signals on the ACTIVE entry (the island
+        // that forms quorums is the one that must ingest evidence). The
+        // outbox is only drained on ack, so a torn send re-delivers — the
+        // lighthouse ring tolerates duplicates, losing evidence is worse.
+        size_t attached = 0;
+        if (is_active) {
+          std::lock_guard<std::mutex> lk(signal_mu_);
+          if (!signal_outbox_.empty()) {
+            Json arr = Json::array();
+            for (const auto& s : signal_outbox_) arr.push(s);
+            attached = signal_outbox_.size();
+            req["signals"] = std::move(arr);
+          }
+        }
         Json resp;
         if (call_json(fds[i], req, &resp, 5000)) {
           acked = resp.get("ok").as_bool();
+          if (acked && is_active) {
+            // Evidence cursor: the ack carries the island's failure-signal
+            // seq + last signal; the trainer's watcher polls these via the
+            // "evidence_status" RPC to react to peer death in ~one
+            // heartbeat instead of a full collective timeout.
+            int64_t sseq = resp.get("signal_seq").as_int(-1);
+            if (sseq >= 0) {
+              int64_t cur = lh_signal_seq_.load();
+              while (sseq > cur &&
+                     !lh_signal_seq_.compare_exchange_weak(cur, sseq)) {
+              }
+              std::lock_guard<std::mutex> lk(signal_mu_);
+              if (resp.has("signal")) last_signal_ = resp.get("signal");
+            }
+            if (attached > 0) {
+              std::lock_guard<std::mutex> lk(signal_mu_);
+              for (size_t k = 0; k < attached && !signal_outbox_.empty(); k++)
+                signal_outbox_.pop_front();
+            }
+          }
         } else {
           close(fds[i]);
           fds[i] = -1;
@@ -179,8 +248,10 @@ void ManagerServer::heartbeat_loop() {
         if (is_active) {
           last_active_ok_ms = now_ms();
           failover_streak = 0;
+          active_fail_streak = 0;
         }
       } else if (fds[i] < 0) {
+        if (is_active) active_fail_streak += 1;
         fail_streak[i] += 1;
         double unit = chaos::backoff_unit(
             opts_.replica_id + "|hb|" + lh_addrs_[i], fail_streak[i]);
@@ -188,27 +259,22 @@ void ManagerServer::heartbeat_loop() {
             now_ms() + static_cast<int64_t>(unit * 2000.0);  // cap 2 s
       }
     }
-    if (!draining_ &&
-        now_ms() - last_active_ok_ms > opts_.lighthouse_lease_ms) {
+    if (!draining_ && n > 1 && opts_.evidence_streak > 0 &&
+        active_fail_streak >= static_cast<uint64_t>(opts_.evidence_streak)) {
+      // Hard-evidence failover: N consecutive transport failures against
+      // the active entry (connect refused/reset — the process is GONE, not
+      // merely slow) fail over at heartbeat-cadence speed instead of
+      // waiting out the rest of the lease.
+      fail_over(2, "transport-dead (hard evidence)",
+                now_ms() - last_active_ok_ms);
+    } else if (!draining_ &&
+               now_ms() - last_active_ok_ms > opts_.lighthouse_lease_ms) {
       // Lease lapsed: deterministic failover down the list (wrapping, so a
       // resurrected earlier entry can be re-adopted if everything later
-      // also dies — it will take over with a freshly fenced epoch).
-      failover_streak += 1;
-      int next = (active + 1) % static_cast<int>(n);
-      lh_active_.store(next);
-      lh_failovers_.fetch_add(1);
-      last_active_ok_ms = now_ms();
-      fprintf(stderr,
-              "[manager %s] lighthouse lease lapsed on %s: failing over to "
-              "%s (failover #%lld)\n",
-              opts_.replica_id.c_str(), lh_addrs_[active].c_str(),
-              lh_addrs_[next].c_str(),
-              static_cast<long long>(lh_failovers_.load()));
-      // Seeded full-jitter pause (shared PR-7 backoff) so the whole fleet
-      // doesn't re-register against the standby in the same instant.
-      double unit = chaos::backoff_unit(opts_.replica_id + "|lh_failover",
-                                        failover_streak);
-      sleep_ms(static_cast<int64_t>(unit * 500.0));
+      // also dies — it will take over with a freshly fenced epoch). The
+      // soft-evidence fallback: covers hangs/partitions where connects
+      // still land but acks never do.
+      fail_over(1, "lease lapsed", now_ms() - last_active_ok_ms);
     }
     sleep_ms(opts_.heartbeat_interval_ms);
   }
@@ -306,6 +372,38 @@ Json ManagerServer::handle_request(const Json& req, int64_t deadline_ms) {
     resp["ok"] = Json::of(true);
     return resp;
   }
+  if (type == "signal") {
+    // Trainer/runner-observed failure evidence: queue for heartbeat
+    // piggyback to the active lighthouse. Source must be one of
+    // telemetry.SIGNAL_SOURCES; the lighthouse drops unknown sources, so
+    // here we only refuse the obviously malformed (empty) case.
+    const std::string source = req.get("source").as_str();
+    if (source.empty()) {
+      resp["ok"] = Json::of(false);
+      resp["error"] = Json::of("signal requires a non-empty 'source'");
+      return resp;
+    }
+    queue_signal(source, req.get("replica_id").as_str(opts_.replica_id),
+                 req.get("site").as_str(""), req.get("detail"));
+    resp["ok"] = Json::of(true);
+    return resp;
+  }
+  if (type == "evidence_status") {
+    // Lock-cheap poll for the trainer's evidence watcher: the island-wide
+    // failure-signal cursor plus the last signal seen in an active ack. A
+    // seq rise with a hard source on a PEER is grounds to abort a wedged
+    // collective now instead of waiting out its timeout.
+    resp["ok"] = Json::of(true);
+    resp["signal_seq"] = Json::of(lh_signal_seq_.load());
+    {
+      std::lock_guard<std::mutex> lk(signal_mu_);
+      resp["signal"] = last_signal_;
+      resp["outbox"] = Json::of(static_cast<int64_t>(signal_outbox_.size()));
+      resp["outbox_dropped"] = Json::of(signal_outbox_dropped_);
+    }
+    resp["lh"] = lh_info_json();
+    return resp;
+  }
   if (type == "info") {
     resp["ok"] = Json::of(true);
     resp["replica_id"] = Json::of(opts_.replica_id);
@@ -329,7 +427,34 @@ Json ManagerServer::lh_info_json() const {
   lh["stale_rejected"] = Json::of(lh_stale_rejected_.load());
   lh["unreachable_retries"] = Json::of(lh_unreachable_retries_.load());
   lh["job"] = Json::of(opts_.job);
+  // Detection attribution of the LAST failover: how long the dead active
+  // entry went unacked before we moved ("detect_ms"), and which trigger won
+  // — hard transport evidence or the lease timeout fallback.
+  lh["detect_ms"] = Json::of(lh_detect_ms_.load());
+  int k = lh_failover_kind_.load();
+  lh["evidence"] = Json::of(k == 2 ? "evidence" : (k == 1 ? "lease" : ""));
+  lh["signal_seq"] = Json::of(lh_signal_seq_.load());
   return lh;
+}
+
+void ManagerServer::queue_signal(const std::string& source,
+                                 const std::string& subject,
+                                 const std::string& site, Json detail) {
+  Json s = Json::object();
+  s["source"] = Json::of(source);
+  s["replica_id"] = Json::of(subject.empty() ? opts_.replica_id : subject);
+  s["site"] =
+      Json::of(site.empty() ? "manager:" + opts_.replica_id : site);
+  s["ts_ms"] = Json::of(now_ms());
+  if (!detail.is_null()) s["detail"] = std::move(detail);
+  std::lock_guard<std::mutex> lk(signal_mu_);
+  signal_outbox_.push_back(std::move(s));
+  // Bounded like the lighthouse rings: drop the OLDEST — fresh evidence is
+  // what unblocks survivors.
+  while (signal_outbox_.size() > 16) {
+    signal_outbox_.pop_front();
+    signal_outbox_dropped_ += 1;
+  }
 }
 
 std::optional<Quorum> ManagerServer::lighthouse_quorum(
@@ -348,20 +473,37 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(
   int64_t unreachable = 0;
   std::string last_addr;
   std::string denied;
+  // Follow-the-failover retries: when the heartbeat thread fails over WHILE
+  // an attempt is burning its connect budget against the dead target, the
+  // next try against the new active is free (not counted against the
+  // budgeted attempts, no backoff). Bounded so a flapping list can't loop.
+  int64_t free_retries = static_cast<int64_t>(lh_addrs_.size()) * 2;
 
   for (int64_t a = 0; a < attempts && running_; a++) {
+    const int active_at_start = lh_active_.load();
     const std::string addr =
-        lh_addrs_[lh_active_.load() % static_cast<int>(lh_addrs_.size())];
+        lh_addrs_[active_at_start % static_cast<int>(lh_addrs_.size())];
     last_addr = addr;
     std::string host;
     int port = 0;
     int fd = -1;
+    bool transport_fail = false;
     int64_t attempt_deadline = std::min(deadline_ms, now_ms() + slice);
     if (split_host_port(addr, &host, &port)) {
-      fd = tcp_connect_retry(host, port,
-                             std::min<int64_t>(slice, opts_.connect_timeout_ms));
+      // Per-attempt connect budget. With standbys configured, cap it near
+      // the lease: a SIGKILLed primary must not eat the whole slice (the
+      // full quorum timeout when quorum_retries=0) when the heartbeat
+      // thread will have failed over at evidence speed long before — the
+      // free retry below follows it. Single-lighthouse deployments keep
+      // the full budget (nowhere else to go).
+      int64_t cbudget = std::min<int64_t>(slice, opts_.connect_timeout_ms);
+      if (lh_addrs_.size() > 1)
+        cbudget = std::min(
+            cbudget, std::max<int64_t>(250, opts_.lighthouse_lease_ms));
+      fd = tcp_connect_retry(host, port, cbudget);
     }
     if (fd < 0) {
+      transport_fail = true;
       unreachable += 1;
       lh_unreachable_retries_.fetch_add(1);
     } else {
@@ -377,6 +519,7 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(
       if (!ok) {
         // Torn mid-RPC (connection reset / partition): same bucket as
         // unreachable — retry, don't latch.
+        transport_fail = true;
         unreachable += 1;
         lh_unreachable_retries_.fetch_add(1);
       } else if (!resp.get("ok").as_bool()) {
@@ -406,6 +549,13 @@ std::optional<Quorum> ManagerServer::lighthouse_quorum(
       }
     }
     if (now_ms() >= deadline_ms) break;
+    if (transport_fail && free_retries > 0 &&
+        lh_active_.load() != active_at_start) {
+      // The heartbeat thread failed over mid-attempt: follow it now.
+      free_retries -= 1;
+      a -= 1;
+      continue;
+    }
     if (a + 1 < attempts) {
       // Seeded full-jitter between attempts (chaos.backoff_jitter's C++
       // twin, keyed per replica so retries across the fleet decorrelate).
@@ -467,6 +617,10 @@ bool ManagerServer::leave(const std::string& reason, int64_t budget_ms) {
       lv["type"] = Json::of("leave");
       lv["replica_id"] = Json::of(opts_.replica_id);
       lv["job"] = Json::of(opts_.job);
+      // Why we left: "trainer died" (the parent-death watchdog leaving on
+      // the corpse's behalf) is failure evidence the lighthouse turns into
+      // a proc_death signal; planned drains stay signal-free.
+      lv["reason"] = Json::of(reason);
       Json lresp;
       sent = call_json(fd, lv, &lresp, remaining) && lresp.get("ok").as_bool();
       close(fd);

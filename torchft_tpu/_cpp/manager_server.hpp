@@ -30,6 +30,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <optional>
@@ -67,6 +68,12 @@ struct ManagerOpts {
   // pre-namespace wire behavior (the key is still sent; an old lighthouse
   // ignores unknown keys).
   std::string job = "default";
+  // Failure-evidence failover: this many CONSECUTIVE transport failures on
+  // the ACTIVE lighthouse entry (connect refused/reset — hard evidence the
+  // process is gone) fail over immediately instead of waiting out the full
+  // lease. 0 disables: lease lapse stays the only failover trigger
+  // (TORCHFT_MGR_EVIDENCE_STREAK / --evidence-streak).
+  int64_t evidence_streak = 3;
 };
 
 class ManagerServer {
@@ -110,6 +117,11 @@ class ManagerServer {
   // HA counters snapshot attached to quorum/info responses so the Python
   // Manager can journal lh_failover / lh_epoch / rpc_retry events.
   Json lh_info_json() const;
+  // Enqueue a failure signal for heartbeat piggyback (bounded outbox; oldest
+  // dropped). Used by the "signal" RPC and by manager-side evidence (lease
+  // lapse / transport-fail failover observations).
+  void queue_signal(const std::string& source, const std::string& subject,
+                    const std::string& site, Json detail);
 
   ManagerOpts opts_;
   // ---- lighthouse HA state ----
@@ -127,6 +139,21 @@ class ManagerServer {
   std::atomic<int64_t> lh_stale_rejected_{0};
   // Connect-level quorum retries absorbed before latching quorum_error_.
   std::atomic<int64_t> lh_unreachable_retries_{0};
+  // ---- failure-evidence state ----
+  // Max failure-signal seq seen in ACTIVE-entry heartbeat ACKs: the local
+  // evidence cursor the trainer's watcher polls via "evidence_status".
+  std::atomic<int64_t> lh_signal_seq_{0};
+  // Detection latency of the last failover: ms from the last successful
+  // active ack to the failover decision (-1 before any failover), plus
+  // which trigger won the race (0 none, 1 lease lapse, 2 hard evidence).
+  std::atomic<int64_t> lh_detect_ms_{-1};
+  std::atomic<int> lh_failover_kind_{0};
+  // Last signal object from an active ACK (signal_mu_), and the bounded
+  // outbox of trainer-emitted signals awaiting heartbeat piggyback.
+  std::mutex signal_mu_;
+  Json last_signal_ = Json::null();
+  std::deque<Json> signal_outbox_;
+  int64_t signal_outbox_dropped_ = 0;
   int port_ = 0;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};

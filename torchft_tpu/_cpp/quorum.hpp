@@ -86,6 +86,21 @@ struct LighthouseOpts {
   std::string district;
   // Root lighthouse address ("host:port") the district rollups go to.
   std::string root_addr;
+  // ---- failure-evidence plane ----
+  // Master switch for the evidence-driven REACTION: cadence-aware hb-lapse
+  // eviction plus signal-triggered quorum re-evaluation. Signals themselves
+  // are always collected/journaled/exported; this only gates acting on
+  // them (TORCHFT_LH_EVIDENCE / --evidence).
+  bool evidence = true;
+  // Cadence-aware hb-lapse eviction budget: a replica whose OPEN heartbeat
+  // gap exceeds max(evict_floor_ms, evict_mult * declared cadence) is
+  // treated as dead on evidence — dropped from the quorum tables so the
+  // next quorum forms immediately, instead of waiting out the full
+  // heartbeat_timeout_ms. Replicas that never declared a cadence (old
+  // clients) are NEVER evicted early (wire back-compat).
+  // (TORCHFT_LH_EVICT_MULT / TORCHFT_LH_EVICT_FLOOR_MS)
+  int64_t evict_mult = 12;
+  int64_t evict_floor_ms = 1000;
 };
 
 // Durable lighthouse snapshot: the only state that must survive a restart.

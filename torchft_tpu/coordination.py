@@ -406,6 +406,17 @@ class _FramedClient:
                 delay_s=round(delay, 4),
                 error=str(err)[:200],
             )
+            if attempt == 1:
+                # Rise edge only (first failure of the call, not every
+                # retry): connect refused/reset against a control-plane
+                # peer is failure evidence in its own right.
+                log.emit(
+                    "failure_signal",
+                    source="rpc_error",
+                    subject=self._addr,
+                    site=f"client:{rpc}",
+                    detail=str(err)[:200],
+                )
         if delay > 0:
             time.sleep(delay)
 
@@ -585,6 +596,7 @@ class LighthouseClient:
         hb_interval_ms: int = 0,
         epoch: int = 0,
         job: str = "",
+        signals: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         """One heartbeat, optionally carrying a :class:`~torchft_tpu.
         telemetry.StepDigest` wire dict (``StepDigest.to_wire()``) plus
@@ -606,6 +618,12 @@ class LighthouseClient:
             req["epoch"] = int(epoch)
         if job:
             req["job"] = job
+        if signals:
+            # Failure-evidence piggyback: observed signals ride the
+            # heartbeat frame exactly like the C++ manager's outbox does
+            # (source/replica_id/site/detail dicts). Old lighthouses drop
+            # the key unread.
+            req["signals"] = list(signals)
         self._client.call(req, timeout)
 
     def fleet(self, timeout: float = 5.0, job: str = "") -> Dict[str, Any]:
@@ -672,17 +690,23 @@ class LighthouseClient:
         self._client.call(req, timeout)
 
     def leave(
-        self, replica_id: str, timeout: float = 5.0, job: str = ""
+        self, replica_id: str, timeout: float = 5.0, job: str = "",
+        reason: str = "",
     ) -> None:
         """Graceful drain: removes the replica from the lighthouse's
         heartbeat/participant maps immediately (with a tombstone against
         in-flight heartbeats), so the survivors' next quorum forms at tick
         speed instead of waiting out the heartbeat timeout. No reference
-        analog — the reference only has Kill → exit(1)."""
+        analog — the reference only has Kill → exit(1). ``reason`` is
+        the evidence tag: a leave sent on a DEAD trainer's behalf uses
+        ``"trainer died"``, which the lighthouse turns into a proc_death
+        failure signal instead of treating it as a planned drain."""
         req: Dict[str, Any] = {
             "type": "leave", "replica_id": replica_id,
             "timeout_ms": int(timeout * 1000),
         }
+        if reason:
+            req["reason"] = reason
         if job:
             req["job"] = job
         self._client.call(req, timeout)
@@ -919,6 +943,44 @@ class ManagerClient:
         drain."""
         return self._client.call(
             {"type": "info", "timeout_ms": int(timeout * 1000)}, timeout
+        )
+
+    def signal(
+        self,
+        source: str,
+        replica_id: str = "",
+        site: str = "",
+        detail: Optional[Dict[str, Any]] = None,
+        timeout: float = 2.0,
+    ) -> None:
+        """Queue a failure signal (``source`` in telemetry.SIGNAL_SOURCES)
+        with the local manager server; it piggybacks on the next heartbeat
+        to the active lighthouse. Fire-and-forget evidence: callers swallow
+        failures rather than perturb the step they are reporting about."""
+        req: Dict[str, Any] = {
+            "type": "signal",
+            "source": source,
+            "timeout_ms": int(timeout * 1000),
+        }
+        if replica_id:
+            req["replica_id"] = replica_id
+        if site:
+            req["site"] = site
+        if detail:
+            req["detail"] = detail
+        self._client.call(req, timeout, retry=False)
+
+    def evidence_status(self, timeout: float = 2.0) -> Dict[str, Any]:
+        """Poll the manager's evidence cursor: the active lighthouse
+        island's failure-signal seq (``signal_seq``), the last signal it
+        acked back (``signal``), and the lighthouse HA attribution
+        (``lh.detect_ms`` / ``lh.evidence``). The trainer-side evidence
+        watcher uses a seq RISE with a hard source on a peer to abort a
+        wedged collective early."""
+        return self._client.call(
+            {"type": "evidence_status", "timeout_ms": int(timeout * 1000)},
+            timeout,
+            retry=False,
         )
 
     def kill(self, msg: str = "") -> None:

@@ -205,6 +205,47 @@ _ALL = [
         "Root lighthouse address `host:port` a district lighthouse reports its per-job rollup digests to; unset = federation off. The --root flag wins over the env.",
         scope="cpp",
     ),
+    # -- failure-evidence plane -------------------------------------------
+    _k(
+        "TORCHFT_LH_EVIDENCE",
+        "bool",
+        "1",
+        "Lighthouse evidence-driven REACTION (cadence-aware hb-lapse eviction + signal-triggered quorum re-evaluation). Signals are always collected/journaled/exported; `0` only stops acting on them.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_EVICT_MULT",
+        "int",
+        "12",
+        "hb-lapse eviction budget multiplier: a replica whose open heartbeat gap exceeds max(TORCHFT_LH_EVICT_FLOOR_MS, mult x its declared cadence) is evicted from the quorum tables on evidence instead of waiting out heartbeat_timeout_ms. Replicas that never declared a cadence are never evicted early.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_EVICT_FLOOR_MS",
+        "int",
+        "1000",
+        "Floor (ms) of the cadence-aware hb-lapse eviction budget, so very fast heartbeaters keep a sane grace window.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_MGR_EVIDENCE_STREAK",
+        "int",
+        "3",
+        "Manager hard-evidence lighthouse failover: this many CONSECUTIVE transport failures (connect refused/reset) on the active entry fails over immediately instead of waiting out the full TORCHFT_LH_LEASE_MS lease. `0` = lease lapse only. The --evidence-streak flag wins over the env.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_EVIDENCE_WATCH",
+        "bool",
+        "1",
+        "Trainer-side evidence watcher: while blocked on a managed collective, poll the local manager's evidence_status (~TORCHFT_EVIDENCE_POLL_S cadence) and abort the wedged process group on first hard peer-failure evidence (native_abort / proc_death / hb_lapse) instead of waiting out the collective timeout.",
+    ),
+    _k(
+        "TORCHFT_EVIDENCE_POLL_S",
+        "float",
+        "0.1",
+        "Poll cadence (seconds) of the trainer-side evidence watcher.",
+    ),
     _k(
         "TORCHFT_TIMEOUT_SEC",
         "float",

@@ -240,23 +240,38 @@ def cc_step_sentinel(path: str) -> Optional[int]:
 def py_tuple_of_strings(path: str, name: str) -> Optional[Tuple[str, ...]]:
     tree = _parse(path)
     for node in tree.body:
+        # Plain and annotated (``X: tuple = (...)``) module-level assigns.
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
         if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id == name:
-                    if isinstance(node.value, (ast.Tuple, ast.List)):
-                        vals = []
-                        for elt in node.value.elts:
-                            if isinstance(
-                                elt, ast.Constant
-                            ) and isinstance(elt.value, str):
-                                vals.append(elt.value)
-                        return tuple(vals)
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    vals = []
+                    for elt in value.elts:
+                        if isinstance(
+                            elt, ast.Constant
+                        ) and isinstance(elt.value, str):
+                            vals.append(elt.value)
+                    return tuple(vals)
     return None
 
 
 def cc_kind_names(path: str) -> Optional[Tuple[str, ...]]:
     text = strip_cc_comments(open(path).read())
     m = re.search(r"kKindNames\[\]\s*=\s*\{([^}]*)\}", text)
+    if not m:
+        return None
+    return tuple(re.findall(r'"([^"]+)"', m.group(1)))
+
+
+def cc_string_array(path: str, name: str) -> Optional[Tuple[str, ...]]:
+    """Entries of a ``const char* <name>[] = {"a", "b", ...}`` array."""
+    text = strip_cc_comments(open(path).read())
+    m = re.search(rf"{re.escape(name)}\[\]\s*=\s*\{{([^}}]*)\}}", text)
     if not m:
         return None
     return tuple(re.findall(r'"([^"]+)"', m.group(1)))

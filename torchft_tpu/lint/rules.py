@@ -779,15 +779,21 @@ FLEET_TOP_KEYS = {
     # (unfiltered) payload adds per-job summary rollups and the root
     # lighthouse's district table.
     "job", "jobs", "districts",
+    # Failure-evidence plane: the island's signal ring, its monotone seq
+    # cursor, and per-source totals.
+    "signals", "signal_seq", "signal_counts",
 }
 FLEET_ROW_KEYS = {
     "last_hb_age_ms", "hb_interval_ms", "digest", "digest_age_ms",
     "flags", "straggler",
+    # Last failure signal naming this replica as subject (null if none).
+    "signal", "signal_age_ms",
 }
 FLEET_AGG_KEYS = {
     "n", "n_digest", "stragglers", "median_rate", "median_step",
     "median_goodput", "max_commit_failures", "anomalies_dropped",
     "quorum_world", "joins_total", "leaves_total", "epoch",
+    "signals_dropped",
 }
 
 # Consumer read sites: variable name -> which key level it addresses.
@@ -877,6 +883,67 @@ def rule_fleet_keys(root: str) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# signal-sources: the failure-evidence plane's source enum.
+#
+# telemetry.SIGNAL_SOURCES (python emitters, detect/report tooling) and
+# lighthouse.cc kSignalSourceNames (the ingest filter) must agree
+# POSITIONALLY — the lighthouse silently drops signals whose source it
+# does not know, so a drifted entry loses evidence with no error anywhere.
+
+
+def rule_signal_sources(root: str) -> List[Finding]:
+    R = "signal-sources"
+    out: List[Finding] = []
+    cc_path = _p(root, LIGHTHOUSE_CC)
+    if not os.path.exists(cc_path):
+        return out  # fixture tree without the C++ plane
+    py = ex.py_tuple_of_strings(_p(root, TELEMETRY_PY), "SIGNAL_SOURCES")
+    cc = ex.cc_string_array(cc_path, "kSignalSourceNames")
+    if py is None:
+        out.append(Finding(R, "SIGNAL_SOURCES tuple not found", TELEMETRY_PY))
+    if cc is None:
+        out.append(Finding(R, "kSignalSourceNames[] not found", LIGHTHOUSE_CC))
+    if py and cc and py != cc:
+        out.append(
+            Finding(
+                R,
+                f"signal sources drifted (ordered): py={list(py)} "
+                f"cc={list(cc)}",
+                LIGHTHOUSE_CC,
+            )
+        )
+    # Every source a python emitter uses must be declared. Emit sites all
+    # funnel through journal events / the "signal" RPC with a literal
+    # source string: catch the literals.
+    if py:
+        emitters = (
+            "torchft_tpu/manager.py",
+            "torchft_tpu/coordination.py",
+            "torchft_tpu/orchestration/runner.py",
+        )
+        pat = re.compile(
+            r"(?:source\s*=\s*|_signal\(\s*|\.signal\(\s*)(['\"])([a-z_]+)\1"
+        )
+        for rel in emitters:
+            path = _p(root, rel)
+            if not os.path.exists(path):
+                continue
+            src = open(path).read()
+            for _q, source in pat.findall(src):
+                if source not in py:
+                    out.append(
+                        Finding(
+                            R,
+                            f"emits undeclared signal source {source!r} "
+                            f"(the lighthouse will drop it): add it to "
+                            f"SIGNAL_SOURCES + kSignalSourceNames",
+                            rel,
+                        )
+                    )
+    return out
+
+
+# ----------------------------------------------------------------------
 
 RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("golden-constants", rule_golden_constants),
@@ -890,6 +957,7 @@ RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("wallclock-free-chaos", rule_wallclock_free),
     ("artifact-hygiene", rule_artifact_hygiene),
     ("fleet-keys", rule_fleet_keys),
+    ("signal-sources", rule_signal_sources),
 ]
 
 

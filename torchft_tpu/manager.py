@@ -292,6 +292,27 @@ class Manager:
         self._client = ManagerClient(manager_addr, self._connect_timeout)
         self._logger = _ManagerLogger(self)
 
+        # Trainer-side evidence watcher (failure-evidence plane): while a
+        # managed collective blocks, a side thread polls the manager
+        # server's evidence cursor over its OWN connection (the shared
+        # client's lock can be held for seconds by the quorum thread) and
+        # aborts the wedged pg on the first hard peer-failure signal —
+        # reacting at heartbeat speed instead of waiting out the collective
+        # timeout. TORCHFT_EVIDENCE_WATCH=0 disables.
+        self._evidence_watcher: Optional[_EvidenceWatcher] = None
+        if knobs.get_raw("TORCHFT_EVIDENCE_WATCH") != "0":
+            self._evidence_watcher = _EvidenceWatcher(
+                self, manager_addr, self._connect_timeout
+            )
+        # Replica ids of the CURRENT quorum (refreshed every formation).
+        # The evidence watcher only reacts to hard signals about these:
+        # evidence about a replica outside the quorum — e.g. the lapsed
+        # heartbeat of a killed-and-relaunched peer's previous incarnation
+        # being evicted — is about a failure this quorum already survived,
+        # and aborting a healthy collective over it would turn forensics
+        # into an outage.
+        self._evidence_peers: set = set()
+
         ft_futures.start_watchdog()
 
     # ------------------------------------------------------------------
@@ -447,6 +468,12 @@ class Manager:
                 failovers=failovers,
                 lh_active=int(lh.get("active", 0)),
                 lh_addr=str(lh.get("addr", "")),
+                # Detection attribution (failure-evidence plane): how long
+                # the dead target went unacked before the server moved, and
+                # which trigger won — "evidence" (hard transport streak) or
+                # "lease" (the timeout fallback).
+                detect_ms=int(lh.get("detect_ms", -1)),
+                evidence=str(lh.get("evidence", "")),
             )
         epoch = int(lh.get("epoch", 0))
         if epoch > prev.get("epoch", 0):
@@ -577,6 +604,10 @@ class Manager:
         # replica derives the same value from the shared quorum result, so
         # cross-replica correlation needs no extra agreement round.
         self._trace_id = f"q{result.quorum_id}.s{result.max_step}"
+        if result.quorum is not None and result.quorum.participants:
+            self._evidence_peers = {
+                m.replica_id for m in result.quorum.participants
+            }
         set_trace = getattr(self._pg, "set_trace_id", None)
         if set_trace is not None:
             try:
@@ -781,6 +812,14 @@ class Manager:
                     cause=type(e).__name__, phase=heal_phase,
                     max_step=result.max_step,
                 )
+                # Hard evidence about OURSELVES: peers blocked on a
+                # collective with us learn via the signal bus that this
+                # heal died, instead of waiting out their own timeouts.
+                self._signal(
+                    "native_abort",
+                    site="trainer.heal",
+                    detail=f"{heal_phase}: {type(e).__name__}",
+                )
                 self.report_error(e)
 
     def _apply_pending_state_dict(self) -> None:
@@ -959,6 +998,43 @@ class Manager:
         """Latches an error: the step continues with no-op comms and
         should_commit votes False (reference: manager.py:452-471)."""
         self._errored = e
+
+    def _signal(
+        self, source: str, subject: str = "", site: str = "", detail: str = ""
+    ) -> None:
+        """Emits failure evidence: journals a ``failure_signal`` locally
+        AND queues it with the manager server for heartbeat piggyback to
+        the active lighthouse (where it feeds quorum re-evaluation and
+        peers' evidence watchers). Best-effort on the RPC leg — reporting
+        evidence must never make the failure it reports about worse."""
+        subject = subject or self._replica_id
+        self._journal(
+            "failure_signal",
+            source=source,
+            subject=subject,
+            site=site or f"trainer:{self._replica_id}",
+            detail=detail[:200] if detail else None,
+        )
+        try:
+            self._client.signal(
+                source,
+                replica_id=subject,
+                site=site or f"trainer:{self._replica_id}",
+                detail={"msg": detail[:200]} if detail else None,
+            )
+        except Exception:  # noqa: BLE001 - advisory evidence only
+            pass
+
+    @contextmanager
+    def _evidence_guard(self):
+        """Arms the evidence watcher for the duration of a blocking
+        collective wait (no-op when the watcher is disabled)."""
+        w = self._evidence_watcher
+        if w is None:
+            yield
+        else:
+            with w.armed():
+                yield
 
     def _abort_pg_on_stall(self) -> None:
         """Timeout-engine callback: a collective or reconfigure exceeded its
@@ -1302,6 +1378,8 @@ class Manager:
                 self._journal("goodput", **g)
         except Exception:  # noqa: BLE001 - shutdown must not fail on a log
             pass
+        if self._evidence_watcher is not None:
+            self._evidence_watcher.stop()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self._checkpoint_transport.shutdown()
         self._client.close()
@@ -1309,6 +1387,127 @@ class Manager:
             self._manager_server.shutdown()
         if self._store_server is not None:
             self._store_server.shutdown()
+
+
+class _EvidenceWatcher:
+    """Trainer-side reaction loop of the failure-evidence plane.
+
+    While armed (a managed collective is blocking), a daemon thread polls
+    the manager server's ``evidence_status`` over its OWN connection —
+    the Manager's shared client lock can be held for seconds by the async
+    quorum thread, which is exactly when this watcher must stay live. On a
+    failure-signal seq RISE whose last signal has a HARD source
+    (``native_abort`` / ``proc_death`` / ``hb_lapse``) about a PEER in
+    the current quorum, it aborts the wedged process group immediately:
+    the blocked wait fails in ~one heartbeat instead of the full
+    collective timeout, and the next quorum reconfigures. Soft sources
+    (``rpc_error``, ``lease_expiry``, ``digest_anomaly``) only advance
+    the cursor — they are noisy enough that acting on them would abort
+    healthy steps — and so do hard signals about NON-members (e.g. the
+    evicted previous incarnation of a relaunched peer).
+
+    The baseline seq is (re)taken at the first poll after arming, so stale
+    evidence about faults that already recovered can't abort a healthy
+    collective."""
+
+    _HARD_SOURCES = ("native_abort", "proc_death", "hb_lapse")
+
+    def __init__(
+        self, manager: "Manager", addr: str, connect_timeout: float
+    ) -> None:
+        self._manager = manager
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+        try:
+            self._poll_s = knobs.get_float("TORCHFT_EVIDENCE_POLL_S")
+        except (TypeError, ValueError):
+            self._poll_s = 0.1
+        if not self._poll_s or self._poll_s <= 0:
+            self._poll_s = 0.1
+        self._client: Optional[ManagerClient] = None
+        self._armed_ev = threading.Event()
+        self._stop_ev = threading.Event()
+        self._base_seq: Optional[int] = None
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    @contextmanager
+    def armed(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="evidence_watch", daemon=True
+            )
+            self._thread.start()
+        self._base_seq = None
+        self._fired = False
+        self._armed_ev.set()
+        try:
+            yield
+        finally:
+            self._armed_ev.clear()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        self._armed_ev.clear()
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+
+    def _run(self) -> None:
+        while not self._stop_ev.is_set():
+            if not self._armed_ev.is_set():
+                self._armed_ev.wait(0.2)
+                continue
+            try:
+                self._poll_once()
+            except Exception:  # noqa: BLE001 - never kill the step
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+            self._stop_ev.wait(self._poll_s)
+
+    def _poll_once(self) -> None:
+        if self._client is None:
+            self._client = ManagerClient(self._addr, self._connect_timeout)
+        st = self._client.evidence_status(timeout=max(self._poll_s * 5, 1.0))
+        seq = int(st.get("signal_seq", 0))
+        if self._base_seq is None:
+            self._base_seq = seq
+            return
+        if seq <= self._base_seq or self._fired:
+            return
+        sig = st.get("signal") or {}
+        source = str(sig.get("source", ""))
+        subject = str(sig.get("replica_id", ""))
+        if (
+            source in self._HARD_SOURCES
+            and subject != self._manager._replica_id
+            and subject in self._manager._evidence_peers
+        ):
+            self._fired = True
+            self._manager._journal(
+                "failure_signal",
+                source=source,
+                subject=subject,
+                site="trainer.evidence_watch",
+                seq=seq,
+                reaction="pg_abort",
+            )
+            self._manager._logger.info(
+                f"evidence watcher: hard signal {source!r} on {subject} "
+                f"(seq {seq}) - aborting wedged pg"
+            )
+            self._manager._abort_pg_on_stall()
+        else:
+            # Soft (or self-referential) evidence: advance the cursor and
+            # keep watching for something actionable.
+            self._base_seq = seq
 
 
 class _ManagedWork(Work):
@@ -1349,10 +1548,15 @@ class _ManagedWork(Work):
                 # a stalled (non-erroring) peer mid-collective must fail
                 # fast, not hang until socket timeouts (reference:
                 # manager.py:473-515 wrap_future + stream timeouts).
-                with ft_futures.context_timeout(
-                    self._manager._abort_pg_on_stall, t
-                ):
-                    result = self._work.wait(t)
+                # The evidence watcher is armed for the duration of the
+                # blocking wait: first hard peer-failure signal aborts the
+                # pg at heartbeat speed; the timeout engine stays as the
+                # evidence-free backstop.
+                with self._manager._evidence_guard():
+                    with ft_futures.context_timeout(
+                        self._manager._abort_pg_on_stall, t
+                    ):
+                        result = self._work.wait(t)
                 if self._in_place:
                     for a in self._arrays:
                         a *= self._scale

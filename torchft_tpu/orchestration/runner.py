@@ -150,6 +150,11 @@ class ReplicaGroupRunner:
                 continue
             if self._stopping:
                 continue
+            # Runner-observed process death: the supervisor is the first
+            # (sometimes the only) observer of an abrupt trainer death —
+            # journal it as failure evidence so detection-latency reports
+            # can attribute the proc_death signal path.
+            self._journal_proc_death(spec.name, rc)
             if idx in self._retired:
                 # Deliberate scale-down: the exit is final, clean or not —
                 # a retired group must never resurrect (a relaunch would
@@ -174,6 +179,19 @@ class ReplicaGroupRunner:
             self._launch(idx)
             alive = True
         return alive
+
+    def _journal_proc_death(self, name: str, rc: int) -> None:
+        from torchft_tpu.telemetry import get_event_log
+
+        log = get_event_log()
+        if log is not None:
+            log.emit(
+                "failure_signal",
+                source="proc_death",
+                subject=name,
+                site="runner.monitor",
+                detail=f"rc={rc}",
+            )
 
     def run_until_done(self, timeout: float) -> bool:
         """Supervises until every process exited cleanly (True) or the

@@ -470,7 +470,32 @@ EVENT_KINDS: Dict[str, str] = {
     "district_failover": "district lighthouse failed over; the root "
                          "accepted a higher epoch for the district and "
                          "fenced the stale primary's rollups",
+    # -- failure-evidence plane (manager.py, coordination.py, tools/) ----
+    "failure_signal": "failure evidence observed (source in "
+                      "SIGNAL_SOURCES): subject replica, observation "
+                      "site, monotonic signal seq",
+    "signal_overflow": "lighthouse signal ring dropped records (rise "
+                       "edge, like anomaly_overflow)",
 }
+
+# Closed enum of failure-evidence signal sources.  Mirrored positionally
+# by ``kSignalSourceNames`` in ``_cpp/lighthouse.cc`` (lint rule
+# ``signal-sources``): every ``failure_signal`` journal event and every
+# lighthouse signal-ring entry carries exactly one of these strings.
+#   hb_lapse       lighthouse fleet scan saw a cadence-aware heartbeat gap
+#   lease_expiry   manager's active-lighthouse lease lapsed (no acks)
+#   digest_anomaly fleet digest flag rise-edge (commit stall, step lag, ...)
+#   rpc_error      control RPC connect refused/reset on the retry path
+#   native_abort   native engine abort / all-stripes-dead / heal failure
+#   proc_death     runner observed the trainer process die
+SIGNAL_SOURCES: tuple = (
+    "hb_lapse",
+    "lease_expiry",
+    "digest_anomaly",
+    "rpc_error",
+    "native_abort",
+    "proc_death",
+)
 
 
 class EventLog:
