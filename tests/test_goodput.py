@@ -1,0 +1,483 @@
+"""Tests for the goodput ledger plane: TimeLedger tiling, the digest
+``acct`` wire (both compat directions, byte budget), lighthouse badput
+aggregation + SLO burn-rate evaluation + MTBF/ETTR, the offline
+goodput_report audit, and the obs_top/obs_export surfacing."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from torchft_tpu import _net
+from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+from torchft_tpu.telemetry import (
+    BADPUT_KINDS,
+    FAULT_BADPUT_KINDS,
+    StepDigest,
+    TimeLedger,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import goodput_report  # noqa: E402
+import obs_export  # noqa: E402
+import obs_top  # noqa: E402
+
+
+@pytest.fixture
+def lighthouse():
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
+    )
+    yield server
+    server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# TimeLedger: tiling by construction
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_tiles_wall_clock():
+    led = TimeLedger(now=100.0)
+    w = led.account({"heal": 2.0, "quorum_wait": 1.0}, "compute", upto=110.0)
+    assert w["heal"] == pytest.approx(2.0)
+    assert w["quorum_wait"] == pytest.approx(1.0)
+    assert w["compute"] == pytest.approx(7.0)
+    led.account({}, "drain", upto=112.5)
+    t = led.totals()
+    assert t["drain"] == pytest.approx(2.5)
+    assert led.total_s() == pytest.approx(12.5)
+    assert sum(t.values()) == pytest.approx(led.total_s())
+    assert led.tiling_error_s() < 1e-9
+    # The wire vector is positional by BADPUT_KINDS.
+    vec = led.acct_vector()
+    assert len(vec) == len(BADPUT_KINDS)
+    assert vec[BADPUT_KINDS.index("heal")] == pytest.approx(2.0)
+    assert vec[BADPUT_KINDS.index("compute")] == pytest.approx(7.0)
+
+
+def test_ledger_clamps_overclaimed_splits():
+    """Splits claiming more than the window are scaled down pro-rata and
+    the residual gets exactly zero — never a negative bucket."""
+    led = TimeLedger(now=0.0)
+    w = led.account({"heal": 30.0, "exposed_comm": 10.0},
+                    "discarded_step", upto=2.0)
+    assert w["heal"] == pytest.approx(1.5)
+    assert w["exposed_comm"] == pytest.approx(0.5)
+    assert w["discarded_step"] == pytest.approx(0.0)
+    assert all(v >= 0.0 for v in led.totals().values())
+    assert led.tiling_error_s() < 1e-9
+
+
+def test_ledger_time_never_runs_backward():
+    led = TimeLedger(now=50.0)
+    led.account({}, "compute", upto=60.0)
+    w = led.account({}, "heal", upto=55.0)  # upto behind the frontier
+    assert w["heal"] == pytest.approx(0.0)
+    assert led.total_s() == pytest.approx(10.0)
+
+
+def test_ledger_rejects_unknown_kind():
+    led = TimeLedger(now=0.0)
+    with pytest.raises(ValueError):
+        led.account({"coffee_break": 1.0}, "compute", upto=1.0)
+    with pytest.raises(ValueError):
+        led.account({}, "coffee_break", upto=1.0)
+
+
+def test_fault_badput_kinds_subset():
+    assert set(FAULT_BADPUT_KINDS) <= set(BADPUT_KINDS)
+    assert "compute" not in FAULT_BADPUT_KINDS
+    assert "init_compile" not in FAULT_BADPUT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# Digest acct wire: budget + compat both directions
+# ---------------------------------------------------------------------------
+
+
+def _acct(**kinds) -> list:
+    vec = [0.0] * len(BADPUT_KINDS)
+    for k, v in kinds.items():
+        vec[BADPUT_KINDS.index(k)] = v
+    return vec
+
+
+def test_digest_acct_roundtrip():
+    d = StepDigest(step=7, rate=1.0, goodput=0.9,
+                   acct=_acct(compute=120.5, heal=3.25))
+    back = StepDigest.from_wire(json.loads(d.to_json()))
+    assert back.acct is not None
+    assert back.acct[BADPUT_KINDS.index("compute")] == pytest.approx(
+        120.5, rel=1e-3)
+    assert back.acct[BADPUT_KINDS.index("heal")] == pytest.approx(
+        3.25, rel=1e-3)
+    # acct omitted entirely when the sender has no ledger.
+    assert "acct" not in json.loads(
+        StepDigest(step=1, rate=0.0, goodput=0.0).to_json())
+
+
+def test_digest_worst_case_with_acct_stays_under_budget():
+    """A fully-loaded digest — max phases, max peers, AND a 10-kind acct
+    vector of week-scale seconds — still fits the 512-byte heartbeat
+    budget."""
+    d = StepDigest(
+        step=2**53 - 1,
+        rate=123456.789,
+        goodput=0.999999,
+        phases={k: [123456.123456, 999999.99999]
+                for k in ("q", "h", "c", "a", "m")},
+        peer_gib_s={f"peer-{i:06d}": 123456.789 for i in range(32)},
+        errored=True,
+        chaos_injections=2**31,
+        commit_failures=2**31,
+        acct=[604800.123456] * len(BADPUT_KINDS),
+    )
+    s = d.to_json()
+    assert len(s.encode()) <= StepDigest.MAX_WIRE_BYTES
+    wire = json.loads(s)
+    assert len(wire["acct"]) == len(BADPUT_KINDS)
+
+
+def test_acct_digest_against_old_lighthouse():
+    """New->old: an acct-carrying heartbeat reaches a lighthouse that
+    predates the ledger plane intact; the old server reads only the keys
+    it knows and answers normally."""
+    received = []
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve() -> None:
+        conn, _ = lsock.accept()
+        try:
+            while True:
+                req = _net.recv_json(conn, timeout=5)
+                received.append(json.loads(bytes(req).decode())
+                                if isinstance(req, (bytes, bytearray))
+                                else req)
+                _net.send_json(conn, {"ok": True})
+        except Exception:
+            pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    client = LighthouseClient(f"127.0.0.1:{port}", connect_timeout=5.0)
+    wire = json.loads(
+        StepDigest(step=9, rate=1.0, goodput=1.0,
+                   acct=_acct(compute=10.0)).to_json())
+    client.heartbeat("compat", digest=wire, hb_interval_ms=100)
+    client.close()
+    lsock.close()
+    t.join(timeout=5)
+    assert received and received[0]["digest"]["acct"][1] == 10.0
+
+
+def test_old_digest_against_new_lighthouse(lighthouse):
+    """Old->new: a digest without acct still lands in the fleet table,
+    and the job-level goodput aggregates render null rather than a made-up
+    number."""
+    c = LighthouseClient(lighthouse.address())
+    c.heartbeat("oldie", digest={"v": 1, "step": 3, "rate": 1.0},
+                hb_interval_ms=60000)
+    fleet = c.fleet()
+    assert fleet["replicas"]["oldie"]["digest"]["step"] == 3
+    agg = fleet["agg"]
+    assert agg["goodput_frac"] is None
+    assert agg["badput_s"] is None
+    assert agg["mtbf_s"] is None and agg["ettr_s"] is None
+    assert agg["slo_burning"] is False
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Lighthouse aggregation, SLO burn, MTBF/ETTR
+# ---------------------------------------------------------------------------
+
+
+def _hb_acct(c, rid, step, **kinds):
+    c.heartbeat(rid, digest={"v": 1, "step": step, "rate": 1.0, "gp": 1.0,
+                             "acct": _acct(**kinds)},
+                hb_interval_ms=60000)
+
+
+def test_fleet_badput_aggregation(lighthouse):
+    c = LighthouseClient(lighthouse.address())
+    _hb_acct(c, "ga", 5, compute=80.0, heal=5.0)
+    _hb_acct(c, "gb", 5, compute=90.0, quorum_wait=25.0)
+    agg = c.fleet()["agg"]
+    assert agg["badput_s"]["compute"] == pytest.approx(170.0)
+    assert agg["badput_s"]["heal"] == pytest.approx(5.0)
+    assert agg["badput_s"]["quorum_wait"] == pytest.approx(25.0)
+    assert agg["goodput_frac"] == pytest.approx(170.0 / 200.0)
+    # A replica's NEXT digest replaces (not double-counts) its account.
+    _hb_acct(c, "ga", 6, compute=100.0, heal=5.0)
+    agg = c.fleet()["agg"]
+    assert agg["badput_s"]["compute"] == pytest.approx(190.0)
+
+    with urllib.request.urlopen(
+        f"http://{lighthouse.address()}/metrics", timeout=5
+    ) as resp:
+        metrics = resp.read().decode()
+    assert "torchft_lighthouse_job_goodput_fraction" in metrics
+    assert 'torchft_lighthouse_job_badput_seconds{job="default",' \
+        'kind="heal"}' in metrics
+    assert "torchft_lighthouse_job_slo_burning" in metrics
+    c.close()
+
+
+def test_slo_burn_rise_and_fall(monkeypatch):
+    """Burn-rate rise edge: goodput below target by >= the burn factor
+    pushes ONE ring record (rise-edge, not per-heartbeat), the fall edge
+    clears the burning gauge without a new record."""
+    monkeypatch.setenv("TORCHFT_LH_SLO_GOODPUT", "0.95")
+    monkeypatch.setenv("TORCHFT_LH_SLO_BURN", "2.0")
+    monkeypatch.setenv("TORCHFT_LH_SLO_MIN_S", "10.0")
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
+    )
+    try:
+        c = LighthouseClient(server.address())
+        # goodput 0.5 -> burn (1-0.5)/(1-0.95) = 10x >= 2x: rise edge.
+        _hb_acct(c, "sa", 5, compute=50.0, heal=50.0)
+        fleet = c.fleet()
+        assert fleet["agg"]["slo_burning"] is True
+        burns = fleet["slo_burns"]
+        assert len(burns) == 1 and fleet["slo_seq"] == 1
+        assert burns[0]["goodput"] == pytest.approx(0.5)
+        assert burns[0]["burn"] == pytest.approx(10.0)
+        # Staying in burn does NOT re-fire (rise-edge contract).
+        _hb_acct(c, "sa", 6, compute=51.0, heal=50.0)
+        fleet = c.fleet()
+        assert fleet["slo_seq"] == 1 and len(fleet["slo_burns"]) == 1
+        # Recovery: goodput back above budget clears the gauge.
+        _hb_acct(c, "sa", 7, compute=990.0, heal=10.0)
+        fleet = c.fleet()
+        assert fleet["agg"]["slo_burning"] is False
+        assert fleet["slo_seq"] == 1
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_slo_disarmed_below_min_accounted(monkeypatch):
+    """Under slo_min_s accounted seconds the evaluator stays silent —
+    startup/compile windows cannot page."""
+    monkeypatch.setenv("TORCHFT_LH_SLO_GOODPUT", "0.95")
+    monkeypatch.setenv("TORCHFT_LH_SLO_MIN_S", "1000.0")
+    server = LighthouseServer(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=20,
+        fleet_snap_ms=0,
+    )
+    try:
+        c = LighthouseClient(server.address())
+        _hb_acct(c, "sb", 5, compute=5.0, heal=50.0)
+        fleet = c.fleet()
+        assert fleet["agg"]["slo_burning"] is False
+        assert fleet["slo_burns"] == []
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_mtbf_and_ettr_from_evidence(lighthouse):
+    c = LighthouseClient(lighthouse.address())
+    _hb_acct(c, "ma", 10, compute=60.0)
+    agg = c.fleet()["agg"]
+    assert agg["mtbf_s"] is None  # no hard evidence yet
+    # Hard evidence (proc_death) opens the recovery episode and counts
+    # toward MTBF; a soft signal must not.
+    c.heartbeat("ma", signals=[{"source": "digest_anomaly",
+                                "replica_id": "ma", "site": "t"}])
+    assert c.fleet()["agg"]["mtbf_s"] is None
+    c.heartbeat("ma", signals=[{"source": "proc_death",
+                                "replica_id": "ma", "site": "t"}])
+    agg = c.fleet()["agg"]
+    assert agg["mtbf_s"] is not None and agg["mtbf_s"] >= 0.0
+    assert agg["ettr_s"] is None  # episode still open
+    # Training moves past the step recorded at evidence time: ETTR closes.
+    _hb_acct(c, "ma", 11, compute=61.0)
+    agg = c.fleet()["agg"]
+    assert agg["ettr_s"] is not None and agg["ettr_s"] >= 0.0
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# goodput_report: offline audit
+# ---------------------------------------------------------------------------
+
+
+def _win(rid, ts, dur, total, committed=True, residual="compute", **splits):
+    body = dict(splits)
+    body[residual] = body.get(residual, 0.0) + (
+        dur - sum(splits.values()))
+    return {
+        "ts": ts, "replica_id": rid, "step": None,
+        "event": "goodput_window",
+        "attrs": {"committed": committed, "residual": residual,
+                  "dur_s": dur, "total_s": total, "splits": body},
+    }
+
+
+def test_goodput_report_tiling_and_down_attribution():
+    events = [
+        # Incarnation 1: 10s then 10s, killed after ts=120.
+        _win("r1", 110.0, 10.0, 10.0),
+        _win("r1", 120.0, 10.0, 20.0, heal=2.0),
+        # Incarnation 2: ledger restarts (total_s resets); origin at
+        # ts - total = 128 -> 8s of down between 120 and 128.
+        _win("r1", 133.0, 5.0, 5.0, residual="init_compile"),
+        _win("r1", 143.0, 10.0, 15.0),
+    ]
+    report = goodput_report.analyze(events)
+    assert goodput_report.check(report) == []
+    row = report["replicas"]["r1"]
+    assert row["incarnations"] == 2
+    assert row["down_s"] == pytest.approx(8.0)
+    assert row["badput_s"]["heal"] == pytest.approx(2.0)
+    assert row["badput_s"]["down"] == pytest.approx(8.0)
+    s = report["summary"]
+    assert s["accounted_s"] == pytest.approx(43.0)  # 35 windowed + 8 down
+    # Retention excludes init_compile from the denominator and charges
+    # only the fault kinds: (2 heal + 8 down) / (43 - 5).
+    assert s["goodput_retention"] == pytest.approx(1.0 - 10.0 / 38.0)
+
+
+def test_goodput_report_catches_broken_tiling():
+    ev = _win("r2", 10.0, 5.0, 5.0)
+    ev["attrs"]["splits"]["compute"] += 0.5  # splits no longer sum to dur
+    report = goodput_report.analyze([ev])
+    errs = goodput_report.check(report)
+    assert errs and any("splits sum" in e for e in errs)
+    # Unknown kinds are a closure violation, not silently summed.
+    ev2 = _win("r3", 10.0, 5.0, 5.0)
+    ev2["attrs"]["splits"] = {"coffee_break": 5.0}
+    errs = goodput_report.check(goodput_report.analyze([ev2]))
+    assert any("unknown kind" in e for e in errs)
+
+
+def test_goodput_report_fault_cost_join():
+    """A recovery episode overlapping goodput windows is charged the
+    overlapped non-compute seconds, keyed by fault kind."""
+    events = [
+        _win("r4", 108.0, 8.0, 8.0),
+        _win("r4", 118.0, 10.0, 18.0, heal=4.0, residual="replay_catchup"),
+        _win("r4", 128.0, 10.0, 28.0),
+    ]
+    episodes = [{
+        "id": "ep0", "open": False, "t_start": 108.0, "t_end": 116.0,
+        "primary": "r4",
+        "root_cause": {"kind": "process_loss", "replica": "r4"},
+        "replicas": {}, "cascade": [],
+    }]
+    cost = goodput_report.attribute_fault_cost(events, episodes)
+    row = cost["process_loss"]
+    assert row["episodes"] == 1
+    # A window spans [ts - dur_s, ts]. [100,108] ends at the episode
+    # start and is all compute anyway; [108,118] sits fully inside the
+    # padded episode window [108,121] -> its 4s heal + 6s replay_catchup
+    # are charged in full; [118,128] overlaps 3s but is all compute.
+    assert row["cost_s"]["heal"] == pytest.approx(4.0)
+    assert row["cost_s"]["replay_catchup"] == pytest.approx(6.0)
+    assert "compute" not in row["cost_s"]
+
+
+# ---------------------------------------------------------------------------
+# obs_top / obs_export surfacing
+# ---------------------------------------------------------------------------
+
+
+def _fleet_payload():
+    return {
+        "job": "default",
+        "replicas": {
+            "acct-r0": {
+                "digest": {"v": 1, "step": 9, "rate": 1.0, "gp": 0.9,
+                           "acct": _acct(compute=90.0, heal=10.0)},
+                "digest_age_ms": 10, "hb_age_ms": 10, "straggler": False,
+                "flags": [],
+            },
+            "plain-r1": {
+                "digest": {"v": 1, "step": 9, "rate": 1.0, "gp": 0.9},
+                "digest_age_ms": 10, "hb_age_ms": 10, "straggler": False,
+                "flags": [],
+            },
+        },
+        "agg": {"n": 2, "n_digest": 2, "stragglers": 0,
+                "quorum_world": 2, "joins_total": 0, "leaves_total": 0,
+                "badput_s": {k: 0.0 for k in BADPUT_KINDS},
+                "goodput_frac": 0.9, "slo_burning": True,
+                "mtbf_s": 1234.5, "ettr_s": 6.7},
+        "anomalies": [], "signals": [], "anomaly_seq": 0, "signal_seq": 0,
+        "slo_burns": [{"seq": 1, "ts_ms": 1, "job": "default",
+                       "goodput": 0.5, "target": 0.95, "burn": 10.0}],
+        "slo_seq": 1,
+    }
+
+
+def test_obs_top_renders_goodput_column_and_glyph():
+    fleet = _fleet_payload()
+    frame = obs_top.render(fleet, color=False)
+    assert obs_top.check_frame(fleet, frame) == []
+    assert "LEDG%" in frame and "WORST" in frame
+    row = next(ln for ln in frame.splitlines()
+               if ln.startswith("acct-r0"))
+    assert "90.0" in row  # ledger goodput %
+    assert " he " in row  # worst badput kind glyph (heal)
+    assert "goodput=90.0%" in frame.splitlines()[0]
+    assert "SLO_BURN" in frame.splitlines()[0]
+    # An acct-less digest renders dashes, not a fake number.
+    plain = next(ln for ln in frame.splitlines()
+                 if ln.startswith("plain-r1"))
+    assert " - " in plain
+    # Dropping the glyph fails the check.
+    broken = frame.replace(" he ", " -- ")
+    assert any("worst-badput" in p
+               for p in obs_top.check_frame(fleet, broken))
+
+
+def test_obs_top_acct_view_compat():
+    assert obs_top._acct_view({}) == (None, "-")
+    assert obs_top._acct_view({"acct": [1.0, 2.0]}) == (None, "-")
+    gp, glyph = obs_top._acct_view({"acct": _acct(compute=8.0, down=2.0)})
+    assert gp == pytest.approx(80.0)
+    assert glyph == "dn"
+
+
+def test_obs_export_goodput_gauges_and_slo_journal(tmp_path):
+    fleet = _fleet_payload()
+    fleet["agg"]["badput_s"] = {"compute": 90.0, "heal": 10.0}
+    text = obs_export.render_fleet_prometheus(fleet)
+    assert 'torchft_exporter_fleet_goodput_fraction{job="default"} 0.9' \
+        in text
+    assert 'torchft_exporter_fleet_badput_seconds{job="default",' \
+        'kind="heal"} 10' in text
+    assert 'torchft_exporter_fleet_slo_burning{job="default"} 1' in text
+    assert 'torchft_exporter_fleet_mtbf_seconds{job="default"}' in text
+    # Cardinality is bounded by the closed enum: no kind label outside
+    # BADPUT_KINDS can ever be emitted.
+    fleet["agg"]["badput_s"]["coffee_break"] = 5.0
+    text = obs_export.render_fleet_prometheus(fleet)
+    assert "coffee_break" not in text
+
+    # slo_burn journaling: rise-edge records, cursor-deduped.
+    from torchft_tpu.telemetry import EventLog
+
+    jpath = tmp_path / "exp.jsonl"
+    journal = EventLog(str(jpath), replica_id="exporter")
+    cur = obs_export.journal_slo_burns(journal, fleet, 0)
+    assert cur == 1
+    cur = obs_export.journal_slo_burns(journal, fleet, cur)  # no dupes
+    journal.close()
+    lines = [json.loads(ln) for ln in jpath.read_text().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["event"] == "slo_burn"
+    assert lines[0]["attrs"]["burn"] == pytest.approx(10.0)

@@ -583,6 +583,57 @@ def test_goodput_commit_fail_heal_bucketing():
         m.shutdown()
 
 
+def test_goodput_ledger_tiles_wall_clock():
+    """The TimeLedger audit fix: the per-kind accounts in goodput() must
+    tile the accounted wall clock to 1e-6 across commit/fail/heal/drain
+    outcomes — the legacy committed/failed/heal buckets are a derived
+    view, the ledger is authoritative. The residual of each window is
+    routed by outcome: first gate -> init_compile, failed gate ->
+    discarded_step, clean gate -> compute."""
+    import time as _time
+
+    from torchft_tpu.telemetry import BADPUT_KINDS
+
+    m = make_manager()
+    shut = False
+    try:
+        m.start_quorum()
+        assert m.should_commit() is True  # first gate -> init_compile
+        _time.sleep(0.03)
+        m.start_quorum()
+        assert m.should_commit() is True  # clean window -> compute
+        m.start_quorum()
+        m.report_error(RuntimeError("injected"))
+        _time.sleep(0.03)
+        assert m.should_commit() is False  # failed -> discarded_step
+
+        g = m.goodput()
+        badput = g["badput_s"]
+        assert set(badput) == set(BADPUT_KINDS)
+        assert g["tiling_error_s"] < 1e-6
+        assert badput["init_compile"] > 0.0
+        assert badput["compute"] > 0.0
+        assert badput["discarded_step"] > 0.0
+        assert badput["quorum_wait"] >= 0.0
+        assert 0.0 < g["ledger_goodput_frac"] < 1.0
+        # The exposed dict is rounded for humans; the live ledger holds
+        # the exact invariant.
+        assert m._ledger.tiling_error_s() < 1e-6
+        assert m._ledger.total_s() == pytest.approx(
+            sum(m._ledger.totals().values()), abs=1e-6)
+
+        # Shutdown accounts the tail window as drain — accounted time
+        # keeps covering wall clock right up to process exit.
+        m.shutdown()
+        shut = True
+        t = m._ledger.totals()
+        assert t["drain"] > 0.0
+        assert m._ledger.tiling_error_s() < 1e-6
+    finally:
+        if not shut:
+            m.shutdown()
+
+
 def test_wrap_future_completes_even_if_report_error_raises():
     """If report_error (or the logger) raises on the callback thread, the
     wrapped future must still resolve to the default — otherwise the

@@ -36,7 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402
 from torchft_tpu import knobs  # noqa: E402
 from torchft_tpu.coordination import LighthouseClient  # noqa: E402
-from torchft_tpu.telemetry import EventLog  # noqa: E402
+from torchft_tpu.telemetry import BADPUT_KINDS, EventLog  # noqa: E402
 
 
 def scrape(client: LighthouseClient, timeout: float = 5.0) -> Dict[str, Any]:
@@ -212,6 +212,45 @@ def render_fleet_prometheus(fleet: Dict[str, Any],
                "Median rolling goodput fraction across digest replicas.")
         lines.append(f"torchft_exporter_fleet_median_goodput{{{jl}}} "
                      f"{float(agg['median_goodput']):.6g}")
+    # Time-accounting plane: job goodput fraction + per-kind badput sums.
+    # The kind label iterates the CLOSED BADPUT_KINDS enum (never the
+    # payload's keys), so the series set is cardinality-bounded by
+    # construction even against a newer lighthouse.
+    if agg.get("goodput_frac") is not None:
+        header("torchft_exporter_fleet_goodput_fraction",
+               "Compute share of all accounted replica-seconds in this "
+               "job (from the cumulative badput ledger).")
+        lines.append(f"torchft_exporter_fleet_goodput_fraction{{{jl}}} "
+                     f"{float(agg['goodput_frac']):.6g}")
+    badput = agg.get("badput_s") or {}
+    if badput:
+        header("torchft_exporter_fleet_badput_seconds",
+               "Accounted replica-seconds per badput kind (closed "
+               "BADPUT_KINDS enum).")
+        for kind in BADPUT_KINDS:
+            if kind in badput:
+                lines.append(
+                    f'torchft_exporter_fleet_badput_seconds{{{jl},'
+                    f'kind="{esc(kind)}"}} {float(badput[kind]):.6g}')
+    if agg.get("mtbf_s") is not None:
+        header("torchft_exporter_fleet_mtbf_seconds",
+               "Mean time between hard-evidence faults in this job.")
+        lines.append(f"torchft_exporter_fleet_mtbf_seconds{{{jl}}} "
+                     f"{float(agg['mtbf_s']):.6g}")
+    if agg.get("ettr_s") is not None:
+        header("torchft_exporter_fleet_ettr_seconds",
+               "Mean evidence-to-training-resumption time in this job.")
+        lines.append(f"torchft_exporter_fleet_ettr_seconds{{{jl}}} "
+                     f"{float(agg['ettr_s']):.6g}")
+    header("torchft_exporter_fleet_slo_burning",
+           "1 while this job burns its goodput error budget faster than "
+           "the configured threshold.")
+    lines.append(f"torchft_exporter_fleet_slo_burning{{{jl}}} "
+                 f"{1 if agg.get('slo_burning') else 0}")
+    header("torchft_exporter_fleet_slo_burns_total",
+           "SLO burn-rate rise edges since lighthouse boot.")
+    lines.append(f"torchft_exporter_fleet_slo_burns_total{{{jl}}} "
+                 f"{int(fleet.get('slo_seq', 0))}")
 
     header("torchft_exporter_replica_straggler",
            "1 when the lighthouse flags this replica as a straggler.")
@@ -381,6 +420,33 @@ def journal_signals(journal: Optional[EventLog],
                 site=str(rec.get("site", "")),
                 ts_ms=int(rec.get("ts_ms", 0)),
                 detail=rec.get("detail"),
+            )
+    return cursor
+
+
+def journal_slo_burns(journal: Optional[EventLog],
+                      fleet: Optional[Dict[str, Any]],
+                      cursor: int) -> int:
+    """Emit every SLO burn-rate rise edge newer than ``cursor`` as an
+    ``slo_burn`` journal event; returns the new cursor. Burn records carry
+    a lighthouse-assigned monotone ``seq`` like anomalies, so a restarting
+    exporter only replays what the ring still holds."""
+    if fleet is None:
+        return cursor
+    for rec in fleet.get("slo_burns") or []:
+        seq = int(rec.get("seq", 0))
+        if seq <= cursor:
+            continue
+        cursor = seq
+        if journal is not None:
+            journal.emit(
+                "slo_burn",
+                seq=seq,
+                job=str(rec.get("job", "")),
+                goodput=rec.get("goodput"),
+                target=rec.get("target"),
+                burn=rec.get("burn"),
+                ts_ms=int(rec.get("ts_ms", 0)),
             )
     return cursor
 
@@ -603,6 +669,7 @@ def main(argv: Optional[list] = None) -> int:
                 journal_overflow(journal, fleet, 0)
                 journal_signals(journal, fleet, 0)
                 journal_signal_overflow(journal, fleet, 0)
+                journal_slo_burns(journal, fleet, 0)
                 sys.stdout.write(render_fleet_prometheus(fleet))
         if args.journal:
             sys.stdout.write(
@@ -630,6 +697,7 @@ def main(argv: Optional[list] = None) -> int:
     overflow_mark = 0
     signal_cursor = 0
     signal_overflow_mark = 0
+    slo_cursor = 0
     try:
         while True:
             try:
@@ -649,6 +717,9 @@ def main(argv: Optional[list] = None) -> int:
                 )
                 signal_overflow_mark = journal_signal_overflow(
                     journal, fleet, signal_overflow_mark
+                )
+                slo_cursor = journal_slo_burns(
+                    journal, fleet, slo_cursor
                 )
                 scrapes += 1
                 if args.max_scrapes and scrapes >= args.max_scrapes:

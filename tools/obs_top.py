@@ -41,6 +41,22 @@ from typing import Any, Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from torchft_tpu import knobs  # noqa: E402
+from torchft_tpu.telemetry import BADPUT_KINDS  # noqa: E402
+
+# Two-letter glyph per badput kind for the WORST column ("compute" never
+# renders there — it is the goodput numerator, not badput).
+BADPUT_GLYPHS = {
+    "init_compile": "ic",
+    "compute": "ok",
+    "exposed_comm": "xc",
+    "quorum_wait": "qw",
+    "heal": "he",
+    "discarded_step": "ds",
+    "replay_catchup": "rc",
+    "straggler_idle": "si",
+    "drain": "dr",
+    "down": "dn",
+}
 
 ANSI_HOME_CLEAR = "\x1b[H\x1b[J"
 ANSI_BOLD = "\x1b[1m"
@@ -88,6 +104,26 @@ def _heal_s(digest: Dict[str, Any]) -> Optional[float]:
     if not isinstance(pair, list) or len(pair) < 2 or pair[1] is None:
         return None
     return float(pair[1])
+
+
+def _acct_view(digest: Dict[str, Any]) -> tuple:
+    """``(ledger goodput %, worst-badput-kind glyph)`` from the digest's
+    cumulative ``acct`` vector (positional by BADPUT_KINDS). ``(None,
+    "-")`` for pre-taxonomy digests or before any accounted second."""
+    acct = digest.get("acct")
+    if not isinstance(acct, list) or len(acct) < len(BADPUT_KINDS):
+        return None, "-"
+    vals = [max(float(v), 0.0) for v in acct[: len(BADPUT_KINDS)]]
+    total = sum(vals)
+    if total <= 0:
+        return None, "-"
+    by = dict(zip(BADPUT_KINDS, vals))
+    gp = by["compute"] / total * 100.0
+    worst = max((k for k in BADPUT_KINDS if k != "compute"),
+                key=lambda k: by[k])
+    if by[worst] <= 0:
+        return gp, "-"
+    return gp, BADPUT_GLYPHS.get(worst, "??")
 
 
 def _bw_summary(digest: Dict[str, Any]) -> str:
@@ -171,9 +207,16 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
         + f" signals={int(fleet.get('signal_seq', 0))}"
         + (f" sig_dropped={int(agg.get('signals_dropped', 0))}"
            if agg.get("signals_dropped") else "")
+        # GOODPUT: the job's compute share of every accounted
+        # replica-second (cumulative badput ledger), plus a loud marker
+        # while the lighthouse's SLO burn-rate evaluator is tripped.
+        + (f" goodput={float(agg['goodput_frac']) * 100:.1f}%"
+           if agg.get("goodput_frac") is not None else "")
+        + (" SLO_BURN" if agg.get("slo_burning") else "")
         + (f" showing={len(order)}/{len(replicas)}" if hidden > 0 else ""),
         ANSI_BOLD))
     header = (f"{'REPLICA':<20} {'STEP':>7} {'RATE/s':>7} {'GOOD%':>6} "
+              f"{'LEDG%':>6} {'WORST':>5} "
               f"{'Q95ms':>7} {'H95ms':>7} {'C95ms':>7} {'A95ms':>7} "
               f"{'M95ms':>7} {'BWmin':>6} {'HB_ms':>7} {'HEAL':>9} "
               f"{'SIGNAL':>14}  FLAGS")
@@ -197,11 +240,16 @@ def render(fleet: Dict[str, Any], color: bool = False, top: int = 0,
         # evidence plane last learned about it, straight from the ring.
         signal_cell = str(r.get("signal") or "-")[:14]
         gp = dg.get("gp")
+        # LEDG%/WORST: cumulative ledger goodput + the badput kind this
+        # replica has lost the most seconds to (two-letter glyph).
+        ledger_gp, worst_glyph = _acct_view(dg)
         row = (
             f"{str(rid)[:20]:<20} "
             f"{_fmt(dg.get('step'), '{:.0f}'):>7} "
             f"{_fmt(dg.get('rate'), '{:.3f}'):>7} "
             f"{_fmt(None if gp is None else float(gp) * 100, '{:.1f}'):>6} "
+            f"{_fmt(ledger_gp, '{:.1f}'):>6} "
+            f"{worst_glyph:>5} "
             f"{_fmt(_phase_ms(dg, 'q'), '{:.1f}'):>7} "
             f"{_fmt(_phase_ms(dg, 'h'), '{:.1f}'):>7} "
             f"{_fmt(_phase_ms(dg, 'c'), '{:.1f}'):>7} "
@@ -346,6 +394,19 @@ def check_frame(fleet: Dict[str, Any], frame: str,
                 problems.append(
                     f"replica {rid!r} failure-evidence signal {sig!r} "
                     f"not rendered in its SIGNAL column")
+        # Time-accounting columns: a digest that carries the cumulative
+        # acct vector must render its ledger goodput cell and the
+        # worst-badput-kind glyph; pre-taxonomy digests render dashes.
+        ledger_gp, worst_glyph = _acct_view(replicas[rid].get("digest") or {})
+        if ledger_gp is not None:
+            row = next(ln for ln in frame_lines if ln.startswith(shown))
+            if f"{ledger_gp:.1f}" not in row:
+                problems.append(
+                    f"replica {rid!r} ledger goodput cell not rendered")
+            if worst_glyph != "-" and f" {worst_glyph} " not in row:
+                problems.append(
+                    f"replica {rid!r} worst-badput glyph {worst_glyph!r} "
+                    f"not rendered")
     head = frame_lines[0] if frame_lines else ""
     if f"replicas={int(agg.get('n', 0))}" not in head:
         problems.append("aggregate replica count missing from header")
@@ -361,6 +422,11 @@ def check_frame(fleet: Dict[str, Any], frame: str,
                         "from header")
     if f"signals={int(fleet.get('signal_seq', 0))}" not in head:
         problems.append("failure-evidence signal count missing from header")
+    if agg.get("goodput_frac") is not None:
+        if f"goodput={float(agg['goodput_frac']) * 100:.1f}%" not in head:
+            problems.append("job goodput fraction missing from header")
+    if agg.get("slo_burning") and "SLO_BURN" not in head:
+        problems.append("SLO burn state missing from header")
     for rec in (fleet.get("signals") or [])[-8:]:
         want = f"#{rec.get('seq')} {rec.get('source')}"
         if not any(want in ln for ln in frame_lines):

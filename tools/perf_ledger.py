@@ -152,7 +152,8 @@ def record_report(
     """Append a live tool report's headline metrics, reusing the same
     extractors as the legacy-artifact importer so live runs extend the
     backfilled trajectories under identical metric names. ``kind`` is
-    one of bench|pg|fleet|wan|recovery|elastic|control|detect. Returns
+    one of bench|pg|fleet|wan|recovery|elastic|control|detect|goodput.
+    Returns
     the number of records
     appended;
     never raises into the calling bench."""
@@ -437,6 +438,13 @@ def _recovery_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
             out.append((f"recovery.heal_gib_s.{transport}",
                         float(row["p50"]), "GiB/s", "higher", "recovery",
                         src, {"n": row.get("n"), "bytes": row.get("bytes")}))
+    if summ.get("goodput_during_heal_p50") is not None:
+        # Healthy-fleet compute share while one replica heals, from the
+        # goodput ledger's windows intersected with each episode window —
+        # the per-episode cut of the ROADMAP "goodput-during-heal" gate.
+        out.append(("recovery.goodput_during_heal",
+                    float(summ["goodput_during_heal_p50"]), "ratio",
+                    "higher", "recovery", src, extra))
     return out
 
 
@@ -493,6 +501,37 @@ def _control_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _goodput_records(fn: str, doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """BENCH_GOODPUT.json (tools/goodput_soak.py): the audited
+    time-accounting headline — fleet goodput fraction, fault badput
+    seconds, and goodput retention at 1 kill/100 steps (retention
+    carries the absolute 0.95 budget: the paper's <5% throughput-loss
+    claim)."""
+    src = f"tools/goodput_soak.py ({os.path.basename(fn)})"
+    summ = doc.get("summary") or {}
+    out = []
+    extra = {
+        "windows": summ.get("num_windows"),
+        "episodes": summ.get("num_episodes"),
+        "kills": doc.get("kills"),
+        "steps": doc.get("steps"),
+    }
+    if summ.get("goodput_retention") is not None:
+        out.append(("goodput.retention",
+                    float(summ["goodput_retention"]), "ratio", "higher",
+                    "goodput", src, extra))
+    if summ.get("goodput_frac") is not None:
+        out.append(("goodput.fleet_fraction",
+                    float(summ["goodput_frac"]), "ratio", "higher",
+                    "goodput", src, extra))
+    if summ.get("fault_badput_s") is not None:
+        out.append(("goodput.fault_badput_s",
+                    float(summ["fault_badput_s"]), "s", "lower",
+                    "goodput", src,
+                    {"badput_s": summ.get("badput_s")}))
+    return out
+
+
 # Live benches reuse the same extractors via record_report(), so one
 # metric name has exactly one extraction path (import-time and run-time).
 _REPORT_EXTRACTORS = {
@@ -504,6 +543,7 @@ _REPORT_EXTRACTORS = {
     "elastic": _elastic_records,
     "control": _control_records,
     "detect": _detect_records,
+    "goodput": _goodput_records,
 }
 
 

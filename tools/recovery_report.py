@@ -102,10 +102,56 @@ def attribute_detect(events: List[Dict[str, Any]],
         e["detect_signal"] = win
 
 
+def attribute_goodput(events: List[Dict[str, Any]],
+                      episodes: List[Dict[str, Any]]) -> None:
+    """Annotate each closed episode with ``goodput_during_heal``: the
+    compute share of the *healthy* replicas' accounted time inside the
+    episode window, from the goodput ledger's ``goodput_window`` events
+    (each spans ``[ts - dur_s, ts]``; overlap is attributed pro-rata).
+    The primary (healing) replica is excluded — the question is how much
+    the rest of the fleet kept training while one replica recovered.
+    ``None`` when the run predates the time-accounting plane. Pure
+    annotation: the phase tiling is untouched, so ``--check``'s
+    invariant is unaffected."""
+    wins = []
+    for ev in events:
+        if ev.get("event") != "goodput_window":
+            continue
+        a = ev.get("attrs") or {}
+        ts = float(ev.get("ts", 0.0))
+        dur = float(a.get("dur_s", 0.0))
+        if dur <= 0:
+            continue
+        wins.append((str(ev.get("replica_id")), ts - dur, ts, dur,
+                     a.get("splits") or {}))
+    for e in episodes:
+        if e["open"]:
+            e["goodput_during_heal"] = None
+            continue
+        lo, hi = float(e["t_start"]), float(e["t_end"])
+        compute = total = 0.0
+        primary_slot = str(e["primary"]).split(":", 1)[0]
+        for rid, w_lo, w_hi, dur, splits in wins:
+            # Slot-prefix match: the relaunched incarnation carries a
+            # fresh uuid suffix but is still the healing replica.
+            if rid.split(":", 1)[0] == primary_slot:
+                continue
+            overlap = min(hi, w_hi) - max(lo, w_lo)
+            if overlap <= 0:
+                continue
+            frac = min(overlap / dur, 1.0)
+            total += dur * frac
+            compute += float(splits.get("compute", 0.0)) * frac
+        e["goodput_during_heal"] = (
+            round(compute / total, 6) if total > 0 else None
+        )
+
+
 def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Full report dict from a merged event list."""
     episodes = telemetry.detect_episodes(events)
     attribute_detect(events, episodes)
+    attribute_goodput(events, episodes)
     closed = [e for e in episodes if not e["open"]]
     ttrs = [e["ttr_s"] for e in closed]
     phases: Dict[str, Dict[str, Any]] = {}
@@ -162,11 +208,14 @@ def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
         for src, v in sorted(by_source.items())
     }
+    gdh = [e["goodput_during_heal"] for e in closed
+           if e.get("goodput_during_heal") is not None]
     return {
         "episodes": episodes,
         "summary": {
             "num_episodes": len(episodes),
             "num_open": sum(1 for e in episodes if e["open"]),
+            "goodput_during_heal_p50": _percentile(gdh, 50),
             "ttr_p50_s": _percentile(ttrs, 50),
             "ttr_p95_s": _percentile(ttrs, 95),
             "ttr_max_s": max(ttrs) if ttrs else None,
@@ -284,6 +333,11 @@ def render_text(report: Dict[str, Any]) -> str:
             f"replica {rc['replica']}{detail}, primary {e['primary']}"
             + (f", trace {e['trace']}" if e.get("trace") else "")
         )
+        if e.get("goodput_during_heal") is not None:
+            out.append(
+                f"  healthy-fleet goodput during heal: "
+                f"{e['goodput_during_heal'] * 100:.2f}%"
+            )
         ds = e.get("detect_signal")
         if ds:
             out.append(
@@ -338,6 +392,11 @@ def render_text(report: Dict[str, Any]) -> str:
         )
         + f", {s['failed_attempts']} failed heal attempt(s)"
     )
+    if s.get("goodput_during_heal_p50") is not None:
+        out.append(
+            f"healthy-fleet goodput during heal: "
+            f"p50 {s['goodput_during_heal_p50'] * 100:.2f}%"
+        )
     for t, g in s["heal_gib_s"].items():
         out.append(
             f"heal bandwidth [{t}]: p50 {g['p50']:.3f} GiB/s over "

@@ -86,6 +86,13 @@
 #                                       # source matches the fault kind),
 #                                       # same-seed replay, then perf_gate
 #                                       # --check vs pinned detection budgets
+#        bash tools/suite_gate.sh goodput # goodput ledger soak: 2-replica
+#                                       # paced DDP with 1 kill/100 steps ->
+#                                       # BENCH_GOODPUT.json, accounts must
+#                                       # tile wall clock (eps 1e-6), kill
+#                                       # cost attributed per fault kind,
+#                                       # then perf_gate --check vs the
+#                                       # pinned 0.95 retention budget
 #        bash tools/suite_gate.sh control # control-plane-loss drill: kill
 #                                       # the active lighthouse mid-run ->
 #                                       # warm-standby takeover (epoch+1),
@@ -177,6 +184,17 @@ if [ "${1:-}" = "detect" ]; then
   timeout 120 env JAX_PLATFORMS=cpu python tools/detect_drill.py \
     --replay || exit 1
   echo "== detect gate: ledger head vs pinned detection budgets =="
+  exec timeout 120 python tools/perf_gate.py --check
+fi
+
+if [ "${1:-}" = "goodput" ]; then
+  echo "== goodput soak: paced 2-replica DDP, 1 kill/100 steps =="
+  timeout 900 env JAX_PLATFORMS=cpu python tools/goodput_soak.py --quick \
+    || exit 1
+  echo "== goodput report: accounts must tile wall clock (eps 1e-6) =="
+  timeout 120 env JAX_PLATFORMS=cpu python tools/goodput_report.py \
+    --from-bench BENCH_GOODPUT.json --check --min-windows 50 || exit 1
+  echo "== goodput gate: ledger head vs pinned retention budget =="
   exec timeout 120 python tools/perf_gate.py --check
 fi
 

@@ -46,6 +46,37 @@ bool known_signal_source(const std::string& s) {
     if (s == n) return true;
   return false;
 }
+
+// Closed badput taxonomy — positionally mirrors telemetry.BADPUT_KINDS on
+// the Python side (lint rule badput-kinds). The digest's "acct" array is
+// indexed by this order; index 1 ("compute") is the goodput numerator.
+const char* const kBadputKindNames[] = {
+    "init_compile",   "compute",        "exposed_comm",
+    "quorum_wait",    "heal",           "discarded_step",
+    "replay_catchup", "straggler_idle", "drain",
+    "down",
+};
+static_assert(sizeof(kBadputKindNames) / sizeof(kBadputKindNames[0]) ==
+                  static_cast<size_t>(kNumBadputKinds),
+              "kBadputKindNames must match kNumBadputKinds");
+constexpr int kBadputComputeIdx = 1;
+
+// Hard failure evidence (same set the trainer's _EvidenceWatcher acts on):
+// these rise edges count as faults for MTBF and open an ETTR episode.
+bool hard_signal_source(const std::string& s) {
+  return s == "hb_lapse" || s == "proc_death" || s == "native_abort";
+}
+
+// A digest's acct vector, when complete: pre-namespace digests (or ones
+// from a client older than the taxonomy) simply don't contribute.
+bool digest_acct(const Json& digest, double out[kNumBadputKinds]) {
+  const Json& a = digest.get("acct");
+  if (!a.is_array() || a.arr.size() < static_cast<size_t>(kNumBadputKinds))
+    return false;
+  for (int i = 0; i < kNumBadputKinds; i++)
+    out[i] = a.arr[i].as_double(0.0);
+  return true;
+}
 }  // namespace
 
 Lighthouse::Lighthouse(const std::string& bind_host, int port,
@@ -57,6 +88,14 @@ Lighthouse::Lighthouse(const std::string& bind_host, int port,
   const char* em = std::getenv("TORCHFT_EXPORT_MAX_REPLICAS");
   if (em != nullptr && *em != '\0') export_max_replicas_ = std::atoll(em);
   if (export_max_replicas_ < 0) export_max_replicas_ = 0;
+  // SLO burn-rate knobs. A target >= 1.0 disarms the evaluator (the burn
+  // denominator would be <= 0 — there is no error budget to spend).
+  const char* sg = std::getenv("TORCHFT_LH_SLO_GOODPUT");
+  if (sg != nullptr && *sg != '\0') slo_goodput_ = std::atof(sg);
+  const char* sb = std::getenv("TORCHFT_LH_SLO_BURN");
+  if (sb != nullptr && *sb != '\0') slo_burn_ = std::atof(sb);
+  const char* sm = std::getenv("TORCHFT_LH_SLO_MIN_S");
+  if (sm != nullptr && *sm != '\0') slo_min_s_ = std::atof(sm);
 }
 
 Lighthouse::~Lighthouse() { stop(); }
@@ -1064,6 +1103,24 @@ void Lighthouse::signal_note_locked(JobState& js, const std::string& source,
   // complete.
   js.signal_seq += 1;
   js.signal_counts[source] += 1;
+  // Fault bookkeeping off the evidence plane: hard sources are faults for
+  // MTBF, and the first one with no open episode starts the ETTR clock —
+  // recovery is "done" when any digest step passes the fleet max as of
+  // now (forward progress resumed; see fleet_note_heartbeat).
+  if (hard_signal_source(source)) {
+    js.hard_signals += 1;
+    if (!js.ettr_open) {
+      int64_t max_step = 0;
+      for (const auto& kv : js.fleet)
+        if (kv.second.has_digest) {
+          int64_t st = kv.second.digest.get("step").as_int(0);
+          if (st > max_step) max_step = st;
+        }
+      js.ettr_open = true;
+      js.ettr_open_ms = now;
+      js.ettr_open_step = max_step;
+    }
+  }
   Json sgn = Json::object();
   sgn["seq"] = Json::of(js.signal_seq);
   sgn["ts_ms"] = Json::of(now);
@@ -1123,6 +1180,11 @@ void Lighthouse::fleet_agg_remove(JobState& js, const FleetEntry& e) {
   auto it = js.agg_cfs.find(e.digest.get("cf").as_int(0));
   if (it != js.agg_cfs.end()) js.agg_cfs.erase(it);
   js.n_digest -= 1;
+  double acct[kNumBadputKinds];
+  if (digest_acct(e.digest, acct)) {
+    for (int i = 0; i < kNumBadputKinds; i++) js.agg_badput[i] -= acct[i];
+    js.n_acct -= 1;
+  }
 }
 
 void Lighthouse::fleet_agg_insert(JobState& js, const FleetEntry& e) {
@@ -1133,6 +1195,11 @@ void Lighthouse::fleet_agg_insert(JobState& js, const FleetEntry& e) {
   js.agg_gps.insert(e.digest.get("gp").as_double(0.0));
   js.agg_cfs.insert(e.digest.get("cf").as_int(0));
   js.n_digest += 1;
+  double acct[kNumBadputKinds];
+  if (digest_acct(e.digest, acct)) {
+    for (int i = 0; i < kNumBadputKinds; i++) js.agg_badput[i] += acct[i];
+    js.n_acct += 1;
+  }
 }
 
 void Lighthouse::fleet_erase(JobState& js, const std::string& replica_id) {
@@ -1168,6 +1235,7 @@ void Lighthouse::fleet_note_heartbeat(JobState& js,
   e.last_hb_ms = now;
   e.hb_count += 1;
   js.fleet_gen += 1;
+  if (js.first_seen_ms == 0) js.first_seen_ms = now;
   int64_t declared = req.get("hb_interval_ms").as_int(0);
   if (declared > 0) e.hb_interval_ms = declared;
   if (!req.has("digest") || !req.get("digest").is_object()) return;
@@ -1217,6 +1285,56 @@ void Lighthouse::fleet_note_heartbeat(JobState& js,
       fleet_set_flag(js, replica_id, e, "step_lag", now, d);
     } else {
       fleet_clear_flag(js, e, "step_lag");
+    }
+  }
+
+  // ETTR close: training moved past the fleet max step recorded when the
+  // fault's hard evidence arrived — the job has recovered.
+  if (js.ettr_open && own_step > js.ettr_open_step) {
+    js.ettr_sum_s += static_cast<double>(now - js.ettr_open_ms) / 1000.0;
+    js.ettr_n += 1;
+    js.ettr_open = false;
+  }
+
+  // SLO burn-rate evaluator: burn = (1 - goodput) / (1 - target) — how
+  // many times faster than allotted the job spends its error budget.
+  // Rise-edge only (the ring is the pager feed), armed after slo_min_s_
+  // accounted seconds so compile/startup can't page, disarmed entirely
+  // when target >= 1 (no budget to spend).
+  if (js.n_acct > 0 && slo_goodput_ < 1.0) {
+    double acct_total = 0.0;
+    for (int i = 0; i < kNumBadputKinds; i++)
+      acct_total += js.agg_badput[i] > 0.0 ? js.agg_badput[i] : 0.0;
+    if (acct_total >= slo_min_s_) {
+      double gp = std::max(js.agg_badput[kBadputComputeIdx], 0.0) / acct_total;
+      double burn = (1.0 - gp) / (1.0 - slo_goodput_);
+      if (burn >= slo_burn_) {
+        if (!js.slo_burning) {
+          js.slo_burning = true;
+          js.slo_seq += 1;
+          Json b = Json::object();
+          b["seq"] = Json::of(js.slo_seq);
+          b["ts_ms"] = Json::of(now);
+          b["job"] = Json::of(js.name);
+          b["goodput"] = Json::of(gp);
+          b["target"] = Json::of(slo_goodput_);
+          b["burn"] = Json::of(burn);
+          js.slo_burns.push_back(b);
+          while (js.slo_burns.size() > kFleetAnomalyRing) {
+            js.slo_burns.pop_front();
+            js.slo_dropped += 1;
+          }
+          js.fleet_gen += 1;
+          fprintf(stderr,
+                  "[lighthouse] slo_burn #%lld: job %s goodput %.4f vs "
+                  "target %.4f (burn %.2fx)\n",
+                  static_cast<long long>(js.slo_seq), js.name.c_str(), gp,
+                  slo_goodput_, burn);
+        }
+      } else if (js.slo_burning) {
+        js.slo_burning = false;  // fall edge: budget spend back in bounds
+        js.fleet_gen += 1;
+      }
     }
   }
   hist_anomaly_.observe_us(now_us_steady() - an_t0);
@@ -1317,6 +1435,35 @@ Json Lighthouse::fleet_agg_locked(JobState& js, int64_t now) {
   // reader comparing two lighthouses can tell owner from fenced stale
   // primary by it.
   agg["epoch"] = Json::of(epoch_.load());
+  // Time-accounting rollup: per-kind badput seconds summed over every row
+  // whose digest carries an acct vector (clamped at 0 — the running sums
+  // can drift a few ulps negative), the job goodput fraction (compute
+  // share of all accounted seconds), and the fault metrics derived from
+  // the evidence plane. Null until any acct digest / fault arrives.
+  double acct_total = 0.0;
+  for (int i = 0; i < kNumBadputKinds; i++)
+    acct_total += js.agg_badput[i] > 0.0 ? js.agg_badput[i] : 0.0;
+  if (js.n_acct > 0 && acct_total > 0.0) {
+    Json bp = Json::object();
+    for (int i = 0; i < kNumBadputKinds; i++)
+      bp[kBadputKindNames[i]] = Json::of(std::max(js.agg_badput[i], 0.0));
+    agg["badput_s"] = bp;
+    agg["goodput_frac"] =
+        Json::of(std::max(js.agg_badput[kBadputComputeIdx], 0.0) / acct_total);
+  } else {
+    agg["badput_s"] = Json::null();
+    agg["goodput_frac"] = Json::null();
+  }
+  agg["mtbf_s"] =
+      js.hard_signals > 0 && js.first_seen_ms > 0
+          ? Json::of(static_cast<double>(now - js.first_seen_ms) / 1000.0 /
+                     static_cast<double>(js.hard_signals))
+          : Json::null();
+  agg["ettr_s"] = js.ettr_n > 0 ? Json::of(js.ettr_sum_s /
+                                           static_cast<double>(js.ettr_n))
+                                : Json::null();
+  agg["slo_burning"] = Json::of(js.slo_burning);
+  agg["slo_dropped"] = Json::of(js.slo_dropped);
   return agg;
 }
 
@@ -1358,19 +1505,22 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   std::vector<std::pair<std::string, FleetEntry>> rows;
   std::deque<Json> anomalies;
   std::deque<Json> signals;
+  std::deque<Json> slo_burns;
   std::map<std::string, int64_t> signal_counts;
   Json agg;
-  int64_t gen, aseq, sseq;
+  int64_t gen, aseq, sseq, slseq;
   {
     std::lock_guard<std::mutex> lk(js.mu);
     rows.assign(js.fleet.begin(), js.fleet.end());
     anomalies = js.anomalies;
     signals = js.signals;
+    slo_burns = js.slo_burns;
     signal_counts = js.signal_counts;
     agg = fleet_agg_locked(js, now);
     gen = js.fleet_gen;
     aseq = js.anomaly_seq;
     sseq = js.signal_seq;
+    slseq = js.slo_seq;
   }
   auto snap = std::make_shared<FleetSnapshot>();
   snap->gen = gen;
@@ -1417,6 +1567,10 @@ std::shared_ptr<const Lighthouse::FleetSnapshot> Lighthouse::fleet_snapshot(
   for (const auto& s : signals) sg.push(s);
   f["signals"] = sg;
   f["signal_seq"] = Json::of(sseq);
+  Json sb = Json::array();
+  for (const auto& b : slo_burns) sb.push(b);
+  f["slo_burns"] = sb;
+  f["slo_seq"] = Json::of(slseq);
   Json scnt = Json::object();
   for (const auto& kv : signal_counts) scnt[kv.first] = Json::of(kv.second);
   f["signal_counts"] = scnt;
@@ -1445,6 +1599,7 @@ Json Lighthouse::fleet_summary_locked(JobState& js, int64_t now) {
   Json s = fleet_agg_locked(js, now);
   s["anomaly_seq"] = Json::of(js.anomaly_seq);
   s["signal_seq"] = Json::of(js.signal_seq);
+  s["slo_seq"] = Json::of(js.slo_seq);
   s["gen"] = Json::of(js.fleet_gen);
   return s;
 }
@@ -1547,6 +1702,13 @@ std::string Lighthouse::render_metrics() {
     int64_t sseq = 0, sdropped = 0;
     size_t n_participants = 0, n_members = 0, n_fleet = 0;
     int64_t n_straggler = 0;
+    // Time-accounting plane (valid when has_acct).
+    bool has_acct = false;
+    double badput[kNumBadputKinds] = {};
+    double goodput = 0.0;
+    int64_t slo_seq = 0;
+    bool slo_burning = false;
+    double mtbf_s = -1.0, ettr_s = -1.0;  // <0 = no fault observed yet
   };
   int64_t now = now_ms();
   const int64_t epoch = epoch_.load();
@@ -1582,6 +1744,22 @@ std::string Lighthouse::render_metrics() {
     for (const auto& kv : jsp->fleet)
       if (!kv.second.flags.empty() || now < kv.second.straggler_until_ms)
         j.n_straggler += 1;
+    double acct_total = 0.0;
+    for (int i = 0; i < kNumBadputKinds; i++) {
+      j.badput[i] = std::max(jsp->agg_badput[i], 0.0);
+      acct_total += j.badput[i];
+    }
+    if (jsp->n_acct > 0 && acct_total > 0.0) {
+      j.has_acct = true;
+      j.goodput = j.badput[kBadputComputeIdx] / acct_total;
+    }
+    j.slo_seq = jsp->slo_seq;
+    j.slo_burning = jsp->slo_burning;
+    if (jsp->hard_signals > 0 && jsp->first_seen_ms > 0)
+      j.mtbf_s = static_cast<double>(now - jsp->first_seen_ms) / 1000.0 /
+                 static_cast<double>(jsp->hard_signals);
+    if (jsp->ettr_n > 0)
+      j.ettr_s = jsp->ettr_sum_s / static_cast<double>(jsp->ettr_n);
     if (jsp->name == "default") {
       def = j;
       hb_ages.reserve(jsp->state.heartbeats.size());
@@ -1815,6 +1993,51 @@ std::string Lighthouse::render_metrics() {
   for (const auto& j : job_rows)
     m << "torchft_lighthouse_job_anomalies_total{job=\"" << prom_escape(j.name)
       << "\"} " << j.aseq << "\n";
+  // Time-accounting series. Cardinality stays bounded by construction:
+  // goodput/SLO gauges are O(jobs); the badput family is O(jobs x the
+  // CLOSED kind enum), never per-replica.
+  m << "# HELP torchft_lighthouse_job_goodput_fraction Compute share of "
+       "all accounted replica-seconds per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_goodput_fraction gauge\n";
+  for (const auto& j : job_rows)
+    if (j.has_acct)
+      m << "torchft_lighthouse_job_goodput_fraction{job=\""
+        << prom_escape(j.name) << "\"} " << j.goodput << "\n";
+  m << "# HELP torchft_lighthouse_job_badput_seconds Accounted "
+       "replica-seconds per badput kind per job namespace (closed enum).\n"
+    << "# TYPE torchft_lighthouse_job_badput_seconds gauge\n";
+  for (const auto& j : job_rows)
+    if (j.has_acct)
+      for (int i = 0; i < kNumBadputKinds; i++)
+        m << "torchft_lighthouse_job_badput_seconds{job=\""
+          << prom_escape(j.name) << "\",kind=\"" << kBadputKindNames[i]
+          << "\"} " << j.badput[i] << "\n";
+  m << "# HELP torchft_lighthouse_job_slo_burns_total SLO burn-rate rise "
+       "edges per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_slo_burns_total counter\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_slo_burns_total{job=\"" << prom_escape(j.name)
+      << "\"} " << j.slo_seq << "\n";
+  m << "# HELP torchft_lighthouse_job_slo_burning Job currently burning "
+       "its goodput error budget faster than the threshold (1) or not (0).\n"
+    << "# TYPE torchft_lighthouse_job_slo_burning gauge\n";
+  for (const auto& j : job_rows)
+    m << "torchft_lighthouse_job_slo_burning{job=\"" << prom_escape(j.name)
+      << "\"} " << (j.slo_burning ? 1 : 0) << "\n";
+  m << "# HELP torchft_lighthouse_job_mtbf_seconds Mean time between "
+       "hard-evidence faults per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_mtbf_seconds gauge\n";
+  for (const auto& j : job_rows)
+    if (j.mtbf_s >= 0.0)
+      m << "torchft_lighthouse_job_mtbf_seconds{job=\"" << prom_escape(j.name)
+        << "\"} " << j.mtbf_s << "\n";
+  m << "# HELP torchft_lighthouse_job_ettr_seconds Mean evidence-to-"
+       "training-resumption time per job namespace.\n"
+    << "# TYPE torchft_lighthouse_job_ettr_seconds gauge\n";
+  for (const auto& j : job_rows)
+    if (j.ettr_s >= 0.0)
+      m << "torchft_lighthouse_job_ettr_seconds{job=\"" << prom_escape(j.name)
+        << "\"} " << j.ettr_s << "\n";
   // District (federation) series, present on a root lighthouse.
   m << "# HELP torchft_lighthouse_districts Districts reporting rollups.\n"
     << "# TYPE torchft_lighthouse_districts gauge\n"

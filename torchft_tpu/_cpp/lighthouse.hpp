@@ -185,6 +185,11 @@ class MedianTracker {
   std::multiset<double> lo_, hi_;
 };
 
+// Size of the closed badput taxonomy (telemetry.BADPUT_KINDS); the names
+// live in lighthouse.cc (kBadputKindNames, lint-mirrored positionally
+// against the Python tuple). The digest's "acct" array is indexed by it.
+constexpr int kNumBadputKinds = 10;
+
 class Lighthouse {
  public:
   Lighthouse(const std::string& bind_host, int port, LighthouseOpts opts);
@@ -285,6 +290,30 @@ class Lighthouse {
     MedianTracker agg_steps;        // digest steps (as double, like the sort)
     MedianTracker agg_gps;          // digest goodputs
     std::multiset<int64_t> agg_cfs;  // digest commit-failure streaks
+
+    // ---- time-accounting (goodput) plane ----
+    // Running per-kind badput second sums over rows whose digest carries
+    // an "acct" vector — maintained at digest swap exactly like the
+    // median trackers (remove old contribution, insert new), so the job
+    // goodput fraction is O(1) at read time.
+    double agg_badput[kNumBadputKinds] = {};
+    int64_t n_acct = 0;          // rows currently contributing to agg_badput
+    int64_t first_seen_ms = 0;   // first heartbeat ever (MTBF denominator)
+    int64_t hard_signals = 0;    // hard-evidence rise edges (MTBF numerator)
+    // ETTR episode: opened on a hard-signal rise, closed when any digest
+    // advances past the fleet max step as of the fault (forward progress
+    // resumed). One open episode at a time — overlapping faults extend it.
+    bool ettr_open = false;
+    int64_t ettr_open_ms = 0;
+    int64_t ettr_open_step = 0;
+    double ettr_sum_s = 0.0;
+    int64_t ettr_n = 0;
+    // SLO burn-rate evaluator: rise-edge slo_burn ring (same discipline
+    // as the anomaly ring — monotone seq, bounded, drops counted).
+    bool slo_burning = false;
+    std::deque<Json> slo_burns;
+    int64_t slo_seq = 0;
+    int64_t slo_dropped = 0;
 
     // ---- per-job snapshot cache ----
     std::mutex snap_mu;     // guards snap only
@@ -391,6 +420,13 @@ class Lighthouse {
   LatencyHist hist_snapshot_;    // fleet snapshot rebuild (copy+build+dump)
 
   int64_t export_max_replicas_ = 64;  // TORCHFT_EXPORT_MAX_REPLICAS
+
+  // SLO burn-rate knobs (TORCHFT_LH_SLO_*): goodput target, burn-rate
+  // threshold that trips a slo_burn event, and the minimum accounted
+  // seconds before the evaluator arms (startup/compile grace).
+  double slo_goodput_ = 0.95;  // TORCHFT_LH_SLO_GOODPUT
+  double slo_burn_ = 2.0;      // TORCHFT_LH_SLO_BURN
+  double slo_min_s_ = 30.0;    // TORCHFT_LH_SLO_MIN_S
 
   std::string bind_host_;
   int port_;

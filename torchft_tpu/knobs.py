@@ -409,6 +409,28 @@ _ALL = [
         "Per-job series cardinality cap in tools/obs_export.py: above this many job namespaces in the composite fleet payload, only jobs with stragglers or anomalies get per-job rollup series (plus a suppressed-count gauge).",
         scope="py",
     ),
+    # -- SLO burn-rate evaluator (lighthouse goodput plane) ---------------
+    _k(
+        "TORCHFT_LH_SLO_GOODPUT",
+        "float",
+        "0.95",
+        "Per-job goodput-fraction SLO target the lighthouse burn-rate evaluator compares against (compute share of all accounted replica-seconds). >= 1.0 disarms the evaluator (no error budget).",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_SLO_BURN",
+        "float",
+        "2.0",
+        "Burn-rate threshold that trips a rise-edge slo_burn event: burn = (1 - goodput) / (1 - TORCHFT_LH_SLO_GOODPUT), i.e. how many times faster than allotted the job spends its error budget.",
+        scope="cpp",
+    ),
+    _k(
+        "TORCHFT_LH_SLO_MIN_S",
+        "float",
+        "30.0",
+        "Minimum accounted replica-seconds before the SLO evaluator arms, so startup/compile windows cannot page.",
+        scope="cpp",
+    ),
     # -- C++-only ----------------------------------------------------------
     _k(
         "TORCHFT_LH_DEBUG",

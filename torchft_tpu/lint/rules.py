@@ -782,6 +782,8 @@ FLEET_TOP_KEYS = {
     # Failure-evidence plane: the island's signal ring, its monotone seq
     # cursor, and per-source totals.
     "signals", "signal_seq", "signal_counts",
+    # Goodput plane: the SLO burn-rate rise-edge ring + its seq cursor.
+    "slo_burns", "slo_seq",
 }
 FLEET_ROW_KEYS = {
     "last_hb_age_ms", "hb_interval_ms", "digest", "digest_age_ms",
@@ -794,6 +796,11 @@ FLEET_AGG_KEYS = {
     "median_goodput", "max_commit_failures", "anomalies_dropped",
     "quorum_world", "joins_total", "leaves_total", "epoch",
     "signals_dropped",
+    # Goodput plane: per-kind badput sums (closed BADPUT_KINDS object, or
+    # null before any acct digest), the job goodput fraction, MTBF/ETTR
+    # from the evidence plane, and the SLO evaluator state.
+    "badput_s", "goodput_frac", "mtbf_s", "ettr_s", "slo_burning",
+    "slo_dropped",
 }
 
 # Consumer read sites: variable name -> which key level it addresses.
@@ -944,6 +951,60 @@ def rule_signal_sources(root: str) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# badput-kinds: the time-accounting plane's closed taxonomy.
+#
+# telemetry.BADPUT_KINDS (the ledger + the digest's positional "acct"
+# array) and lighthouse.cc kBadputKindNames (the aggregation index) must
+# agree POSITIONALLY — a drifted entry silently mis-bills seconds to the
+# wrong kind on one side with no error anywhere. FAULT_BADPUT_KINDS (the
+# headline goodput-retention numerator) must stay a subset.
+
+
+def rule_badput_kinds(root: str) -> List[Finding]:
+    R = "badput-kinds"
+    out: List[Finding] = []
+    py = ex.py_tuple_of_strings(_p(root, TELEMETRY_PY), "BADPUT_KINDS")
+    if py is None:
+        out.append(Finding(R, "BADPUT_KINDS tuple not found", TELEMETRY_PY))
+        return out
+    cc_path = _p(root, LIGHTHOUSE_CC)
+    if os.path.exists(cc_path):
+        cc = ex.cc_string_array(cc_path, "kBadputKindNames")
+        if cc is None:
+            out.append(
+                Finding(R, "kBadputKindNames[] not found", LIGHTHOUSE_CC)
+            )
+        elif py != cc:
+            out.append(
+                Finding(
+                    R,
+                    f"badput kinds drifted (ordered): py={list(py)} "
+                    f"cc={list(cc)}",
+                    LIGHTHOUSE_CC,
+                )
+            )
+    fault = ex.py_tuple_of_strings(
+        _p(root, TELEMETRY_PY), "FAULT_BADPUT_KINDS"
+    )
+    if fault is None:
+        out.append(
+            Finding(R, "FAULT_BADPUT_KINDS tuple not found", TELEMETRY_PY)
+        )
+    else:
+        for k in fault:
+            if k not in py:
+                out.append(
+                    Finding(
+                        R,
+                        f"FAULT_BADPUT_KINDS entry {k!r} is not a "
+                        f"declared BADPUT_KINDS member",
+                        TELEMETRY_PY,
+                    )
+                )
+    return out
+
+
+# ----------------------------------------------------------------------
 
 RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("golden-constants", rule_golden_constants),
@@ -958,6 +1019,7 @@ RULES: List[Tuple[str, Callable[[str], List[Finding]]]] = [
     ("artifact-hygiene", rule_artifact_hygiene),
     ("fleet-keys", rule_fleet_keys),
     ("signal-sources", rule_signal_sources),
+    ("badput-kinds", rule_badput_kinds),
 ]
 
 

@@ -47,6 +47,7 @@ from torchft_tpu.store import StoreClient, TCPStoreServer
 from torchft_tpu.telemetry import (
     DigestWindow,
     StepDigest,
+    TimeLedger,
     get_event_log,
     get_metrics_logger,
     observe_span,
@@ -218,6 +219,18 @@ class Manager:
         # _ManagedWork._finish): subtracting them from the gate dt leaves
         # the compute residual the live digest reports as its "c" phase.
         self._allreduce_since_gate = 0.0
+        # Quorum-RPC-wait seconds inside the current window (accumulated
+        # by _async_quorum): priced as quorum_wait in the ledger.
+        self._quorum_since_gate = 0.0
+        # Whether a heal completed inside the current window: the first
+        # committed gate after a heal is replay/catch-up work, not steady
+        # compute, so its residual is priced as replay_catchup.
+        self._healed_since_gate = False
+        # Closed-taxonomy wall-clock ledger (BADPUT_KINDS): every second
+        # since construction lands in exactly one bucket, so the per-kind
+        # accounts tile the process lifetime by construction. The legacy
+        # _goodput dict above stays as the derived back-compat view.
+        self._ledger = TimeLedger()
 
         # Live health digest (heartbeat-carried StepDigest): rolling
         # rate/goodput window fed at every commit gate, pushed to the
@@ -597,6 +610,11 @@ class Manager:
             self._journal("quorum_abort", reason=str(e)[:200])
             self.report_error(e)
             raise
+        finally:
+            # Ledger split: the quorum RPC wait (including a failed or
+            # aborted one) is quorum_wait badput, not compute.
+            with self._goodput_lock:
+                self._quorum_since_gate += time.monotonic() - t_quorum0
 
         quorum_id_changed = result.quorum_id != self._quorum_id
         heal = result.heal and allow_heal
@@ -794,6 +812,7 @@ class Manager:
                         self._goodput["heal_count"] += 1
                         self._goodput["heal_s"] += t_heal["elapsed_s"]
                         self._heal_since_gate += t_heal["elapsed_s"]
+                        self._healed_since_gate = True
                     self._journal(
                         "heal_done",
                         peer=result.recover_src_replica_rank,
@@ -1116,6 +1135,7 @@ class Manager:
             err is None
             and self._participating_world_size >= self._min_replica_size
         )
+        t_gate_rpc0 = time.monotonic()
         try:
             answer = self._client.should_commit(
                 self._group_rank,
@@ -1127,6 +1147,9 @@ class Manager:
         except Exception as e:
             self._logger.exception(f"should_commit RPC failed: {e}")
             answer = False
+        # Time blocked in the commit-gate barrier RPC: waiting on the
+        # slowest peer to arrive — the ledger's straggler_idle split.
+        commit_wait_s = max(time.monotonic() - t_gate_rpc0, 0.0)
 
         # Fence the serving checkpoint before mutating params
         # (manager.py:818). The staged checkpoint is an immutable host
@@ -1144,6 +1167,8 @@ class Manager:
         now = time.monotonic()
         gate_dt: Optional[float] = None
         with self._goodput_lock:
+            first_gate = self._last_gate_t is None
+            heal_in_window = self._heal_since_gate
             if self._last_gate_t is not None:
                 dt = max(
                     now - self._last_gate_t - self._heal_since_gate, 0.0
@@ -1157,10 +1182,46 @@ class Manager:
             self._heal_since_gate = 0.0
             allreduce_since_gate = self._allreduce_since_gate
             self._allreduce_since_gate = 0.0
+            quorum_since_gate = self._quorum_since_gate
+            self._quorum_since_gate = 0.0
+            healed_in_window = self._healed_since_gate
+            self._healed_since_gate = False
             if answer:
                 self._goodput["committed_steps"] += 1
             else:
                 self._goodput["failed_commits"] += 1
+
+        # Ledger: close [frontier, now]. Named splits claim their measured
+        # seconds; the residual kind absorbs the rest of the window, so the
+        # accounts tile wall-clock by construction. The window before the
+        # first gate is startup (compile/init); a discarded step's residual
+        # is lost work; the first committed gate after a heal is replay.
+        if first_gate:
+            residual = "init_compile"
+        elif not answer:
+            residual = "discarded_step"
+        elif healed_in_window:
+            residual = "replay_catchup"
+        else:
+            residual = "compute"
+        credited = self._ledger.account(
+            {
+                "heal": heal_in_window,
+                "exposed_comm": allreduce_since_gate,
+                "quorum_wait": quorum_since_gate,
+                "straggler_idle": commit_wait_s,
+            },
+            residual,
+            upto=now,
+        )
+        self._journal(
+            "goodput_window",
+            committed=bool(answer),
+            residual=residual,
+            dur_s=round(sum(credited.values()), 9),
+            total_s=round(self._ledger.total_s(), 9),
+            splits={k: round(v, 9) for k, v in credited.items()},
+        )
 
         if gate_dt is not None:
             # Feed the live-digest window, and record the compute residual
@@ -1233,6 +1294,7 @@ class Manager:
                 errored=self.errored() is not None,
                 chaos_injections=chaos_n,
                 commit_failures=self._consecutive_commit_failures,
+                ledger=self._ledger,
             )
             # to_json() enforces the ≤512 B heartbeat budget (dropping bw,
             # then phases, if ever needed); ship the bounded form.
@@ -1241,15 +1303,31 @@ class Manager:
             pass
 
     def goodput(self) -> Dict[str, Any]:
-        """Productive-vs-lost wall-time split since startup: time between
-        commit gates bucketed by outcome, plus heal transfer time.
-        ``goodput_frac`` = committed / (committed + failed + heal); the
-        window before the first gate is unattributed."""
+        """Productive-vs-lost wall-time split since startup.
+
+        The legacy 3-way split (committed_s/failed_s/heal_s, plus
+        ``goodput_frac`` = committed / (committed + failed + heal)) is a
+        derived view kept for back-compat: those buckets need NOT tile
+        the run window (the pre-first-gate window is unattributed there).
+        The authoritative accounting is the closed-taxonomy ledger:
+        ``badput_s`` (per-:data:`~torchft_tpu.telemetry.BADPUT_KINDS`
+        seconds) tiles ``accounted_s`` — wall-clock from construction to
+        the last commit gate / drain — within float noise
+        (``tiling_error_s``); ``ledger_goodput_frac`` is the compute
+        share of every accounted second."""
         with self._goodput_lock:
             out = dict(self._goodput)
         denom = out["committed_s"] + out["failed_s"] + out["heal_s"]
         out["goodput_frac"] = (
             round(out["committed_s"] / denom, 4) if denom > 0 else None
+        )
+        badput = self._ledger.totals()
+        out["badput_s"] = {k: round(v, 4) for k, v in badput.items()}
+        out["accounted_s"] = round(self._ledger.total_s(), 4)
+        out["tiling_error_s"] = self._ledger.tiling_error_s()
+        total = sum(badput.values())
+        out["ledger_goodput_frac"] = (
+            round(badput["compute"] / total, 4) if total > 0 else None
         )
         return out
 
@@ -1348,6 +1426,9 @@ class Manager:
         the heartbeat stall."""
         if self._drained:
             return True
+        # Ledger: everything since the last gate was spent getting out,
+        # not training — close the window as drain.
+        self._account_drain()
         # Let an in-flight async quorum settle first so its registration
         # cannot land after (and undo) the leave.
         if self._quorum_future is not None:
@@ -1370,8 +1451,28 @@ class Manager:
 
     # ------------------------------------------------------------------
 
+    def _account_drain(self) -> None:
+        """Close the ledger's open tail window as ``drain`` and journal
+        the window, so offline tiling checks cover teardown too. Never
+        raises: accounting must not fail a drain or shutdown."""
+        try:
+            credited = self._ledger.account({}, "drain")
+            self._journal(
+                "goodput_window",
+                committed=False,
+                residual="drain",
+                dur_s=round(sum(credited.values()), 9),
+                total_s=round(self._ledger.total_s(), 9),
+                splits={k: round(v, 9) for k, v in credited.items()},
+            )
+        except Exception:  # noqa: BLE001 - advisory only
+            pass
+
     def shutdown(self) -> None:
         try:
+            # Close the tail window (teardown is drain, not compute) so
+            # the journaled final accounts tile up to this very call.
+            self._account_drain()
             g = self.goodput()
             if g["committed_steps"] or g["failed_commits"]:
                 self._logger.info(f"goodput: {g}")

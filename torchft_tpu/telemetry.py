@@ -476,6 +476,12 @@ EVENT_KINDS: Dict[str, str] = {
                       "site, monotonic signal seq",
     "signal_overflow": "lighthouse signal ring dropped records (rise "
                        "edge, like anomaly_overflow)",
+    # -- goodput ledger (manager.py, tools/goodput_report.py) -----------
+    "goodput_window": "one accounted wall-clock window: per-kind second "
+                      "splits (BADPUT_KINDS) that tile [t0, t1] exactly",
+    "slo_burn": "lighthouse SLO burn-rate rise edge: a job's goodput "
+                "fraction is eating its error budget faster than the "
+                "configured burn threshold",
 }
 
 # Closed enum of failure-evidence signal sources.  Mirrored positionally
@@ -496,6 +502,134 @@ SIGNAL_SOURCES: tuple = (
     "native_abort",
     "proc_death",
 )
+
+# Closed taxonomy of where a replica-second can go.  Mirrored positionally
+# by ``kBadputKindNames`` in ``_cpp/lighthouse.cc`` (lint rule
+# ``badput-kinds``): every second the :class:`TimeLedger` accounts lands
+# in exactly one of these buckets, and the per-replica accounts must TILE
+# wall-clock (``tools/goodput_report.py --check``, eps 1e-6).
+#   init_compile   process start -> first commit gate (imports, tracing,
+#                  XLA compile, first quorum formation)
+#   compute        committed-gate window residual: the training work the
+#                  job exists to do (the only GOODput bucket)
+#   exposed_comm   allreduce wall time not overlapped with compute
+#   quorum_wait    blocked on quorum formation / re-formation
+#   heal           receiving state from a live peer (this replica heals)
+#   discarded_step failed-gate window residual: work thrown away because
+#                  the commit gate said no
+#   replay_catchup committed-gate residual for windows re-running steps
+#                  the fleet already passed (post-heal catchup)
+#   straggler_idle blocked on the commit-gate vote gather (waiting for
+#                  slower peers' votes)
+#   drain          graceful leave / shutdown handshake
+#   down           process not running (between incarnations; attributed
+#                  journal-side by goodput_report from inter-incarnation
+#                  gaps, never self-reported)
+BADPUT_KINDS: tuple = (
+    "init_compile",
+    "compute",
+    "exposed_comm",
+    "quorum_wait",
+    "heal",
+    "discarded_step",
+    "replay_catchup",
+    "straggler_idle",
+    "drain",
+    "down",
+)
+
+# Badput kinds that only ever accrue because of a FAULT (vs the perf
+# badput present in a fault-free run: exposed_comm, quorum_wait,
+# straggler_idle).  The headline goodput-retention metric charges only
+# these against the run.
+FAULT_BADPUT_KINDS: tuple = (
+    "heal",
+    "discarded_step",
+    "replay_catchup",
+    "drain",
+    "down",
+)
+
+
+class TimeLedger:
+    """Per-replica wall-clock accountant over :data:`BADPUT_KINDS`.
+
+    The frontier design makes tiling true *by construction*: every call
+    to :meth:`account` closes the window ``[frontier, upto]``, clamps the
+    caller's per-kind splits to fit it, assigns the unclaimed remainder
+    to ``residual``, and advances the frontier to ``upto``.  The sum of
+    all buckets therefore always equals ``frontier - origin`` up to
+    float rounding — there is no code path that can leak or double-count
+    a second.  ``Manager`` drives it once per commit gate plus once at
+    drain; ``tools/goodput_report.py`` re-checks the invariant offline
+    from the ``goodput_window`` journal events.
+
+    ``now`` (monotonic seconds) is injectable for deterministic tests.
+    """
+
+    def __init__(self, now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else float(now)
+        self._lock = threading.Lock()
+        self._origin = t
+        self._frontier = t
+        self._acct: Dict[str, float] = {k: 0.0 for k in BADPUT_KINDS}
+
+    def account(
+        self,
+        splits: Dict[str, float],
+        residual: str,
+        upto: Optional[float] = None,
+    ) -> Dict[str, float]:
+        """Close the window ``[frontier, upto]``: credit each ``splits``
+        kind its seconds (scaled down proportionally if they over-claim
+        the window), the remainder to ``residual``.  Returns the per-kind
+        seconds actually credited (the ``goodput_window`` event body)."""
+        if residual not in self._acct:
+            raise ValueError(f"unknown badput kind {residual!r}")
+        t = time.monotonic() if upto is None else float(upto)
+        with self._lock:
+            window = max(t - self._frontier, 0.0)
+            claimed: Dict[str, float] = {}
+            total = 0.0
+            for kind, s in splits.items():
+                if kind not in self._acct:
+                    raise ValueError(f"unknown badput kind {kind!r}")
+                s = max(float(s), 0.0)
+                if s > 0.0:
+                    claimed[kind] = s
+                    total += s
+            if total > window and total > 0.0:
+                scale = window / total
+                claimed = {k: v * scale for k, v in claimed.items()}
+                total = window
+            claimed[residual] = claimed.get(residual, 0.0) + (window - total)
+            for kind, s in claimed.items():
+                self._acct[kind] += s
+            self._frontier = max(self._frontier, t)
+            return claimed
+
+    def totals(self) -> Dict[str, float]:
+        """Per-kind cumulative seconds (copy)."""
+        with self._lock:
+            return dict(self._acct)
+
+    def acct_vector(self) -> List[float]:
+        """Cumulative seconds positionally ordered by
+        :data:`BADPUT_KINDS` — the digest wire form (``acct`` key)."""
+        with self._lock:
+            return [self._acct[k] for k in BADPUT_KINDS]
+
+    def total_s(self) -> float:
+        with self._lock:
+            return self._frontier - self._origin
+
+    def tiling_error_s(self) -> float:
+        """|sum(buckets) - accounted wall| — float noise only, by
+        construction; exported so tests can pin the invariant."""
+        with self._lock:
+            return abs(
+                sum(self._acct.values()) - (self._frontier - self._origin)
+            )
 
 
 class EventLog:
@@ -794,7 +928,10 @@ class StepDigest:
     commit (keys q/h/c/a/m, see :data:`DIGEST_PHASE_SPANS`); ``bw`` maps
     peer rank → effective GiB/s on the native data plane (absent on the
     socket backend); ``err`` is the error-latch state, ``chaos`` the
-    injection count, ``cf`` the consecutive-commit-failure streak. The
+    injection count, ``cf`` the consecutive-commit-failure streak;
+    ``acct`` is the cumulative :class:`TimeLedger` account — seconds per
+    badput kind, positionally ordered by :data:`BADPUT_KINDS` (a plain
+    array keeps it inside the byte budget; both ends share the enum). The
     budget exists because the digest rides the 100 ms-interval heartbeat:
     it must stay cheap to build, send, and parse every tick.
     """
@@ -812,6 +949,7 @@ class StepDigest:
         errored: bool = False,
         chaos_injections: int = 0,
         commit_failures: int = 0,
+        acct: Optional[List[float]] = None,
     ) -> None:
         self.step = int(step)
         self.rate = float(rate)
@@ -821,6 +959,7 @@ class StepDigest:
         self.errored = bool(errored)
         self.chaos_injections = int(chaos_injections)
         self.commit_failures = int(commit_failures)
+        self.acct = None if acct is None else [float(v) for v in acct]
 
     @classmethod
     def collect(
@@ -831,6 +970,7 @@ class StepDigest:
         chaos_injections: int = 0,
         commit_failures: int = 0,
         now: Optional[float] = None,
+        ledger: Optional["TimeLedger"] = None,
     ) -> "StepDigest":
         """Builds a digest from a :class:`DigestWindow` plus the process's
         own span histograms (:func:`span_percentiles`) — no extra timers,
@@ -851,6 +991,7 @@ class StepDigest:
             errored=errored,
             chaos_injections=chaos_injections,
             commit_failures=commit_failures,
+            acct=None if ledger is None else ledger.acct_vector(),
         )
 
     def to_wire(self) -> Dict[str, Any]:
@@ -883,15 +1024,18 @@ class StepDigest:
             wire["chaos"] = self.chaos_injections
         if self.commit_failures:
             wire["cf"] = self.commit_failures
+        if self.acct is not None:
+            wire["acct"] = [_sig4(v) for v in self.acct[: len(BADPUT_KINDS)]]
         return wire
 
     def to_json(self) -> str:
         """Compact JSON, hard-capped at :data:`MAX_WIRE_BYTES`: if the
         encoded form is somehow over budget the bandwidth map is dropped
-        first, then the phase block — a truncated digest beats a heartbeat
-        frame that old lighthouses might refuse to read."""
+        first, then the phase block, then the badput account — a
+        truncated digest beats a heartbeat frame that old lighthouses
+        might refuse to read."""
         wire = self.to_wire()
-        for drop in (None, "bw", "ph"):
+        for drop in (None, "bw", "ph", "acct"):
             if drop is not None:
                 wire.pop(drop, None)
             s = json.dumps(wire, separators=(",", ":"))
@@ -921,6 +1065,11 @@ class StepDigest:
             errored=bool(wire.get("err", 0)),
             chaos_injections=int(wire.get("chaos", 0) or 0),
             commit_failures=int(wire.get("cf", 0) or 0),
+            acct=(
+                [float(v) for v in wire["acct"]]
+                if isinstance(wire.get("acct"), (list, tuple))
+                else None
+            ),
         )
 
 
